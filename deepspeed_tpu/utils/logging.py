@@ -1,0 +1,54 @@
+"""Rank-aware logging.
+
+TPU-native re-design of the reference logger
+(/root/reference/deepspeed/utils/logging.py): same `logger` +
+`log_dist(message, ranks=...)` surface, but rank comes from
+`jax.process_index()` instead of torch.distributed.
+"""
+
+import logging
+import os
+import sys
+
+_FORMAT = "[%(asctime)s] [%(levelname)s] [deepspeed_tpu] %(message)s"
+
+
+def _create_logger(name="deepspeed_tpu", level=logging.INFO):
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=getattr(logging, os.environ.get("DSTPU_LOG_LEVEL", "INFO").upper(), logging.INFO)
+)
+
+
+def _process_index():
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log `message` only on the listed process ranks (None/[-1] => all).
+
+    Reference parity: deepspeed/utils/logging.py `log_dist`.
+    """
+    my_rank = _process_index()
+    if ranks is None or -1 in ranks or my_rank in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message, _seen=set()):
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

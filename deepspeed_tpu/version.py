@@ -1,0 +1,29 @@
+"""Version info for deepspeed_tpu.
+
+Mirrors the reference's version stamping (/root/reference/version.txt,
+deepspeed/git_version_info.py) without requiring a build step.
+"""
+
+__version__ = "0.1.0"
+version = __version__
+git_hash = "unknown"
+git_branch = "main"
+
+try:  # best-effort git stamp, mirroring reference git_version_info.py
+    import os
+    import subprocess
+
+    _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    git_hash = (
+        subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=_repo, capture_output=True, text=True, timeout=2,
+        ).stdout.strip()
+        or "unknown"
+    )
+except Exception:  # pragma: no cover - git not available
+    pass
+
+# populated lazily by op_builder registry (reference: installed_ops dict)
+installed_ops = {}
+compatible_ops = {}

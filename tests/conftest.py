@@ -1,0 +1,34 @@
+"""Test harness: virtual 8-device CPU mesh.
+
+The reference tests run real NCCL on 2-4 local GPUs via the
+@distributed_test fork-N-processes fixture
+(/root/reference/tests/unit/common.py:16-100). TPU-natively we instead run
+single-process with XLA's host-platform device virtualization: 8 fake CPU
+devices, so every sharding/collective path executes for real (SPMD) without
+hardware. This must run before jax initializes, hence conftest import time.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: ambient env pins the TPU platform
+
+import jax  # noqa: E402
+
+# sitecustomize (axon) imports jax before conftest runs, so the env var
+# alone is too late — override via config as well.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    """Each test builds meshes explicitly; clear the global between tests."""
+    yield
+    from deepspeed_tpu.comm import mesh as mesh_mod
+
+    mesh_mod._CURRENT_MESH = None
