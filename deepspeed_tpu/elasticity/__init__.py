@@ -1,0 +1,10 @@
+from .elasticity import (  # noqa: F401
+    ElasticityConfig,
+    ElasticityConfigError,
+    ElasticityError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+    get_compatible_gpus_v01,
+)

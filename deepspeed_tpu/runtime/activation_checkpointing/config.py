@@ -1,0 +1,36 @@
+"""Activation checkpointing config
+(reference: deepspeed/runtime/activation_checkpointing/config.py:769-850).
+
+On TPU, `partition_activations` maps to sharding saved activations over the
+model axis inside `jax.checkpoint` policies; `cpu_checkpointing` maps to
+host offload of residuals; `contiguous_memory_optimization` and
+`synchronize_checkpoint_boundary` are accepted no-ops (XLA owns layout and
+ordering).
+"""
+
+from ..config_utils import DeepSpeedConfigObject, get_scalar_param
+
+ACTIVATION_CHKPT = "activation_checkpointing"
+ACT_CHKPT_PARTITION_ACTIVATIONS = "partition_activations"
+ACT_CHKPT_NUMBER_CHECKPOINTS = "number_checkpoints"
+ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION = "contiguous_memory_optimization"
+ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY = "synchronize_checkpoint_boundary"
+ACT_CHKPT_PROFILE = "profile"
+ACT_CHKPT_CPU_CHECKPOINTING = "cpu_checkpointing"
+
+
+class DeepSpeedActivationCheckpointingConfig(DeepSpeedConfigObject):
+    def __init__(self, param_dict):
+        super().__init__()
+        d = param_dict.get(ACTIVATION_CHKPT, {}) or {}
+        self.partition_activations = get_scalar_param(
+            d, ACT_CHKPT_PARTITION_ACTIVATIONS, False)
+        self.contiguous_memory_optimization = get_scalar_param(
+            d, ACT_CHKPT_CONTIGUOUS_MEMORY_OPTIMIZATION, False)
+        self.cpu_checkpointing = get_scalar_param(
+            d, ACT_CHKPT_CPU_CHECKPOINTING, False)
+        self.number_checkpoints = get_scalar_param(
+            d, ACT_CHKPT_NUMBER_CHECKPOINTS, None)
+        self.profile = get_scalar_param(d, ACT_CHKPT_PROFILE, False)
+        self.synchronize_checkpoint_boundary = get_scalar_param(
+            d, ACT_CHKPT_SYNCHRONIZE_CHECKPOINT_BOUNDARY, False)

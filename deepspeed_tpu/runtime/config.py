@@ -1,0 +1,317 @@
+"""DeepSpeedConfig — JSON config parsing + validation.
+
+Schema-compatible with the reference (deepspeed/runtime/config.py:536):
+user configs written for DeepSpeed parse unchanged. The batch triple
+(train_batch_size = micro_batch * gradient_accumulation_steps * dp_world)
+solver mirrors reference config.py:681-752. TPU additions: a "mesh"
+section selecting parallel axis sizes.
+"""
+
+import json
+
+from ..elasticity import (
+    ElasticityConfigError,
+    compute_elastic_config,
+    elasticity_enabled,
+    ensure_immutable_elastic_config,
+)
+from ..elasticity import constants as ec
+from ..profiling.config import DeepSpeedFlopsProfilerConfig
+from ..utils.logging import logger
+from . import constants as c
+from .activation_checkpointing.config import DeepSpeedActivationCheckpointingConfig
+from .config_utils import (
+    DeepSpeedConfigObject,
+    dict_raise_error_on_duplicate_keys,
+    get_scalar_param,
+)
+from .zero.config import DeepSpeedZeroConfig
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+TORCH_DTYPES = {
+    "fp16": "float16", "float16": "float16", "half": "float16",
+    "bf16": "bfloat16", "bfloat16": "bfloat16",
+    "fp32": "float32", "float32": "float32", "float": "float32",
+}
+
+
+class DeepSpeedConfigWriter(DeepSpeedConfigObject):
+    pass
+
+
+def get_fp16_enabled(param_dict):
+    return get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_ENABLED,
+                            c.FP16_ENABLED_DEFAULT)
+
+
+def get_precision(param_dict):
+    """Return the compute dtype name. The EleutherAI fork extends the fp16
+    section with "type": "bfloat16" (reference runtime/constants.py:127-161,
+    engine.py:613-620)."""
+    if not get_fp16_enabled(param_dict):
+        return "float32"
+    raw = get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_TYPE,
+                           c.FP16_TYPE_DEFAULT)
+    if raw not in TORCH_DTYPES:
+        raise DeepSpeedConfigError(
+            f"fp16.type must be one of {sorted(set(TORCH_DTYPES))}, got {raw!r}")
+    return TORCH_DTYPES[raw]
+
+
+class DeepSpeedConfig(DeepSpeedConfigObject):
+    def __init__(self, json_file_or_dict, mpu=None, param_dict=None,
+                 world_size=None):
+        super().__init__()
+        if param_dict is not None:
+            self._param_dict = param_dict
+        elif isinstance(json_file_or_dict, dict):
+            self._param_dict = json_file_or_dict
+        elif isinstance(json_file_or_dict, str):
+            try:
+                with open(json_file_or_dict) as f:
+                    self._param_dict = json.load(
+                        f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+            except FileNotFoundError:
+                raise DeepSpeedConfigError(
+                    f"DeepSpeed config file not found: {json_file_or_dict}")
+        else:
+            raise DeepSpeedConfigError(
+                "config must be a dict or a path to a json file, got "
+                f"{type(json_file_or_dict)}")
+
+        # world size for the batch triple: dp size (reference uses dist world
+        # / mp size; here it's device_count / (model*pipe*seq axes))
+        if world_size is not None:
+            self.world_size = int(world_size)
+        elif mpu is not None:
+            self.world_size = int(mpu.get_data_parallel_world_size())
+        else:
+            self.world_size = self._infer_dp_world_size()
+
+        # Elasticity resolves the batch triple before parsing it
+        # (reference runtime/config.py:537-614).
+        self.elasticity_enabled = elasticity_enabled(self._param_dict)
+        if self.elasticity_enabled:
+            elastic_dict = self._param_dict[ec.ELASTICITY]
+            ensure_immutable_elastic_config(elastic_dict)
+            final_batch_size, valid_gpus, micro_batch = compute_elastic_config(
+                self._param_dict, world_size=self.world_size)
+            self.elastic_valid_world_sizes = valid_gpus
+            ignore = elastic_dict.get(ec.IGNORE_NON_ELASTIC_BATCH_INFO,
+                                      ec.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+            batch_keys = (c.TRAIN_BATCH_SIZE, c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                          c.GRADIENT_ACCUMULATION_STEPS)
+            if not ignore and any(k in self._param_dict for k in batch_keys):
+                raise ElasticityConfigError(
+                    f"batch size keys {batch_keys} must not be set when "
+                    f"elasticity is enabled (set "
+                    f"'{ec.IGNORE_NON_ELASTIC_BATCH_INFO}': true to override)")
+            self._param_dict = dict(self._param_dict)
+            self._param_dict[c.TRAIN_BATCH_SIZE] = final_batch_size
+            self._param_dict[c.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch
+            self._param_dict[c.GRADIENT_ACCUMULATION_STEPS] = (
+                final_batch_size // (micro_batch * self.world_size))
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    def _infer_dp_world_size(self):
+        mesh_dict = self._param_dict.get(c.MESH) or {}
+        try:
+            import jax
+
+            n = jax.device_count()
+        except Exception:
+            n = 1
+        non_dp = 1
+        for axis in ("model", "pipe", "seq"):
+            non_dp *= max(1, int(mesh_dict.get(axis, 1)))
+        dp = mesh_dict.get("data", -1)
+        if dp in (-1, None):
+            dp = max(1, n // non_dp)
+        return int(dp)
+
+    # -- parsing ----------------------------------------------------------
+
+    def _initialize_params(self, pd):
+        self.train_batch_size = get_scalar_param(pd, c.TRAIN_BATCH_SIZE,
+                                                 c.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get_scalar_param(
+            pd, c.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+            c.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get_scalar_param(
+            pd, c.GRADIENT_ACCUMULATION_STEPS,
+            c.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get_scalar_param(pd, c.STEPS_PER_PRINT,
+                                                c.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get_scalar_param(pd, c.DUMP_STATE, c.DUMP_STATE_DEFAULT)
+        self.disable_allgather = get_scalar_param(pd, c.DISABLE_ALLGATHER,
+                                                  c.DISABLE_ALLGATHER_DEFAULT)
+
+        self.gradient_clipping = get_scalar_param(pd, c.GRADIENT_CLIPPING,
+                                                  c.GRADIENT_CLIPPING_DEFAULT)
+        self.sparse_gradients_enabled = get_scalar_param(
+            pd, c.SPARSE_GRADIENTS, c.SPARSE_GRADIENTS_DEFAULT)
+        self.prescale_gradients = get_scalar_param(pd, c.PRESCALE_GRADIENTS,
+                                                   c.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get_scalar_param(
+            pd, c.GRADIENT_PREDIVIDE_FACTOR, c.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+
+        self.zero_config = DeepSpeedZeroConfig(pd)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = \
+            DeepSpeedActivationCheckpointingConfig(pd)
+        self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(pd)
+
+        # precision
+        self.fp16_enabled = get_fp16_enabled(pd)
+        self.precision = get_precision(pd)
+        fp16_dict = pd.get(c.FP16, {})
+        self.loss_scale = get_scalar_param(fp16_dict, c.FP16_LOSS_SCALE,
+                                           c.FP16_LOSS_SCALE_DEFAULT)
+        self.initial_scale_power = get_scalar_param(
+            fp16_dict, c.FP16_INITIAL_SCALE_POWER,
+            c.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.loss_scale_window = get_scalar_param(
+            fp16_dict, c.FP16_LOSS_SCALE_WINDOW, c.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.hysteresis = get_scalar_param(fp16_dict, c.FP16_HYSTERESIS,
+                                           c.FP16_HYSTERESIS_DEFAULT)
+        self.min_loss_scale = get_scalar_param(fp16_dict, c.FP16_MIN_LOSS_SCALE,
+                                               c.FP16_MIN_LOSS_SCALE_DEFAULT)
+        self.amp_enabled = get_scalar_param(pd.get(c.AMP, {}), c.AMP_ENABLED,
+                                            c.AMP_ENABLED_DEFAULT)
+        self.amp_params = {k: v for k, v in pd.get(c.AMP, {}).items()
+                           if k != c.AMP_ENABLED}
+
+        # optimizer / scheduler
+        opt_dict = pd.get(c.OPTIMIZER, None)
+        self.optimizer_name = (opt_dict.get(c.TYPE).lower()
+                               if opt_dict and opt_dict.get(c.TYPE) else None)
+        self.optimizer_params = (opt_dict.get(c.OPTIMIZER_PARAMS, {})
+                                 if opt_dict else None)
+        self.optimizer_legacy_fusion = (get_scalar_param(
+            opt_dict, c.LEGACY_FUSION, c.LEGACY_FUSION_DEFAULT)
+            if opt_dict else c.LEGACY_FUSION_DEFAULT)
+        self.zero_allow_untested_optimizer = get_scalar_param(
+            pd, c.ZERO_ALLOW_UNTESTED_OPTIMIZER,
+            c.ZERO_ALLOW_UNTESTED_OPTIMIZER_DEFAULT)
+
+        sched_dict = pd.get(c.SCHEDULER, None)
+        self.scheduler_name = sched_dict.get(c.TYPE) if sched_dict else None
+        self.scheduler_params = (sched_dict.get(c.SCHEDULER_PARAMS, {})
+                                 if sched_dict else None)
+
+        # observability
+        self.wall_clock_breakdown = get_scalar_param(
+            pd, c.WALL_CLOCK_BREAKDOWN, c.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get_scalar_param(pd, c.MEMORY_BREAKDOWN,
+                                                 c.MEMORY_BREAKDOWN_DEFAULT)
+        tb = pd.get(c.TENSORBOARD, {})
+        self.tensorboard_enabled = get_scalar_param(tb, c.TENSORBOARD_ENABLED,
+                                                    c.TENSORBOARD_ENABLED_DEFAULT)
+        self.tensorboard_output_path = get_scalar_param(
+            tb, c.TENSORBOARD_OUTPUT_PATH, c.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.tensorboard_job_name = get_scalar_param(
+            tb, c.TENSORBOARD_JOB_NAME, c.TENSORBOARD_JOB_NAME_DEFAULT)
+
+        # progressive layer drop
+        pld = pd.get(c.PROGRESSIVE_LAYER_DROP, {})
+        self.pld_enabled = get_scalar_param(pld, c.PLD_ENABLED, c.PLD_ENABLED_DEFAULT)
+        self.pld_params = ({c.PLD_THETA: get_scalar_param(pld, c.PLD_THETA,
+                                                          c.PLD_THETA_DEFAULT),
+                            c.PLD_GAMMA: get_scalar_param(pld, c.PLD_GAMMA,
+                                                          c.PLD_GAMMA_DEFAULT)}
+                           if self.pld_enabled else False)
+
+        ckpt = pd.get(c.CHECKPOINT, {})
+        self.checkpoint_tag_validation_mode = str(get_scalar_param(
+            ckpt, c.CHECKPOINT_TAG_VALIDATION,
+            c.CHECKPOINT_TAG_VALIDATION_DEFAULT)).lower()
+        self.checkpoint_tag_validation_enabled = \
+            self.checkpoint_tag_validation_mode != "ignore"
+        self.checkpoint_tag_validation_fail = \
+            self.checkpoint_tag_validation_mode == "fail"
+
+        self.sparse_attention = pd.get(c.SPARSE_ATTENTION, None)
+        self.vocabulary_size = get_scalar_param(pd, c.VOCABULARY_SIZE,
+                                                c.VOCABULARY_SIZE_DEFAULT)
+
+        # TPU additions
+        self.mesh_shape = pd.get(c.MESH, c.MESH_DEFAULT)
+
+    # -- batch triple (reference config.py:681-752) -----------------------
+
+    def _configure_train_batch_size(self):
+        self._set_batch_related_parameters()
+        self._batch_assertion()
+
+    def _set_batch_related_parameters(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = self.world_size
+
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * dp
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // dp
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * dp
+            self.gradient_accumulation_steps = 1
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "needs to be provided")
+
+    def _batch_assertion(self):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        dp = self.world_size
+        if not (train_batch > 0 and micro_batch > 0 and grad_acc > 0):
+            raise DeepSpeedConfigError(
+                f"batch sizes must be positive: train_batch_size={train_batch}, "
+                f"micro_batch={micro_batch}, grad_acc={grad_acc}")
+        if train_batch != micro_batch * grad_acc * dp:
+            raise DeepSpeedConfigError(
+                f"Check batch related parameters: train_batch_size={train_batch} "
+                f"is not equal to micro_batch_per_gpu({micro_batch}) * "
+                f"gradient_acc_steps({grad_acc}) * world_size({dp})")
+
+    # -- sanity (reference config.py _do_sanity_check) --------------------
+
+    def _do_sanity_check(self):
+        if self.optimizer_name is not None and self.zero_enabled:
+            if (self.optimizer_name not in c.DEEPSPEED_OPTIMIZERS and
+                    not self.zero_allow_untested_optimizer):
+                logger.warning(
+                    f"optimizer '{self.optimizer_name}' is untested with ZeRO; "
+                    f"set '{c.ZERO_ALLOW_UNTESTED_OPTIMIZER}': true to silence")
+        if self.zero_config.stage == 2 and not self.fp16_enabled:
+            # reference requires fp16 for ZeRO>0; bf16/fp32 work fine on TPU,
+            # keep a log line for parity awareness only
+            logger.debug("ZeRO-2 without reduced precision (allowed on TPU)")
+
+    def print(self, name="DeepSpeedConfig"):
+        logger.info(f"{name}:")
+        for k in sorted(self.__dict__):
+            if not k.startswith("_"):
+                logger.info(f"  {k} = {self.__dict__[k]}")

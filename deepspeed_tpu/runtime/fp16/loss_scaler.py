@@ -1,0 +1,186 @@
+"""Loss scaling — static + dynamic.
+
+Reference: deepspeed/runtime/fp16/loss_scaler.py (Megatron lineage). The
+semantics (scale_factor backoff, scale_window growth, hysteresis delayed
+shift) are kept; the mechanism is redesigned for XLA: scaler state is a
+pytree of scalars and `update_scale_jit` is a branchless pure function so
+the whole skip-step decision lives inside the jitted train step
+(`jnp.where` instead of Python control flow).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# config keys (reference loss_scaler.py:19-22)
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"
+
+
+def make_scaler_state(init_scale: float) -> dict:
+    """Pytree state carried through the jitted step."""
+    return {
+        "cur_scale": jnp.asarray(init_scale, dtype=jnp.float32),
+        "cur_iter": jnp.asarray(0, dtype=jnp.int32),
+        "last_overflow_iter": jnp.asarray(-1, dtype=jnp.int32),
+        "cur_hysteresis": jnp.asarray(1, dtype=jnp.int32),
+    }
+
+
+def update_scale_jit(state: dict, overflow, *, scale_factor: float = 2.0,
+                     scale_window: int = 1000, min_scale: float = 1.0,
+                     delayed_shift: int = 1,
+                     consecutive_hysteresis: bool = False) -> dict:
+    """Branchless DynamicLossScaler.update_scale (reference :150-170).
+
+    overflow: bool scalar (traced). Static knobs are Python values baked at
+    trace time.
+    """
+    cur_scale = state["cur_scale"]
+    cur_iter = state["cur_iter"] + 1
+    cur_hyst = state["cur_hysteresis"]
+
+    shift_now = jnp.logical_or(delayed_shift == 1, cur_hyst <= 1)
+    dec_scale = jnp.maximum(cur_scale / scale_factor, min_scale)
+
+    window_hit = ((cur_iter - state["last_overflow_iter"]) % scale_window) == 0
+    inc_scale = jnp.where(window_hit, cur_scale * scale_factor, cur_scale)
+
+    new_scale = jnp.where(overflow,
+                          jnp.where(shift_now, dec_scale, cur_scale),
+                          inc_scale)
+    new_hyst = jnp.where(
+        overflow,
+        jnp.where(shift_now, cur_hyst, cur_hyst - 1),
+        jnp.where(jnp.logical_and(window_hit, not consecutive_hysteresis),
+                  jnp.asarray(delayed_shift, jnp.int32),
+                  (jnp.asarray(delayed_shift, jnp.int32)
+                   if consecutive_hysteresis else cur_hyst)),
+    )
+    new_last_overflow = jnp.where(overflow, cur_iter,
+                                  state["last_overflow_iter"])
+    return {
+        "cur_scale": new_scale,
+        "cur_iter": cur_iter,
+        "last_overflow_iter": new_last_overflow,
+        "cur_hysteresis": new_hyst,
+    }
+
+
+class LossScalerBase:
+    """Host-side API parity (reference LossScalerBase)."""
+
+    def __init__(self, cur_scale):
+        self.cur_scale = float(cur_scale)
+
+    @property
+    def loss_scale(self):
+        return self.cur_scale
+
+    def update_scale(self, overflow):
+        pass
+
+    def state_dict(self):
+        return {"cur_scale": self.cur_scale}
+
+    def load_state_dict(self, sd):
+        self.cur_scale = sd["cur_scale"]
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (reference LossScaler)."""
+
+    def __init__(self, scale=1.0):
+        super().__init__(scale)
+        self.dynamic = False
+
+    def jit_state(self):
+        return make_scaler_state(self.cur_scale)
+
+    def jit_update(self, state, overflow):
+        state = dict(state)
+        state["cur_iter"] = state["cur_iter"] + 1
+        return state
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scale (reference DynamicLossScaler)."""
+
+    def __init__(self, init_scale=2 ** 32, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False,
+                 raise_error_at_min_scale=True):
+        super().__init__(init_scale)
+        self.dynamic = True
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+        self.raise_error_at_min_scale = raise_error_at_min_scale
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.cur_hysteresis = delayed_shift
+
+    def jit_state(self):
+        st = make_scaler_state(self.cur_scale)
+        st["cur_iter"] = jnp.asarray(self.cur_iter, jnp.int32)
+        st["last_overflow_iter"] = jnp.asarray(self.last_overflow_iter, jnp.int32)
+        st["cur_hysteresis"] = jnp.asarray(self.cur_hysteresis, jnp.int32)
+        return st
+
+    def jit_update(self, state, overflow):
+        return update_scale_jit(state, overflow,
+                                scale_factor=self.scale_factor,
+                                scale_window=self.scale_window,
+                                min_scale=self.min_scale,
+                                delayed_shift=self.delayed_shift,
+                                consecutive_hysteresis=self.consecutive_hysteresis)
+
+    # host-side mirror (used outside jit, e.g. tests / eager mode)
+    def update_scale(self, overflow):
+        self.cur_iter += 1
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                if self.cur_scale == self.min_scale and self.raise_error_at_min_scale:
+                    raise RuntimeError(
+                        "Current loss scale already at minimum - cannot "
+                        "decrease scale anymore. Exiting run.")
+                self.cur_scale = max(self.cur_scale / self.scale_factor,
+                                     self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+
+    def state_dict(self):
+        return {"cur_scale": self.cur_scale, "cur_iter": self.cur_iter,
+                "last_overflow_iter": self.last_overflow_iter,
+                "cur_hysteresis": self.cur_hysteresis}
+
+    def load_state_dict(self, sd):
+        self.cur_scale = sd["cur_scale"]
+        self.cur_iter = sd.get("cur_iter", 0)
+        self.last_overflow_iter = sd.get("last_overflow_iter", -1)
+        self.cur_hysteresis = sd.get("cur_hysteresis", self.delayed_shift)
+
+
+def create_loss_scaler(ds_config) -> LossScalerBase:
+    """Build from DeepSpeedConfig (reference fp16 optimizer ctors)."""
+    if ds_config.precision == "float16":
+        if ds_config.loss_scale == 0:
+            return DynamicLossScaler(
+                init_scale=2 ** ds_config.initial_scale_power,
+                scale_window=ds_config.loss_scale_window,
+                min_scale=ds_config.min_loss_scale,
+                delayed_shift=ds_config.hysteresis)
+        return LossScaler(scale=ds_config.loss_scale)
+    # bf16/fp32 need no loss scaling
+    return LossScaler(scale=1.0)

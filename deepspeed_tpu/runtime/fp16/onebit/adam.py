@@ -1,0 +1,152 @@
+"""1-bit Adam — error-compensated sign-compressed momentum allreduce.
+
+Reference: deepspeed/runtime/fp16/onebit/adam.py:14 + the NCCL/MPI compressed
+backends (runtime/comm/nccl.py:47-186). Semantics kept: dense Adam during a
+`freeze_step` warmup, then the second moment is frozen and only momentum is
+communicated, 1-bit sign-compressed with worker- and server-side error
+feedback.
+
+TPU redesign: the reference's cupy packbits + all_to_all + allgather
+machinery was a bandwidth workaround for commodity interconnects. Here the
+compress -> reduce -> recompress pipeline is a pure function inside the
+jitted step: signs ride a psum over the `data` mesh axis (ICI), and both
+error-feedback stages live in optimizer state. The optimizer owns its DP
+reduction (`handles_dp_reduction`), so the engine skips its gradient psum
+after warmup.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def compressed_allreduce(x, worker_error, server_error, axis):
+    """1-bit compress with error feedback, average over `axis`, recompress.
+
+    Returns (averaged_tensor, new_worker_error, new_server_error).
+    Mirrors NcclBackend.compressed_allreduce (reference comm/nccl.py:47-186):
+      worker: c = x + worker_error; scale = ||c||_1/n; send sign(c)*scale
+      server: s = avg + server_error; rescale and sign again
+    """
+    c = x + worker_error
+    scale = jnp.mean(jnp.abs(c))
+    compressed = jnp.sign(c) * scale
+    new_worker_error = c - compressed
+
+    if axis is not None:
+        avg = lax.pmean(compressed, axis)
+    else:
+        avg = compressed
+
+    s = avg + server_error
+    server_scale = jnp.mean(jnp.abs(s))
+    out = jnp.sign(s) * server_scale
+    new_server_error = s - out
+    return out, new_worker_error, new_server_error
+
+
+class OnebitAdam:
+    name = "OnebitAdam"
+    handles_dp_reduction = True
+
+    def __init__(self, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
+                 bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
+                 eps_inside_sqrt=False, weight_decay=0.0, max_grad_norm=0.0,
+                 amsgrad=False, cuda_aware=False):
+        if amsgrad:
+            raise RuntimeError("1-bit Adam does not support the AMSGrad variant.")
+        self.defaults = dict(lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay,
+                             bias_correction=bias_correction)
+        self.param_groups = [dict(self.defaults)]
+        self.freeze_step = int(freeze_step)
+        self.eps_inside_sqrt = eps_inside_sqrt
+
+    @property
+    def lr(self):
+        return self.param_groups[0]["lr"]
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, dtype=jnp.float32)
+        zt = lambda: jax.tree_util.tree_map(zeros, params)
+        return {
+            "step": jnp.zeros((), dtype=jnp.int32),
+            "exp_avg": zt(),
+            "exp_avg_sq": zt(),
+            "worker_error": zt(),
+            "server_error": zt(),
+        }
+
+    def update(self, grads, state, params, lr=None, comm_axis=None):
+        """grads must be LOCAL (per-shard, unreduced) gradients; this
+        optimizer performs its own DP averaging (dense during warmup,
+        compressed after)."""
+        g = self.param_groups[0]
+        lr = g["lr"] if lr is None else lr
+        beta1, beta2 = g["betas"]
+        eps = g["eps"]
+        wd = g["weight_decay"]
+        step = state["step"] + 1
+        frozen = step > self.freeze_step  # traced scalar bool
+
+        if g["bias_correction"]:
+            bc1 = 1.0 - beta1 ** step.astype(jnp.float32)
+            bc2 = 1.0 - beta2 ** step.astype(jnp.float32)
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+
+        def upd(p, grad, m, v, we, se):
+            grad = grad.astype(jnp.float32)
+
+            def warm_branch(operands):
+                grad_, m_, v_, we_, se_ = operands
+                g_ = lax.pmean(grad_, comm_axis) if comm_axis is not None else grad_
+                m_warm = beta1 * m_ + (1.0 - beta1) * g_
+                v_warm = beta2 * v_ + (1.0 - beta2) * g_ * g_
+                return m_warm, v_warm, we_, se_
+
+            def frozen_branch(operands):
+                grad_, m_, v_, we_, se_ = operands
+                m_local = beta1 * m_ + (1.0 - beta1) * grad_
+                m_comp, we_new, se_new = compressed_allreduce(m_local, we_, se_,
+                                                              comm_axis)
+                return m_comp, v_, we_new, se_new
+
+            # lax.cond so only ONE communication path executes per step —
+            # after freeze the dense allreduce must not run, or 1-bit's
+            # bandwidth saving is negated.
+            new_m, new_v, new_we, new_se = lax.cond(
+                frozen, frozen_branch, warm_branch, (grad, m, v, we, se))
+
+            p32 = p.astype(jnp.float32)
+            if self.eps_inside_sqrt:
+                denom = jnp.sqrt(new_v / bc2 + eps)
+            else:
+                denom = jnp.sqrt(new_v / bc2) + eps
+            step_val = (new_m / bc1) / denom
+            if wd:
+                step_val = step_val + wd * p32
+            return (p32 - lr * step_val).astype(p.dtype), new_m, new_v, new_we, new_se
+
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        gl = treedef.flatten_up_to(grads)
+        ml = treedef.flatten_up_to(state["exp_avg"])
+        vl = treedef.flatten_up_to(state["exp_avg_sq"])
+        wel = treedef.flatten_up_to(state["worker_error"])
+        sel = treedef.flatten_up_to(state["server_error"])
+        out = [upd(*t) for t in zip(p_leaves, gl, ml, vl, wel, sel)]
+        unflat = lambda i: jax.tree_util.tree_unflatten(treedef,
+                                                        [t[i] for t in out])
+        return unflat(0), {"step": step, "exp_avg": unflat(1),
+                           "exp_avg_sq": unflat(2), "worker_error": unflat(3),
+                           "server_error": unflat(4)}
+
+    def state_dict(self):
+        return {"param_groups": self.param_groups,
+                "freeze_step": self.freeze_step}
+
+    def load_state_dict(self, sd):
+        self.param_groups = sd["param_groups"]
+        self.freeze_step = sd.get("freeze_step", self.freeze_step)

@@ -1,0 +1,88 @@
+"""Elasticity solver tests (reference analogue: tests/unit/test_elastic.py)."""
+
+import pytest
+
+from deepspeed_tpu.elasticity import (
+    ElasticityConfigError,
+    ElasticityIncompatibleWorldSize,
+    compute_elastic_config,
+    get_compatible_gpus_v01,
+)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 10000,
+        "micro_batch_sizes": [8, 12, 16, 17],
+        "min_gpus": 32,
+        "max_gpus": 1500,
+        "min_time": 20,
+        "version": 0.1,
+    },
+}
+
+
+def test_basic_10k():
+    final, valid = compute_elastic_config(BASE)
+    assert final <= 10000
+    for g in valid:
+        assert 32 <= g <= 1500
+        # batch must decompose as micro * acc * g for some micro
+        assert any(final % (m * g) == 0 for m in BASE["elasticity"]["micro_batch_sizes"])
+
+
+def test_compatible_world_size():
+    final, valid = compute_elastic_config(BASE)
+    ws = valid[0]
+    f2, v2, micro = compute_elastic_config(BASE, world_size=ws)
+    assert f2 == final
+    assert micro in BASE["elasticity"]["micro_batch_sizes"]
+    assert final % (micro * ws) == 0
+
+
+def test_incompatible_world_size():
+    cfg = {"elasticity": dict(BASE["elasticity"], micro_batch_sizes=[8, 16],
+                              min_gpus=32)}
+    final, valid = compute_elastic_config(cfg)
+    bad = 31  # below min_gpus
+    assert bad not in valid
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=bad)
+
+
+def test_missing_section_and_bad_micro():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"train_batch_size": 4})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True,
+                                               "max_train_batch_size": 100,
+                                               "micro_batch_sizes": []}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": True,
+                                               "max_train_batch_size": 100,
+                                               "micro_batch_sizes": [0, 2]}})
+
+
+def test_future_version_rejected():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": dict(BASE["elasticity"],
+                                                   version=0.2)})
+
+
+def test_v01_prefers_larger():
+    final_l, _ = get_compatible_gpus_v01([2, 4], 1000, prefer_larger=True)
+    final_s, _ = get_compatible_gpus_v01([2, 4], 1000, prefer_larger=False)
+    assert final_l >= final_s
+
+
+def test_config_integration_batch_resolution():
+    # elastic config populates the batch triple; explicit batch keys rejected
+    c = DeepSpeedConfig({"elasticity": dict(BASE["elasticity"], min_gpus=1,
+                                            max_gpus=64)}, world_size=8)
+    assert c.train_batch_size == \
+        c.train_micro_batch_size_per_gpu * c.gradient_accumulation_steps * 8
+    with pytest.raises(ElasticityConfigError):
+        DeepSpeedConfig({"train_batch_size": 64,
+                         "elasticity": dict(BASE["elasticity"], min_gpus=1,
+                                            max_gpus=64)}, world_size=8)

@@ -1,0 +1,162 @@
+"""LR/BS schedule + loss scaler tests (reference analogues:
+tests/unit/test_lr_schedulers.py, test_dynamic_loss_scale.py)."""
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.adam import FusedAdam
+from deepspeed_tpu.runtime.bs_schedules import BatchSizeScheduler
+from deepspeed_tpu.runtime.fp16.loss_scaler import (
+    DynamicLossScaler,
+    LossScaler,
+    update_scale_jit,
+)
+from deepspeed_tpu.runtime.lr_schedules import (
+    LRRangeTest,
+    OneCycle,
+    WarmupDecayLR,
+    WarmupLR,
+    get_scheduler_class,
+)
+
+
+def make_opt(lr=0.01):
+    return FusedAdam(lr=lr)
+
+
+def test_warmup_lr_log_curve_and_plateau():
+    opt = make_opt()
+    s = WarmupLR(opt, warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=10)
+    lrs = []
+    for _ in range(15):
+        s.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    # monotonic rise then flat at max
+    assert all(b >= a - 1e-12 for a, b in zip(lrs, lrs[1:]))
+    assert lrs[-1] == pytest.approx(0.1)
+    assert lrs[0] == pytest.approx(0.1 * math.log(1) / math.log(10) + 0.0)
+
+
+def test_warmup_decay_reaches_zero():
+    opt = make_opt()
+    s = WarmupDecayLR(opt, total_num_steps=20, warmup_max_lr=0.1,
+                      warmup_num_steps=5)
+    for _ in range(21):  # lr reaches 0 when last_batch_iteration == total_num_steps
+        s.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_lr_range_test_continuous_and_staircase():
+    opt = make_opt()
+    s = LRRangeTest(opt, lr_range_test_min_lr=0.01, lr_range_test_step_size=5,
+                    lr_range_test_step_rate=1.0)
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01)
+    for _ in range(10):
+        s.step()
+    assert opt.param_groups[0]["lr"] == pytest.approx(0.01 * (1 + 10 / 5))
+
+    opt2 = make_opt()
+    s2 = LRRangeTest(opt2, lr_range_test_min_lr=0.01, lr_range_test_step_size=5,
+                     lr_range_test_staircase=True)
+    for _ in range(4):
+        s2.step()
+    assert opt2.param_groups[0]["lr"] == pytest.approx(0.01)  # floor(4/5)=0
+
+
+def test_one_cycle_peak_and_return():
+    opt = make_opt()
+    s = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                 cycle_first_step_size=10)
+    lrs = []
+    for _ in range(20):
+        s.step()
+        lrs.append(opt.param_groups[0]["lr"])
+    assert max(lrs) == pytest.approx(0.1, rel=1e-6)
+    assert lrs[-1] == pytest.approx(0.01, rel=1e-2)
+    # momentum cycles inversely
+    moms = opt.param_groups[0]["betas"]
+    assert 0.79 < moms[0] < 0.91
+
+
+def test_one_cycle_decay_phase():
+    opt = make_opt()
+    s = OneCycle(opt, cycle_min_lr=0.01, cycle_max_lr=0.1,
+                 cycle_first_step_size=5, decay_step_size=5,
+                 decay_lr_rate=1.0)
+    for _ in range(25):
+        s.step()
+    assert opt.param_groups[0]["lr"] < 0.01
+
+
+def test_scheduler_registry_and_state_dict():
+    assert get_scheduler_class("WarmupLR") is WarmupLR
+    with pytest.raises(ValueError):
+        get_scheduler_class("nope")
+    opt = make_opt()
+    s = WarmupLR(opt, warmup_num_steps=10)
+    s.step(5)
+    sd = s.state_dict()
+    s2 = WarmupLR(make_opt(), warmup_num_steps=10)
+    s2.load_state_dict(sd)
+    assert s2.last_batch_iteration == 5
+
+
+def test_bs_scheduler_ramp():
+    s = BatchSizeScheduler(final_batch_size=16, num_intervals=8,
+                           warmup_num_steps=100)
+    seen = []
+    for _ in range(101):
+        s.step()
+        seen.append(s.current_batch_size)
+    assert seen[0] < 16
+    assert seen[-1] == 16
+    assert sorted(set(seen)) == list(sorted(set(seen)))  # monotone stairs
+
+
+def test_dynamic_loss_scaler_host_semantics():
+    s = DynamicLossScaler(init_scale=2 ** 8, scale_window=4, min_scale=1.0)
+    assert s.loss_scale == 256
+    s.update_scale(True)  # overflow halves
+    assert s.loss_scale == 128
+    for _ in range(4):
+        s.update_scale(False)
+    assert s.loss_scale == 256  # window growth
+    # hysteresis: delayed_shift=2 absorbs first overflow
+    h = DynamicLossScaler(init_scale=16, delayed_shift=2)
+    h.update_scale(True)
+    assert h.loss_scale == 16
+    h.update_scale(True)
+    assert h.loss_scale == 8
+
+
+def test_dynamic_loss_scaler_min_scale_raises():
+    s = DynamicLossScaler(init_scale=2, scale_window=1000, min_scale=1.0)
+    s.update_scale(True)
+    with pytest.raises(RuntimeError):
+        s.update_scale(True)  # already at min
+
+
+def test_update_scale_jit_matches_host():
+    host = DynamicLossScaler(init_scale=2 ** 8, scale_window=3, min_scale=1.0,
+                             raise_error_at_min_scale=False)
+    state = host.jit_state()
+    overflows = [False, True, False, False, False, True, False, False, False,
+                 False, False]
+    for ov in overflows:
+        state = update_scale_jit(state, jnp.asarray(ov), scale_factor=2.0,
+                                 scale_window=3, min_scale=1.0)
+        host.update_scale(ov)
+        assert float(state["cur_scale"]) == pytest.approx(host.loss_scale), \
+            f"diverged at overflow={ov}"
+
+
+def test_static_scaler():
+    s = LossScaler(scale=128.0)
+    st = s.jit_state()
+    st = s.jit_update(st, jnp.asarray(True))
+    assert float(st["cur_scale"]) == 128.0
+    s.update_scale(True)
+    assert s.loss_scale == 128.0

@@ -1,0 +1,144 @@
+"""Optimizer tests vs numpy references
+(reference analogues: tests/unit/test_adamw.py, test_cpu_adam.py,
+test_onebit.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.ops.adam import FusedAdam
+from deepspeed_tpu.ops.lamb import FusedLamb
+from deepspeed_tpu.runtime.fp16.onebit import OnebitAdam
+
+
+def numpy_adamw(p, g, m, v, step, lr=1e-3, b1=0.9, b2=0.999, eps=1e-8, wd=0.01):
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    bc1 = 1 - b1 ** step
+    bc2 = 1 - b2 ** step
+    update = (m / bc1) / (np.sqrt(v / bc2) + eps)
+    return p - lr * update - lr * wd * p, m, v
+
+
+def test_fused_adam_matches_numpy_adamw():
+    rng = np.random.RandomState(0)
+    p = rng.randn(4, 8).astype(np.float32)
+    params = {"w": jnp.asarray(p)}
+    opt = FusedAdam(lr=1e-3, weight_decay=0.01, adam_w_mode=True)
+    state = opt.init(params)
+
+    np_p, np_m, np_v = p.copy(), np.zeros_like(p), np.zeros_like(p)
+    for step in range(1, 4):
+        g = rng.randn(4, 8).astype(np.float32)
+        params, state = jax.jit(opt.update)({"w": jnp.asarray(g)}, state, params)
+        np_p, np_m, np_v = numpy_adamw(np_p, g, np_m, np_v, step)
+    np.testing.assert_allclose(np.asarray(params["w"]), np_p, rtol=1e-5,
+                               atol=1e-6)
+    assert int(state["step"]) == 3
+
+
+def test_fused_adam_l2_mode_differs():
+    params = {"w": jnp.ones((4,))}
+    g = {"w": jnp.ones((4,))}
+    adamw = FusedAdam(lr=1e-2, weight_decay=0.1, adam_w_mode=True)
+    adaml2 = FusedAdam(lr=1e-2, weight_decay=0.1, adam_w_mode=False)
+    p1, _ = adamw.update(g, adamw.init(params), params)
+    p2, _ = adaml2.update(g, adaml2.init(params), params)
+    assert not np.allclose(np.asarray(p1["w"]), np.asarray(p2["w"]))
+
+
+def test_fused_adam_traced_lr_no_recompile():
+    params = {"w": jnp.ones((4,))}
+    opt = FusedAdam()
+    state = opt.init(params)
+    jitted = jax.jit(opt.update)
+    g = {"w": jnp.ones((4,))}
+    p1, state = jitted(g, state, params, lr=jnp.float32(1e-3))
+    p2, state = jitted(g, state, p1, lr=jnp.float32(1e-4))  # no retrace
+    assert jitted._cache_size() == 1
+
+
+def test_fused_lamb_trust_ratio_bounds():
+    params = {"w": jnp.full((8,), 1e-8)}  # tiny params -> trust clamped low
+    g = {"w": jnp.ones((8,))}
+    opt = FusedLamb(lr=1.0, min_coeff=0.01, max_coeff=10.0)
+    new_p, _ = opt.update(g, opt.init(params), params)
+    delta = np.abs(np.asarray(new_p["w"]) - 1e-8)
+    # lr * trust * unit-ish adam step; trust must respect bounds
+    assert delta.max() <= 10.0 + 1e-5
+    # big params, tiny grads -> trust clamped at max_coeff
+    params2 = {"w": jnp.full((8,), 100.0)}
+    g2 = {"w": jnp.full((8,), 1e-10)}
+    new_p2, _ = opt.update(g2, opt.init(params2), params2)
+    assert np.isfinite(np.asarray(new_p2["w"])).all()
+
+
+def test_lamb_matches_adam_when_trust_is_one():
+    # symmetric setup where ||p||/||update|| is within [min,max] -> pure scale
+    rng = np.random.RandomState(1)
+    p = rng.randn(16).astype(np.float32)
+    g = rng.randn(16).astype(np.float32)
+    opt = FusedLamb(lr=0.0, weight_decay=0.0)
+    new_p, st = opt.update({"w": jnp.asarray(g)}, opt.init({"w": jnp.asarray(p)}),
+                           {"w": jnp.asarray(p)})
+    np.testing.assert_allclose(np.asarray(new_p["w"]), p)  # lr=0 is identity
+    assert int(st["step"]) == 1
+
+
+def test_onebit_adam_warmup_matches_fused_adam():
+    rng = np.random.RandomState(2)
+    p = rng.randn(8).astype(np.float32)
+    g = rng.randn(8).astype(np.float32)
+    params = {"w": jnp.asarray(p)}
+    grads = {"w": jnp.asarray(g)}
+    ob = OnebitAdam(lr=1e-3, freeze_step=100, weight_decay=0.0)
+    fa = FusedAdam(lr=1e-3, weight_decay=0.0)
+    p1, _ = ob.update(grads, ob.init(params), params)
+    p2, _ = fa.update(grads, fa.init(params), params)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-6)
+
+
+def test_onebit_adam_frozen_compression_error_feedback():
+    # after freeze_step, updates use sign-compressed momentum and the
+    # compression error is carried in state
+    params = {"w": jnp.asarray(np.linspace(-1, 1, 8), dtype=jnp.float32)}
+    grads = {"w": jnp.asarray(np.linspace(1, -1, 8), dtype=jnp.float32)}
+    ob = OnebitAdam(lr=1e-3, freeze_step=1)
+    state = ob.init(params)
+    params, state = ob.update(grads, state, params)   # step 1: warmup
+    assert np.allclose(np.asarray(state["worker_error"]["w"]), 0)
+    params, state = ob.update(grads, state, params)   # step 2: frozen
+    assert not np.allclose(np.asarray(state["worker_error"]["w"]), 0)
+    # v frozen at step-1 value
+    params3, state3 = ob.update(grads, state, params)
+    np.testing.assert_allclose(np.asarray(state3["exp_avg_sq"]["w"]),
+                               np.asarray(state["exp_avg_sq"]["w"]))
+
+
+def test_onebit_adam_distributed_compressed_allreduce():
+    """Compressed allreduce across a data axis approximates dense averaging."""
+    info = comm.make_mesh(data=8)
+    rng = np.random.RandomState(3)
+    local_grads = rng.randn(8, 16).astype(np.float32)  # one row per shard
+
+    ob = OnebitAdam(lr=1e-2, freeze_step=0)
+    params = {"w": jnp.zeros((16,))}
+    state = ob.init(params)
+
+    def shard_update(g_row):
+        new_p, st = ob.update({"w": g_row[0]}, state, params, comm_axis="data")
+        return new_p["w"]
+
+    f = jax.shard_map(shard_update, mesh=info.mesh, in_specs=P("data", None),
+                      out_specs=P(), check_vma=False)
+    out = np.asarray(f(jnp.asarray(local_grads)))
+    # every shard must agree (it's an allreduce) and point roughly along the
+    # dense-averaged gradient direction
+    dense = local_grads.mean(axis=0)
+    assert np.isfinite(out).all()
+    cos = np.dot(-out, dense) / (np.linalg.norm(out) * np.linalg.norm(dense))
+    assert cos > 0.5

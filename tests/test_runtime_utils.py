@@ -1,0 +1,90 @@
+"""Runtime-utils tests (reference analogue: tests/unit/test_runtime_utils.py,
+test_partition.py partition solvers)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.runtime.utils import (
+    clip_grad_norm,
+    get_global_norm,
+    global_grad_norm_sq,
+    has_overflow,
+    partition_balanced,
+    partition_uniform,
+    prefix_sum_inc,
+)
+
+
+def test_partition_uniform():
+    assert partition_uniform(10, 2) == [0, 5, 10]
+    assert partition_uniform(11, 2) == [0, 6, 11]
+    assert partition_uniform(3, 5) == [0, 1, 2, 3, 3, 3]
+    parts = partition_uniform(24, 4)
+    assert parts[0] == 0 and parts[-1] == 24
+    assert all(b >= a for a, b in zip(parts, parts[1:]))
+
+
+def test_partition_balanced_uniform_weights():
+    parts = partition_balanced([1.0] * 12, 4)
+    assert parts == [0, 3, 6, 9, 12]
+
+
+def test_partition_balanced_skewed():
+    w = [10.0, 1.0, 1.0, 1.0, 1.0, 10.0]
+    parts = partition_balanced(w, 2)
+    assert parts[0] == 0 and parts[-1] == 6
+    loads = [sum(w[parts[i]:parts[i + 1]]) for i in range(2)]
+    assert max(loads) <= 14.0  # balanced better than naive [0,3,6] -> 12 vs 12
+
+
+def test_partition_balanced_single_heavy():
+    w = [100.0, 1.0, 1.0]
+    parts = partition_balanced(w, 3)
+    assert parts[1] == 1  # heavy item isolated
+
+
+def test_prefix_sum():
+    assert prefix_sum_inc([1, 2, 3]) == [1, 3, 6]
+
+
+def test_has_overflow_local():
+    good = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    bad = {"a": jnp.array([1.0, jnp.inf]), "b": jnp.zeros((2, 2))}
+    nan = {"a": jnp.array([1.0, jnp.nan]), "b": jnp.zeros((2, 2))}
+    assert not bool(has_overflow(good))
+    assert bool(has_overflow(bad))
+    assert bool(has_overflow(nan))
+
+
+def test_has_overflow_cross_shard():
+    info = comm.make_mesh(data=8)
+    x = np.ones((8, 4), np.float32)
+    x[3, 2] = np.inf  # only shard 3 overflows
+
+    def f(xs):
+        return has_overflow({"g": xs}, axes=["data"])
+
+    out = jax.shard_map(f, mesh=info.mesh, in_specs=P("data", None),
+                        out_specs=P(), check_vma=False)(jnp.asarray(x))
+    assert bool(out)  # all shards see the overflow
+
+
+def test_clip_grad_norm():
+    g = {"a": jnp.full((4,), 3.0), "b": jnp.full((4,), 4.0)}
+    norm_sq = float(global_grad_norm_sq(g))
+    assert norm_sq == pytest.approx(4 * 9 + 4 * 16)
+    clipped, norm = clip_grad_norm(g, max_norm=1.0)
+    assert float(norm) == pytest.approx(norm_sq ** 0.5)
+    new_norm = float(global_grad_norm_sq(clipped)) ** 0.5
+    assert new_norm == pytest.approx(1.0, rel=1e-4)
+    # under the limit -> unchanged
+    same, _ = clip_grad_norm(g, max_norm=100.0)
+    np.testing.assert_allclose(np.asarray(same["a"]), 3.0, rtol=1e-6)
+
+
+def test_get_global_norm():
+    assert get_global_norm([3.0, 4.0]) == pytest.approx(5.0)

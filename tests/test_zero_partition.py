@@ -1,0 +1,103 @@
+"""ZeRO sharding-plan tests (reference analogues: tests/unit/test_zero.py,
+test_partition.py — here the mechanism is shardings, so we assert on specs
+and on executed memory layout)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu.comm as comm
+from deepspeed_tpu.ops.adam import FusedAdam
+from deepspeed_tpu.runtime.zero.partition import ZeroShardingPlan, add_data_axis
+
+
+def make_params():
+    return {
+        "dense": jnp.zeros((64, 32)),
+        "bias": jnp.zeros((32,)),
+        "tiny": jnp.zeros((3,)),           # too small to shard
+        "odd": jnp.zeros((7, 5)),          # nothing divides dp=8
+    }
+
+
+def test_add_data_axis_picks_largest_free_dim():
+    assert add_data_axis(None, (64, 32), 8, 1) == P("data", None)
+    assert add_data_axis(P(None, "model"), (64, 32), 8, 1) == P("data", "model")
+    # dim already used by model axis -> fall to other dim
+    assert add_data_axis(P("model", None), (64, 32), 8, 1) == P("model", "data")
+    # nothing divisible -> unchanged
+    assert add_data_axis(None, (7, 5), 8, 1) == P(None, None)
+    # below min size -> replicated
+    assert add_data_axis(None, (64,), 8, min_size_to_shard=1024) == P(None)
+
+
+def test_stage0_everything_replicated():
+    info = comm.make_mesh(data=8)
+    plan = ZeroShardingPlan(0, info, make_params())
+    for spec in jax.tree_util.tree_leaves(
+            plan.opt_spec, is_leaf=lambda x: isinstance(x, P)):
+        assert "data" not in tuple(spec)
+
+
+def test_stage1_opt_sharded_params_replicated():
+    info = comm.make_mesh(data=8)
+    plan = ZeroShardingPlan(1, info, make_params(), min_size_to_shard=1)
+    assert plan.opt_spec["dense"] == P("data", None)
+    assert plan.param_spec["dense"] == P()
+    assert plan.grad_spec["dense"] == P()
+    # non-divisible stays replicated even in opt state
+    assert plan.opt_spec["odd"] == P(None, None)
+
+
+def test_stage2_grads_sharded():
+    info = comm.make_mesh(data=8)
+    plan = ZeroShardingPlan(2, info, make_params(), min_size_to_shard=1)
+    assert plan.grad_spec["dense"] == P("data", None)
+    assert plan.param_spec["dense"] == P()
+
+
+def test_stage3_params_sharded():
+    info = comm.make_mesh(data=8)
+    plan = ZeroShardingPlan(3, info, make_params(), min_size_to_shard=1)
+    assert plan.param_spec["dense"] == P("data", None)
+
+
+def test_stage_respects_tp_spec():
+    info = comm.make_mesh(data=4, model=2)
+    params = {"w": jnp.zeros((64, 32))}
+    specs = {"w": P(None, "model")}
+    plan = ZeroShardingPlan(3, info, params, param_specs=specs,
+                            min_size_to_shard=1)
+    assert plan.param_spec["w"] == P("data", "model")
+
+
+def test_executed_opt_state_memory_is_sharded():
+    """End-to-end: jitted adam step with stage-1 shardings actually stores
+    1/dp of the moments per device."""
+    info = comm.make_mesh(data=8)
+    params = {"w": jnp.zeros((64, 64), jnp.float32)}
+    plan = ZeroShardingPlan(1, info, params, min_size_to_shard=1)
+    opt = FusedAdam(lr=1e-3)
+    state = opt.init(params)
+    shardings = plan.opt_state_shardings(state)
+    state = jax.device_put(state, shardings)
+    shard = state["exp_avg"]["w"].addressable_shards[0]
+    assert shard.data.shape == (8, 64)  # 64/8 rows per device
+
+    @jax.jit
+    def step(g, st, p):
+        new_p, new_st = opt.update(g, st, p)
+        return new_p, plan.constrain_opt_state(new_st)
+
+    g = {"w": jnp.ones((64, 64))}
+    new_p, new_st = step(g, state, params)
+    assert new_st["exp_avg"]["w"].addressable_shards[0].data.shape == (8, 64)
+    np.testing.assert_allclose(np.asarray(new_st["exp_avg"]["w"]),
+                               np.full((64, 64), 0.1), rtol=1e-6)
+
+
+def test_describe():
+    info = comm.make_mesh(data=8)
+    plan = ZeroShardingPlan(2, info, make_params(), min_size_to_shard=1)
+    assert "ZeRO stage 2" in plan.describe()
