@@ -1,0 +1,124 @@
+"""Data loading: DeepSpeedDataLoader + RepeatingLoader.
+
+Reference: deepspeed/runtime/dataloader.py:10,33 (torch DataLoader +
+DistributedSampler). TPU-native redesign: single-controller JAX wants the
+GLOBAL batch assembled on host and sharded over the mesh's data axis by the
+engine, so the loader yields global numpy batches; in multi-process mode
+each process reads its own slice (process_index-strided sampling), matching
+DistributedSampler semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+import jax
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (reference :10-31).
+    Advances the wrapped loader's epoch on each wrap so shuffling loaders
+    re-shuffle instead of replaying one permutation."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+        self._epoch = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self._epoch += 1
+            if hasattr(self.loader, "set_epoch"):
+                self.loader.set_epoch(self._epoch)
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def _default_collate(items):
+    """Stack a list of samples into a batch pytree of numpy arrays."""
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: _default_collate([it[k] for it in items]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(_default_collate([it[i] for it in items])
+                           for i in range(len(first)))
+    return np.stack([np.asarray(it) for it in items])
+
+
+class DeepSpeedDataLoader:
+    """Batched, optionally shuffled, process-sharded loader
+    (reference :33-101).
+
+    dataset: any indexable (len + __getitem__) of samples (arrays, tuples,
+    dicts). Yields GLOBAL per-process batches as numpy pytrees; the engine
+    shards dim 0 over the data mesh axis.
+    """
+
+    def __init__(self, dataset, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 local_rank: int = -1, shuffle: bool = False, seed: int = 0,
+                 drop_last: bool = True, data_parallel_world_size: Optional[int] = None,
+                 data_parallel_rank: Optional[int] = None):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.collate_fn = collate_fn or _default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        # single-controller: every process loads its slice of the global batch
+        self.num_shards = (data_parallel_world_size
+                           if data_parallel_world_size is not None
+                           else jax.process_count())
+        self.shard_id = (data_parallel_rank if data_parallel_rank is not None
+                         else jax.process_index())
+        self.epoch = 0
+        if self.batch_size % max(1, self.num_shards) == 0:
+            self._per_shard = self.batch_size // max(1, self.num_shards)
+        else:
+            raise ValueError(
+                f"batch_size {batch_size} not divisible by data shards "
+                f"{self.num_shards}")
+        # every shard sees the SAME number of samples (wraparound padding,
+        # DistributedSampler-style) — unequal counts would desync lockstep
+        # SPMD processes and hang collectives
+        import math
+
+        self._samples_per_shard = math.ceil(len(dataset) /
+                                            max(1, self.num_shards))
+        self.len = self._samples_per_shard // self._per_shard
+        if not self.drop_last and self._samples_per_shard % self._per_shard:
+            self.len += 1
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def __iter__(self):
+        n = len(self.dataset)
+        order = np.arange(n)
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(order)
+        # DistributedSampler semantics: pad to equal length by wrapping, then
+        # rank-strided slice — all shards yield the same batch count
+        total = self._samples_per_shard * self.num_shards
+        if total > n:
+            order = np.concatenate([order, order[:total - n]])
+        shard_idx = order[self.shard_id::self.num_shards]
+        for i in range(0, len(shard_idx) - self._per_shard + 1, self._per_shard):
+            batch_ids = shard_idx[i:i + self._per_shard]
+            yield self.collate_fn([self.dataset[int(j)] for j in batch_ids])
+        if not self.drop_last:
+            tail = len(shard_idx) % self._per_shard
+            if tail:
+                batch_ids = shard_idx[len(shard_idx) - tail:]
+                yield self.collate_fn([self.dataset[int(j)] for j in batch_ids])

@@ -1,0 +1,564 @@
+"""DeepSpeedEngine — the core training engine.
+
+Reference: deepspeed/runtime/engine.py:102 (DeepSpeedEngine(Module) with
+forward :959 / backward :1040 / step :1201, optimizer selection :647,
+checkpoint I/O :1491-1890). The public API is kept — forward/backward/step,
+gradient-accumulation boundaries, loss scaling, save/load_checkpoint — but
+the execution model is TPU-native:
+
+* One jitted `_micro_step` computes loss+grads for a micro batch and folds
+  them into a (possibly ZeRO-sharded) fp32 accumulator. Data parallelism is
+  implicit: the batch is sharded over the `data` mesh axis and the loss is a
+  global mean, so XLA inserts the gradient psum (no bucketed allreduce —
+  contrast reference engine.py:1323-1396).
+* One jitted `_apply_step` unscales, checks overflow, clips, runs the fused
+  optimizer, applies ZeRO sharding constraints, and updates the loss-scale
+  state — the skip-on-overflow decision is a branchless select inside the
+  same program (contrast reference fp16/loss_scaler + stage2.step).
+* ZeRO stages are sharding plans (runtime/zero/partition.py), not optimizer
+  wrappers: stage 1 shards optimizer state, stage 2 shards the gradient
+  accumulator (psum becomes reduce-scatter), stage 3 shards parameters.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .. import comm
+from ..comm.mesh import DATA_AXIS, MeshInfo
+from ..ops.adam import DeepSpeedCPUAdam, FusedAdam
+from ..ops.lamb import FusedLamb
+from ..utils.logging import log_dist, logger
+from . import checkpointing as ckpt_io
+from . import constants as const
+from .config import DeepSpeedConfig
+from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .fp16.loss_scaler import create_loss_scaler
+from .fp16.onebit import OnebitAdam
+from .lr_schedules import SCHEDULERS
+from .module import TrainModule
+from .progressive_layer_drop import ProgressiveLayerDrop
+from .utils import ThroughputTimer, clip_grad_norm, has_overflow
+from .zero.partition import ZeroShardingPlan
+
+DTYPES = {"float32": jnp.float32, "float16": jnp.float16,
+          "bfloat16": jnp.bfloat16}
+
+
+class DeepSpeedEngine:
+    def __init__(self, args=None, model: Optional[TrainModule] = None,
+                 optimizer=None, model_parameters=None, training_data=None,
+                 lr_scheduler=None, mpu=None, dist_init_required=None,
+                 collate_fn=None, config_params=None, dont_change_device=False):
+        if model is None:
+            raise ValueError("deepspeed_tpu.initialize requires a model")
+        self.module = model
+        self.client_optimizer = optimizer
+        self.client_lr_scheduler = lr_scheduler
+        self.mpu = mpu
+        self.collate_fn = collate_fn
+        self.global_steps = 0
+        self.global_samples = 0
+        self.micro_steps = 0
+        self.skipped_steps = 0
+        self.loaded_checkpoint_tag = None
+
+        if dist_init_required is None or dist_init_required:
+            comm.init_distributed()
+
+        config = config_params
+        if config is None and args is not None:
+            config = getattr(args, "deepspeed_config", None)
+        if config is None:
+            raise ValueError(
+                "DeepSpeed requires --deepspeed_config or a config dict")
+
+        # mesh first (config's dp world size derives from it)
+        self.mesh_info = self._build_mesh(config, mpu)
+        self._config = DeepSpeedConfig(
+            config, world_size=self.mesh_info.get_data_parallel_world_size())
+        self.dp_world_size = self.mesh_info.get_data_parallel_world_size()
+        self.mp_world_size = self.mesh_info.get_model_parallel_world_size()
+
+        self.compute_dtype = DTYPES[self._config.precision]
+        self.loss_scaler = create_loss_scaler(self._config)
+
+        # parameters: user-supplied pytree wins, else model.init
+        key = jax.random.PRNGKey(int(os.environ.get("DSTPU_SEED", 42)))
+        self._rng_key, init_key = jax.random.split(key)
+        if model_parameters is not None:
+            params = model_parameters
+        else:
+            params = model.init(init_key)
+        params = jax.tree_util.tree_map(
+            lambda p: jnp.asarray(p, dtype=jnp.float32), params)  # fp32 master
+
+        # ZeRO sharding plan + placement
+        self.zero_plan = ZeroShardingPlan(
+            self._config.zero_optimization_stage, self.mesh_info, params,
+            param_specs=getattr(model, "param_specs", None))
+        self._params = jax.device_put(params, self.zero_plan.param_shardings())
+        log_dist(self.zero_plan.describe(), ranks=[0])
+
+        # optimizer
+        self.optimizer = self._configure_optimizer()
+        opt_state = self.optimizer.init(self._params)
+        self._opt_state = jax.device_put(
+            opt_state, self.zero_plan.opt_state_shardings(opt_state))
+        self._scaler_state = self.loss_scaler.jit_state()
+        self._grad_acc = None  # lazily built zeros, sharded per grad_spec
+        self._cached = None    # (loss, grads) from forward awaiting backward
+
+        # lr scheduler
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+
+        # progressive layer drop
+        self.progressive_layer_drop = None
+        if self._config.pld_enabled:
+            self.progressive_layer_drop = ProgressiveLayerDrop(
+                theta=self._config.pld_params[const.PLD_THETA],
+                gamma=self._config.pld_params[const.PLD_GAMMA])
+
+        # data
+        self.training_dataloader = (self.deepspeed_io(training_data)
+                                    if training_data is not None else None)
+
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self.steps_per_print() or 50)
+        self._step_fns = self._build_step_fns()
+        self._last_lr = self._current_lr()
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+
+    def _build_mesh(self, config, mpu) -> MeshInfo:
+        mesh_dict = {}
+        if isinstance(config, dict):
+            mesh_dict = dict(config.get(const.MESH) or {})
+        if mpu is not None and not mesh_dict:
+            mesh_dict = {"model": mpu.get_model_parallel_world_size()}
+        return comm.make_mesh(
+            data=mesh_dict.get("data", -1),
+            model=mesh_dict.get("model", 1),
+            pipe=mesh_dict.get("pipe", 1),
+            seq=mesh_dict.get("seq", 1))
+
+    def _configure_optimizer(self):
+        """reference engine.py:647-757 optimizer selection."""
+        if self.client_optimizer is not None:
+            log_dist("using client optimizer", ranks=[0])
+            return self.client_optimizer
+        name = self._config.optimizer_name
+        params = dict(self._config.optimizer_params or {})
+        if name is None:
+            log_dist("no optimizer configured; defaulting to FusedAdam",
+                     ranks=[0])
+            return FusedAdam()
+        if name in (const.ADAM_OPTIMIZER, "adamw"):
+            # both "Adam" and "AdamW" default to decoupled decay, matching
+            # reference FusedAdam(adam_w_mode=True); "adam_w_mode": false in
+            # params selects classic L2
+            adam_w = params.pop(const.ADAM_W_MODE, True)
+            if self._config.zero_config.cpu_offload:
+                return DeepSpeedCPUAdam(adam_w_mode=adam_w, **params)
+            return FusedAdam(adam_w_mode=adam_w, **params)
+        if name == const.LAMB_OPTIMIZER:
+            return FusedLamb(**params)
+        if name == const.ONEBIT_ADAM_OPTIMIZER:
+            return OnebitAdam(**params)
+        raise ValueError(f"unknown optimizer {name!r}; supported: "
+                         f"{const.DEEPSPEED_OPTIMIZERS}")
+
+    def _configure_lr_scheduler(self, client_scheduler):
+        if client_scheduler is not None:
+            return client_scheduler
+        name = self._config.scheduler_name
+        if name is None:
+            return None
+        if name not in SCHEDULERS:
+            raise ValueError(f"unknown scheduler {name!r}")
+        sched = SCHEDULERS[name](self.optimizer,
+                                 **(self._config.scheduler_params or {}))
+        log_dist(f"using scheduler {name}", ranks=[0])
+        return sched
+
+    # ------------------------------------------------------------------
+    # jitted step programs
+    # ------------------------------------------------------------------
+
+    def _build_step_fns(self):
+        model = self.module
+        compute_dtype = self.compute_dtype
+        plan = self.zero_plan
+        opt = self.optimizer
+        gas = self.gradient_accumulation_steps()
+        clip = float(self._config.gradient_clipping or 0.0)
+        prescale = self._config.prescale_gradients
+        predivide = float(self._config.gradient_predivide_factor or 1.0)
+        scaler = self.loss_scaler
+        pld_enabled = self.progressive_layer_drop is not None
+
+        def cast(tree, dtype):
+            return jax.tree_util.tree_map(
+                lambda x: x.astype(dtype) if jnp.issubdtype(
+                    x.dtype, jnp.floating) else x, tree)
+
+        def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
+            cparams = cast(params, compute_dtype)
+
+            def scaled_loss_fn(p):
+                kwargs = {}
+                if pld_enabled:
+                    kwargs = {"progressive_layer_drop": True,
+                              "pld_theta": pld_theta}
+                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                scale_factor = loss_scale / (predivide if prescale else 1.0)
+                return loss.astype(jnp.float32) * scale_factor, loss
+
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(cparams)
+            grads = cast(grads, jnp.float32)
+            new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
+            new_acc = plan.constrain_grads(new_acc)
+            return loss, new_acc
+
+        def apply_step(params, opt_state, scaler_state, acc, lr):
+            loss_scale = scaler_state["cur_scale"]
+            overflow = has_overflow(acc)
+            denom = loss_scale * gas
+            if prescale:
+                denom = denom / predivide
+            grads = jax.tree_util.tree_map(lambda g: g / denom, acc)
+            grad_norm = jnp.asarray(0.0, jnp.float32)
+            if clip > 0.0:
+                grads, grad_norm = clip_grad_norm(grads, clip)
+            # NOTE: with the jit+sharded-batch model, DP grad averaging
+            # already happened (XLA psum at the loss-mean boundary), so
+            # OnebitAdam runs with comm_axis=None here; its shard_map
+            # integration (true compressed comm) is exercised separately.
+            new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
+
+            # branchless skip-step on overflow (reference: step skipped,
+            # scale halved — fp16/loss_scaler + stage2.py:1385-1404)
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, opt_state)
+
+            new_params = plan.constrain_params(new_params)
+            new_opt = plan.constrain_opt_state(new_opt)
+            new_scaler = scaler.jit_update(scaler_state, overflow)
+            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_params, new_opt, new_scaler, zero_acc, overflow, grad_norm
+
+        donate_micro = jax.jit(micro_step, donate_argnums=(1,))
+        # lr=None (optimizer-default) is a static arg value: jit treats None
+        # as an empty pytree, giving that case its own (single) trace
+        donate_apply = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
+        return {"micro": donate_micro, "apply": donate_apply}
+
+    def _zero_grad_acc(self):
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), self._params)
+        return jax.device_put(zeros, self.zero_plan.grad_shardings())
+
+    def _shard_batch(self, batch):
+        """Place the global batch sharded over the data axis (dim 0)."""
+        mesh = self.mesh_info.mesh
+
+        def put(x):
+            x = jnp.asarray(x)
+            spec = [None] * x.ndim
+            if x.ndim and x.shape[0] % max(1, self.dp_world_size) == 0:
+                spec[0] = DATA_AXIS
+            elif x.ndim:
+                # replicating costs dp x memory/compute — tell the user once
+                if not getattr(self, "_warned_replicated_batch", False):
+                    self._warned_replicated_batch = True
+                    logger.warning(
+                        f"batch dim 0 ({x.shape[0]}) not divisible by data "
+                        f"shards ({self.dp_world_size}); replicating batch "
+                        f"over the data axis")
+            return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+
+        return jax.tree_util.tree_map(put, batch)
+
+    def _next_rng(self):
+        self._rng_key, sub = jax.random.split(self._rng_key)
+        return sub
+
+    def _current_lr(self):
+        """Current lr from param_groups, or None for optimizers without the
+        torch-style attribute (their update() then uses its own default —
+        never silently train at lr=0)."""
+        groups = getattr(self.optimizer, "param_groups", None)
+        if groups and "lr" in groups[0]:
+            return float(groups[0]["lr"])
+        return None
+
+    # ------------------------------------------------------------------
+    # public training API (reference engine.py:959,1040,1201)
+    # ------------------------------------------------------------------
+
+    def forward(self, batch, rng=None):
+        """Compute loss AND gradients for a micro batch (fused fwd+bwd —
+        separate passes would recompute the forward under autodiff).
+        Returns the (unscaled) loss; gradients are cached for backward()."""
+        if self._grad_acc is None:
+            self._grad_acc = self._zero_grad_acc()
+        if self.is_gradient_accumulation_boundary():
+            self.tput_timer.start()  # times one full global batch
+        batch = self._shard_batch(batch)
+        rng = rng if rng is not None else self._next_rng()
+        theta = jnp.asarray(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop else 1.0, jnp.float32)
+        loss, self._grad_acc = self._step_fns["micro"](
+            self._params, self._grad_acc, batch, rng,
+            self._scaler_state["cur_scale"], theta)
+        self._cached = loss
+        return loss
+
+    def backward(self, loss=None, allreduce_gradients=True):
+        """Gradients were produced in forward(); this advances the
+        micro-step bookkeeping (API parity with reference backward :1040)."""
+        if self._cached is None:
+            raise RuntimeError("backward() called before forward()")
+        self.micro_steps += 1
+        self.global_samples += self.train_micro_batch_size_per_gpu() * \
+            self.dp_world_size
+        self._cached = None
+        return loss
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        return (self.micro_steps % self.gradient_accumulation_steps()) == 0
+
+    def step(self):
+        """Weight update at accumulation boundaries (reference :1201)."""
+        if self.micro_steps == 0 or not self.is_gradient_accumulation_boundary():
+            return
+        cur_lr = self._current_lr()
+        lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        (self._params, self._opt_state, self._scaler_state, self._grad_acc,
+         overflow, grad_norm) = self._step_fns["apply"](
+            self._params, self._opt_state, self._scaler_state,
+            self._grad_acc, lr)
+        self.global_steps += 1
+        if bool(overflow):
+            self.skipped_steps += 1
+            log_dist(f"overflow: skipping step, new loss scale "
+                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
+        else:
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        self.tput_timer.stop(report_speed=False)
+        if self.steps_per_print() and \
+                self.global_steps % self.steps_per_print() == 0:
+            cur = self._current_lr()
+            lr_str = f"{cur:.3e}" if cur is not None else "optimizer-default"
+            log_dist(
+                f"step={self.global_steps}, lr={lr_str}, "
+                f"loss_scale={float(self._scaler_state['cur_scale'])}, "
+                f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
+                ranks=[0])
+
+    def train_batch(self, data_iter=None):
+        """Convenience: run a full global batch (gas micro steps + update).
+        Returns the mean loss (reference PipelineEngine.train_batch parity
+        at the engine level)."""
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("train_batch needs data_iter or training_data")
+            data_iter = self._train_iter if hasattr(self, "_train_iter") else \
+                iter(RepeatingLoader(self.training_dataloader))
+            self._train_iter = data_iter
+        losses = []
+        for _ in range(self.gradient_accumulation_steps()):
+            batch = next(data_iter)
+            losses.append(self.forward(batch))
+            self.backward()
+        self.step()
+        return jnp.mean(jnp.stack(losses))
+
+    def eval_batch(self, batch, rng=None):
+        """Loss without gradient/bookkeeping side effects (jitted + cached)."""
+        if not hasattr(self, "_eval_fn"):
+            model = self.module
+            dtype = self.compute_dtype
+
+            def eval_fn(params, batch, rng):
+                cparams = jax.tree_util.tree_map(
+                    lambda x: x.astype(dtype)
+                    if jnp.issubdtype(x.dtype, jnp.floating) else x, params)
+                out = model.loss(cparams, batch, rng=rng, train=False)
+                return out[0] if isinstance(out, tuple) else out
+
+            self._eval_fn = jax.jit(eval_fn)
+        batch = self._shard_batch(batch)
+        rng = rng if rng is not None else self._next_rng()
+        return self._eval_fn(self._params, batch, rng)
+
+    # ------------------------------------------------------------------
+    # accessors (reference engine.py:300-536)
+    # ------------------------------------------------------------------
+
+    @property
+    def params(self):
+        return self._params
+
+    def train_batch_size(self):
+        return self._config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self):
+        return self._config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self):
+        return self._config.gradient_accumulation_steps
+
+    def steps_per_print(self):
+        return self._config.steps_per_print
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def fp16_enabled(self):
+        return self._config.fp16_enabled
+
+    def precision(self):
+        return self._config.precision
+
+    @property
+    def loss_scale(self):
+        return float(self._scaler_state["cur_scale"])
+
+    def get_lr(self):
+        return [g["lr"] for g in getattr(self.optimizer, "param_groups",
+                                         [{"lr": 0.0}])]
+
+    def deepspeed_io(self, dataset, batch_size=None, route=None,
+                     data_sampler=None, collate_fn=None, num_local_io_workers=None):
+        """reference engine.py:882 — build the distributed dataloader.
+
+        Single-controller JAX consumes the GLOBAL micro batch
+        (micro_per_gpu * dp_world) per forward; the loader internally
+        strides it across processes in multi-host mode."""
+        global_micro = (batch_size if batch_size is not None else
+                        self.train_micro_batch_size_per_gpu() *
+                        self.dp_world_size)
+        return DeepSpeedDataLoader(
+            dataset, batch_size=global_micro, shuffle=True,
+            collate_fn=collate_fn or self.collate_fn)
+
+    # ------------------------------------------------------------------
+    # checkpointing (reference engine.py:1491-1890)
+    # ------------------------------------------------------------------
+
+    def _client_state(self, client_state: Dict[str, Any]):
+        state = dict(client_state or {})
+        state.update({
+            "global_steps": self.global_steps,
+            "global_samples": self.global_samples,
+            "skipped_steps": self.skipped_steps,
+            "micro_steps": self.micro_steps,
+            "dp_world_size": self.dp_world_size,
+            "mp_world_size": self.mp_world_size,
+        })
+        return state
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        self._checkpoint_tag_validation(tag)
+        model_state = {
+            "module": jax.tree_util.tree_map(np.asarray, self._params),
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None else None),
+            "loss_scaler": {
+                k: np.asarray(v) for k, v in self._scaler_state.items()},
+            "rng_key": np.asarray(self._rng_key),
+            **self._client_state(client_state),
+        }
+        optim_state = {
+            "optimizer_state": jax.tree_util.tree_map(np.asarray,
+                                                      self._opt_state),
+            # json round-trip: msgpack rejects tuples (betas); lists restore fine
+            "optimizer_hparams": (json.loads(json.dumps(
+                self.optimizer.state_dict()))
+                if hasattr(self.optimizer, "state_dict") else None),
+            "zero_stage": self.zero_optimization_stage(),
+        }
+        ckpt_io.save_checkpoint_state(save_dir, tag, model_state, optim_state,
+                                      save_latest=save_latest)
+        return True
+
+    def _checkpoint_tag_validation(self, tag):
+        """All ranks must agree on the tag (reference :1671-1686). In
+        single-controller JAX ranks share the tag by construction; validate
+        printable-ness only."""
+        if self._config.checkpoint_tag_validation_enabled:
+            if any(ch in str(tag) for ch in "\n\t "):
+                msg = f"checkpoint tag {tag!r} contains whitespace"
+                if self._config.checkpoint_tag_validation_fail:
+                    raise ValueError(msg)
+                logger.warning(msg)
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True, load_lr_scheduler_states=True):
+        try:
+            ckpt_dir, model_state, optim_state = ckpt_io.load_checkpoint_state(
+                load_dir, tag)
+        except FileNotFoundError as e:
+            logger.warning(f"load_checkpoint: {e}")
+            return None, {}
+
+        params = jax.tree_util.tree_map(jnp.asarray, model_state["module"])
+        self._params = jax.device_put(params, self.zero_plan.param_shardings())
+        if load_optimizer_states and optim_state is not None:
+            opt = jax.tree_util.tree_map(jnp.asarray,
+                                         optim_state["optimizer_state"])
+            self._opt_state = jax.device_put(
+                opt, self.zero_plan.opt_state_shardings(opt))
+            hparams = optim_state.get("optimizer_hparams")
+            if hparams is not None and hasattr(self.optimizer,
+                                               "load_state_dict"):
+                # restores runtime lr/beta mutations (e.g. manual decay)
+                self.optimizer.load_state_dict(
+                    jax.tree_util.tree_map(
+                        lambda x: x.item() if hasattr(x, "item") and
+                        getattr(x, "ndim", 1) == 0 else x, hparams))
+        if model_state.get("loss_scaler") is not None:
+            self._scaler_state = {
+                k: jnp.asarray(v) for k, v in model_state["loss_scaler"].items()}
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                model_state.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+            # re-apply the restored schedule position to param_groups so the
+            # first post-resume step uses the right lr
+            it = getattr(self.lr_scheduler, "last_batch_iteration", None)
+            if it is not None and it >= 0:
+                self.lr_scheduler.step(it)
+        if model_state.get("rng_key") is not None:
+            self._rng_key = jnp.asarray(model_state["rng_key"])
+        self.global_steps = int(model_state.get("global_steps", 0))
+        self.global_samples = int(model_state.get("global_samples", 0))
+        self.skipped_steps = int(model_state.get("skipped_steps", 0))
+        self.micro_steps = int(model_state.get("micro_steps", 0))
+        self._grad_acc = None
+        self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)
+
+        client_state = {k: v for k, v in model_state.items()
+                        if k not in ("module", "lr_scheduler", "loss_scaler")}
+        return ckpt_dir, client_state

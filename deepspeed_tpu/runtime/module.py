@@ -1,0 +1,88 @@
+"""Model protocol for the engine.
+
+The reference wraps a torch.nn.Module whose __call__ returns the loss
+(reference engine.py:959 self.module(*inputs)). JAX has no stateful modules,
+so the engine's contract is a small protocol:
+
+    class MyModel(TrainModule):
+        def init(self, rng) -> params-pytree
+        def loss(self, params, batch, rng=None, train=True) -> scalar
+              (or (scalar, aux-dict))
+        # optional:
+        param_specs: pytree of jax.sharding.PartitionSpec for TP/SP layout
+        def apply(self, params, batch, rng=None, train=False) -> outputs
+
+Flax modules adapt via `from_flax`; plain functions via `from_functions`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class TrainModule:
+    """Base class; subclasses implement init() and loss()."""
+
+    #: optional pytree of PartitionSpec matching the params tree (TP/SP)
+    param_specs = None
+
+    def init(self, rng):
+        raise NotImplementedError
+
+    def loss(self, params, batch, rng=None, train=True):
+        raise NotImplementedError
+
+    def apply(self, params, batch, rng=None, train=False):
+        raise NotImplementedError(
+            f"{type(self).__name__} does not implement apply()")
+
+
+class _FnModule(TrainModule):
+    def __init__(self, init_fn, loss_fn, apply_fn=None, param_specs=None):
+        self._init = init_fn
+        self._loss = loss_fn
+        self._apply = apply_fn
+        self.param_specs = param_specs
+
+    def init(self, rng):
+        return self._init(rng)
+
+    def loss(self, params, batch, rng=None, train=True):
+        return self._loss(params, batch, rng=rng, train=train)
+
+    def apply(self, params, batch, rng=None, train=False):
+        if self._apply is None:
+            return super().apply(params, batch, rng=rng, train=train)
+        return self._apply(params, batch, rng=rng, train=train)
+
+
+def from_functions(init_fn: Callable, loss_fn: Callable,
+                   apply_fn: Optional[Callable] = None,
+                   param_specs: Any = None) -> TrainModule:
+    """Build a TrainModule from pure functions.
+
+    loss_fn signature: (params, batch, rng=None, train=True) -> loss[, aux].
+    """
+    return _FnModule(init_fn, loss_fn, apply_fn, param_specs)
+
+
+def from_flax(module, loss_fn: Callable, example_batch=None,
+              param_specs: Any = None) -> TrainModule:
+    """Adapt a flax.linen Module. loss_fn receives (apply_fn, variables,
+    batch, rng, train) and returns the scalar loss."""
+
+    def init_fn(rng):
+        if example_batch is None:
+            raise ValueError("from_flax requires example_batch for init()")
+        return module.init(rng, example_batch)
+
+    def loss_wrap(params, batch, rng=None, train=True):
+        return loss_fn(module.apply, params, batch, rng, train)
+
+    def apply_fn(params, batch, rng=None, train=False):
+        kwargs = {}
+        if rng is not None:
+            kwargs["rngs"] = {"dropout": rng}
+        return module.apply(params, batch, **kwargs)
+
+    return _FnModule(init_fn, loss_wrap, apply_fn, param_specs)

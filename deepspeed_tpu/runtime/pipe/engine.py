@@ -1,0 +1,33 @@
+"""PipelineEngine — scheduled pipeline-parallel training.
+
+Reference: deepspeed/runtime/pipe/engine.py:52 (train_batch :264,
+eval_batch :351, instruction dispatch :1280-1306).
+
+Current state: executes the PipelineModule end-to-end through the base
+engine (correct for pipe=1 meshes); the instruction-schedule executor over
+the `pipe` mesh axis (1F1B via ppermute handoffs) builds on
+pipe/schedule.py and lands with the pipeline milestone.
+"""
+
+from __future__ import annotations
+
+from ..engine import DeepSpeedEngine
+
+
+class PipelineEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.micro_batches = self.gradient_accumulation_steps()
+
+    def train_batch(self, data_iter=None):
+        return super().train_batch(data_iter)
+
+    def eval_batch(self, data_iter):
+        batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
+        return super().eval_batch(batch)
+
+    def inference_batch(self, data_iter):
+        """EleutherAI addition (reference pipe/engine.py:422)."""
+        batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
+        inputs = batch[0] if isinstance(batch, (tuple, list)) else batch
+        return self.module.apply(self._params, inputs, train=False)

@@ -1,0 +1,157 @@
+"""PipelineModule / LayerSpec — layer-list model for pipeline parallelism.
+
+Reference: deepspeed/runtime/pipe/module.py:23,86. A PipelineModule is a
+sequence of layer constructors (LayerSpec) partitioned over pipeline stages.
+The full pipeline runtime (schedules, ppermute p2p) lives in
+runtime/pipe/engine.py; this module carries the model description and the
+stage partitioner.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+
+from ...utils.logging import logger
+from ..utils import partition_balanced, partition_uniform
+
+
+class LayerSpec:
+    """Deferred layer constructor (reference pipe/module.py:23): holds the
+    callable + args so stages only materialize their own layers."""
+
+    def __init__(self, typename: Callable, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """Layer whose params are shared across stages (reference :44), e.g.
+    embedding/unembedding weight tying."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="weight", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Sequence-of-layers model partitioned over the `pipe` mesh axis
+    (reference pipe/module.py:86).
+
+    Each built layer must be a TrainModule-like object exposing
+    `init(rng) -> params` and `apply(params, x, rng=None, train=True) -> x`.
+    """
+
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False, partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        self.layer_specs = list(layers)
+        self.num_stages = num_stages or 1
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.seed_layers = seed_layers
+        self._topology = topology
+        self._layers = [spec.build() if isinstance(spec, LayerSpec) else spec
+                        for spec in self.layer_specs]
+        self.parts = self._partition_layers()
+
+    def mpu(self):
+        return self._topology
+
+    def num_layers(self):
+        return len(self._layers)
+
+    def _count_layer_params(self):
+        """Estimate per-layer parameter counts by abstract-evaluating init."""
+        counts = []
+        rng = jax.random.PRNGKey(0)
+        for layer in self._layers:
+            try:
+                shapes = jax.eval_shape(layer.init, rng)
+                counts.append(sum(int(jax.numpy.prod(jax.numpy.asarray(l.shape)))
+                                  if l.shape else 1
+                                  for l in jax.tree_util.tree_leaves(shapes)))
+            except Exception:
+                counts.append(1)
+        return counts
+
+    def _partition_layers(self):
+        """Stage boundaries (reference pipe/module.py:358-413; methods
+        `uniform` and `parameters`)."""
+        method = self.partition_method.lower()
+        if method == "uniform":
+            parts = partition_uniform(len(self._layers), self.num_stages)
+        elif method == "parameters":
+            weights = self._count_layer_params()
+            parts = partition_balanced([float(w) for w in weights],
+                                       self.num_stages)
+        else:
+            raise NotImplementedError(
+                f"partition_method {self.partition_method!r}")
+        logger.debug(f"pipeline partition: {parts}")
+        return parts
+
+    def stage_layers(self, stage_id: int) -> List[Any]:
+        return self._layers[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    # whole-model init/apply (used for single-stage and reference parity)
+    def init(self, rng):
+        """Params pytree: {"layers": [per-layer params or None], "tied":
+        {key: shared params}}. Tied layers (TiedLayerSpec, reference
+        pipe/module.py:415-428) share ONE param entry, so gradients
+        accumulate into the single tied copy through autodiff — the
+        functional equivalent of the reference's tied-grad allreduce."""
+        tied = {}
+        layer_params = []
+        for layer, spec in zip(self._layers, self.layer_specs):
+            rng, sub = jax.random.split(rng)
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key not in tied:
+                    tied[spec.key] = layer.init(sub)
+                layer_params.append(None)
+            else:
+                layer_params.append(layer.init(sub))
+        return {"layers": layer_params, "tied": tied}
+
+    def apply(self, params, x, rng=None, train=True):
+        if isinstance(params, (list, tuple)):  # pre-tying flat format
+            if any(isinstance(s, TiedLayerSpec) for s in self.layer_specs):
+                raise ValueError(
+                    "flat params list cannot express tied layers; use the "
+                    "{'layers': ..., 'tied': ...} pytree from init()")
+            layer_params, tied = list(params), {}
+        else:
+            layer_params, tied = params["layers"], params["tied"]
+        for layer, spec, p in zip(self._layers, self.layer_specs,
+                                  layer_params):
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            else:
+                sub = None
+            if isinstance(spec, TiedLayerSpec):
+                p = tied[spec.key]
+                if spec.forward_fn is not None:
+                    x = spec.forward_fn(layer, p, x)
+                    continue
+            x = layer.apply(p, x, rng=sub, train=train)
+        return x
+
+    def loss(self, params, batch, rng=None, train=True):
+        inputs, labels = batch
+        out = self.apply(params, inputs, rng=rng, train=train)
+        if self.loss_fn is None:
+            raise ValueError("PipelineModule needs loss_fn for training")
+        return self.loss_fn(out, labels)

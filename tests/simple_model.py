@@ -1,0 +1,53 @@
+"""Test model fixtures (reference analogue: tests/unit/simple_model.py)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.module import TrainModule
+
+
+class SimpleModel(TrainModule):
+    """Two-layer MLP regression model (reference SimpleModel)."""
+
+    def __init__(self, hidden_dim=16, out_dim=4, empty_grad=False):
+        self.hidden_dim = hidden_dim
+        self.out_dim = out_dim
+        self.empty_grad = empty_grad
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        params = {
+            "w1": jax.random.normal(k1, (self.hidden_dim, self.hidden_dim))
+            * 0.1,
+            "b1": jnp.zeros((self.hidden_dim,)),
+            "w2": jax.random.normal(k2, (self.hidden_dim, self.out_dim)) * 0.1,
+            "b2": jnp.zeros((self.out_dim,)),
+        }
+        return params
+
+    def apply(self, params, x, rng=None, train=False):
+        h = jnp.tanh(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+
+    def loss(self, params, batch, rng=None, train=True, **kwargs):
+        x, y = batch
+        pred = self.apply(params, x, rng=rng, train=train)
+        return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+
+def random_dataset(n=256, in_dim=16, out_dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(in_dim, out_dim).astype(np.float32)
+    xs = rng.randn(n, in_dim).astype(np.float32)
+    ys = xs @ w
+    return [(xs[i], ys[i]) for i in range(n)]
+
+
+def random_batches(steps, batch_size=32, in_dim=16, out_dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(in_dim, out_dim).astype(np.float32)
+    for _ in range(steps):
+        x = rng.randn(batch_size, in_dim).astype(np.float32)
+        yield (x, x @ w)

@@ -1,0 +1,210 @@
+"""End-to-end engine tests (reference analogues: tests/unit/test_fp16.py,
+test_checkpointing.py, test_data.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from tests.simple_model import SimpleModel, random_batches, random_dataset
+
+
+def base_config(**over):
+    cfg = {
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def train(engine, steps=20, batch_size=32, seed=0):
+    losses = []
+    for batch in random_batches(steps, batch_size=batch_size, seed=seed):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_initialize_returns_tuple():
+    engine, opt, loader, sched = ds.initialize(model=SimpleModel(),
+                                               config=base_config())
+    assert engine.optimizer is opt
+    assert loader is None and sched is None
+    assert engine.train_batch_size() == 32
+    assert engine.dp_world_size == 8
+
+
+def test_basic_training_loss_decreases():
+    engine, *_ = ds.initialize(model=SimpleModel(), config=base_config())
+    losses = train(engine, steps=40)
+    assert losses[-1] < losses[0] * 0.3
+    assert engine.global_steps == 40
+
+
+@pytest.mark.parametrize("stage", [0, 1, 2, 3])
+def test_zero_stages_converge_identically(stage):
+    cfg = base_config(zero_optimization={"stage": stage})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    losses = train(engine, steps=15)
+    assert losses[-1] < losses[0]
+    # all stages must produce the same math (sharding is layout, not algebra)
+    cfg0 = base_config()
+    ref, *_ = ds.initialize(model=SimpleModel(), config=cfg0)
+    ref_losses = train(ref, steps=15)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-4, atol=1e-5)
+
+
+def test_gradient_accumulation_boundary():
+    cfg = base_config(train_batch_size=32, gradient_accumulation_steps=4)
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    assert engine.train_micro_batch_size_per_gpu() == 1
+    batches = list(random_batches(4, batch_size=8))
+    for i, b in enumerate(batches):
+        engine.forward(b)
+        engine.backward()
+        engine.step()
+        if i < 3:
+            assert engine.global_steps == 0
+    assert engine.global_steps == 1
+
+
+def test_grad_accum_equivalence():
+    """gas=4 with quarter batches == gas=1 with the full batch."""
+    big = base_config(train_batch_size=32, gradient_accumulation_steps=1)
+    acc = base_config(train_batch_size=32, gradient_accumulation_steps=4)
+    e1, *_ = ds.initialize(model=SimpleModel(), config=big)
+    e2, *_ = ds.initialize(model=SimpleModel(), config=acc)
+
+    data = list(random_batches(8, batch_size=32, seed=3))
+    for x, y in data:
+        e1.forward((x, y))
+        e1.backward()
+        e1.step()
+        for i in range(4):
+            e2.forward((x[i * 8:(i + 1) * 8], y[i * 8:(i + 1) * 8]))
+            e2.backward()
+            e2.step()
+    p1 = jax.tree_util.tree_map(np.asarray, e1.params)
+    p2 = jax.tree_util.tree_map(np.asarray, e2.params)
+    for k in p1:
+        np.testing.assert_allclose(p1[k], p2[k], rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_training():
+    cfg = base_config(fp16={"enabled": True, "type": "bfloat16"})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    assert engine.precision() == "bfloat16"
+    losses = train(engine, steps=30)
+    assert losses[-1] < losses[0]
+    # master params stay fp32
+    assert engine.params["w1"].dtype == jnp.float32
+
+
+def test_fp16_dynamic_loss_scale_recovers_from_overflow():
+    cfg = base_config(fp16={"enabled": True, "loss_scale": 0,
+                            "initial_scale_power": 4, "hysteresis": 1})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    assert engine.loss_scale == 16.0
+    losses = train(engine, steps=10)
+    assert losses[-1] < losses[0] * 2  # training proceeds
+    # force an overflow through a poisoned batch (NaN loss -> NaN grads)
+    x = np.full((32, 16), np.nan, np.float32)
+    y = np.zeros((32, 4), np.float32)
+    engine.forward((x, y))
+    engine.backward()
+    before = engine.loss_scale
+    engine.step()
+    assert engine.skipped_steps >= 1
+    assert engine.loss_scale == before / 2
+
+
+def test_scheduler_advances_only_on_unskipped_steps():
+    cfg = base_config(scheduler={"type": "WarmupLR",
+                                 "params": {"warmup_max_lr": 1e-2,
+                                            "warmup_num_steps": 100}})
+    engine, opt, _, sched = ds.initialize(model=SimpleModel(), config=cfg)
+    train(engine, steps=5)
+    assert sched.last_batch_iteration == 4
+    assert opt.param_groups[0]["lr"] < 1e-2
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    cfg = base_config(scheduler={"type": "WarmupLR",
+                                 "params": {"warmup_max_lr": 1e-2,
+                                            "warmup_num_steps": 10}})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    train(engine, steps=7)
+    engine.save_checkpoint(str(tmp_path), client_state={"note": "hello"})
+    assert (tmp_path / "latest").read_text() == "global_step7"
+    assert (tmp_path / "global_step7" /
+            "mp_rank_00_model_states.msgpack").exists()
+    assert (tmp_path / "global_step7" /
+            "zero_pp_rank_0_mp_rank_00_optim_states.msgpack").exists()
+
+    fresh, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    path, client = fresh.load_checkpoint(str(tmp_path))
+    assert client["note"] == "hello"
+    assert fresh.global_steps == 7
+    for k in engine.params:
+        np.testing.assert_allclose(np.asarray(fresh.params[k]),
+                                   np.asarray(engine.params[k]))
+    # resumed training matches continued training
+    c1 = train(engine, steps=5, seed=9)
+    c2 = train(fresh, steps=5, seed=9)
+    np.testing.assert_allclose(c1, c2, rtol=1e-5)
+
+
+def test_checkpoint_missing_load_returns_none(tmp_path):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=base_config())
+    path, client = engine.load_checkpoint(str(tmp_path))
+    assert path is None and client == {}
+
+
+def test_checkpoint_tag_validation():
+    cfg = base_config(checkpoint={"tag_validation": "fail"})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    with pytest.raises(ValueError):
+        engine.save_checkpoint("/tmp/ckpt_does_not_matter", tag="bad tag")
+
+
+def test_train_batch_with_dataloader():
+    ds_data = random_dataset(n=512)
+    cfg = base_config(train_batch_size=64, gradient_accumulation_steps=2)
+    engine, _, loader, _ = ds.initialize(model=SimpleModel(), config=cfg,
+                                         training_data=ds_data)
+    assert loader is not None
+    l0 = float(engine.train_batch())
+    for _ in range(20):
+        loss = engine.train_batch()
+    assert float(loss) < l0
+    assert engine.global_steps == 21
+
+
+def test_eval_batch_no_side_effects():
+    engine, *_ = ds.initialize(model=SimpleModel(), config=base_config())
+    batch = next(random_batches(1))
+    loss = engine.eval_batch(batch)
+    assert np.isfinite(float(loss))
+    assert engine.micro_steps == 0 and engine.global_steps == 0
+
+
+def test_client_optimizer_wins():
+    from deepspeed_tpu.ops.lamb import FusedLamb
+
+    opt = FusedLamb(lr=5e-3)
+    engine, out_opt, *_ = ds.initialize(model=SimpleModel(), optimizer=opt,
+                                        config=base_config())
+    assert out_opt is opt
+
+
+def test_unknown_optimizer_raises():
+    cfg = base_config(optimizer={"type": "sgdmagic", "params": {}})
+    with pytest.raises(ValueError):
+        ds.initialize(model=SimpleModel(), config=cfg)
