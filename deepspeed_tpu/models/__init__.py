@@ -1,0 +1,6 @@
+"""Model zoo — TPU-native model families (the reference has none in-tree;
+its model tests drive an external Megatron GPT-2, SURVEY.md §1)."""
+
+from .gpt import GPT, GPTConfig, gpt2_config, GPT2_SIZES
+
+__all__ = ["GPT", "GPTConfig", "gpt2_config", "GPT2_SIZES"]

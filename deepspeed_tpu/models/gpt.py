@@ -1,0 +1,311 @@
+"""GPT model family — the framework's flagship decoder-only transformer.
+
+The reference ships no model zoo (SURVEY.md §1: "There is no model zoo...");
+its model tests drive an external Megatron GPT-2
+(/root/reference/tests/model/Megatron_GPT2/). This framework is standalone,
+so the GPT family lives in-tree, built TPU-first:
+
+* pure-function params pytree (nested dicts), bf16-friendly, static shapes;
+* Megatron-style tensor parallelism expressed as `PartitionSpec`s over the
+  `model` mesh axis (column-parallel QKV/fc1, row-parallel proj/fc2,
+  vocab-parallel embedding) — XLA inserts the psums the reference delegates
+  to Megatron's mpu (reference engine.py:622-641 just *accepts* an mpu);
+* sequence sharding of activations over the `seq` axis
+  (with_sharding_constraint), ring attention optional via
+  deepspeed_tpu.parallel.ring_attention;
+* `jax.checkpoint` rematerialisation per block (the analogue of
+  activation_checkpointing/checkpointing.py) behind `remat=True`;
+* attention dispatches through ops.transformer.attention (Pallas flash
+  attention on TPU, fused-XLA fallback elsewhere).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..ops.transformer.attention import multihead_attention
+from ..runtime.module import TrainModule
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304          # GPT-2 50257 padded to a 128 multiple
+    max_seq_len: int = 1024
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None       # default 4*d_model
+    dropout: float = 0.0
+    embed_dropout: float = 0.0
+    layer_norm_eps: float = 1e-5
+    tie_embeddings: bool = True
+    remat: bool = False              # per-block rematerialisation
+    shard_activations: bool = True   # seq/data sharding constraints
+    attn_impl: str = "auto"          # auto|pallas|xla (ops/transformer)
+    param_dtype: Any = jnp.float32
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.num_heads == 0
+
+    @property
+    def head_dim(self):
+        return self.d_model // self.num_heads
+
+
+# Standard GPT-2 sizes; "xl" is the 1.5B north-star model (BASELINE.md).
+GPT2_SIZES: Dict[str, Dict[str, int]] = {
+    "nano":   dict(num_layers=3,  num_heads=3,  d_model=48,  max_seq_len=128,
+                   vocab_size=256),
+    "small":  dict(num_layers=12, num_heads=12, d_model=768),
+    "medium": dict(num_layers=24, num_heads=16, d_model=1024),
+    "large":  dict(num_layers=36, num_heads=20, d_model=1280),
+    "xl":     dict(num_layers=48, num_heads=25, d_model=1600),
+}
+
+
+def gpt2_config(size: str = "small", **overrides) -> GPTConfig:
+    base = dict(GPT2_SIZES[size])
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+# ---------------------------------------------------------------------------
+# init
+# ---------------------------------------------------------------------------
+
+def _init_block(rng, cfg: GPTConfig):
+    k = jax.random.split(rng, 4)
+    d, f = cfg.d_model, cfg.d_ff
+    std = 0.02
+    proj_std = std / math.sqrt(2 * cfg.num_layers)  # GPT-2 residual scaling
+    dt = cfg.param_dtype
+    return {
+        "ln1": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+        "attn": {
+            "qkv": {"w": (jax.random.normal(k[0], (d, 3 * d)) * std).astype(dt),
+                    "b": jnp.zeros((3 * d,), dt)},
+            "proj": {"w": (jax.random.normal(k[1], (d, d)) * proj_std).astype(dt),
+                     "b": jnp.zeros((d,), dt)},
+        },
+        "ln2": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
+        "mlp": {
+            "fc1": {"w": (jax.random.normal(k[2], (d, f)) * std).astype(dt),
+                    "b": jnp.zeros((f,), dt)},
+            "fc2": {"w": (jax.random.normal(k[3], (f, d)) * proj_std).astype(dt),
+                    "b": jnp.zeros((d,), dt)},
+        },
+    }
+
+
+def _block_specs(cfg: GPTConfig):
+    """Megatron TP layout: column-parallel qkv/fc1 (shard output dim over
+    `model`), row-parallel proj/fc2 (shard input dim)."""
+    return {
+        "ln1": {"scale": P(), "bias": P()},
+        "attn": {
+            "qkv": {"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)},
+            "proj": {"w": P(MODEL_AXIS, None), "b": P()},
+        },
+        "ln2": {"scale": P(), "bias": P()},
+        "mlp": {
+            "fc1": {"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)},
+            "fc2": {"w": P(MODEL_AXIS, None), "b": P()},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# forward pieces (pure functions)
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, p, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * p["scale"].astype(jnp.float32) +
+            p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate, rng, train):
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+def _constrain(x, cfg: GPTConfig, spec):
+    if not cfg.shard_activations:
+        return x
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # no mesh in scope (e.g. plain jit in unit tests)
+        return x
+
+
+def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
+    """One pre-LN transformer block. x: [B, S, D]."""
+    B, S, D = x.shape
+    H = cfg.num_heads
+    r1 = r2 = r3 = None
+    if rng is not None:
+        r1, r2, r3 = jax.random.split(rng, 3)
+
+    h = layer_norm(x, p["ln1"], cfg.layer_norm_eps)
+    qkv = h @ p["attn"]["qkv"]["w"].astype(h.dtype) + \
+        p["attn"]["qkv"]["b"].astype(h.dtype)
+    q, kk, v = jnp.split(qkv, 3, axis=-1)
+    split_heads = lambda t: t.reshape(B, S, H, D // H)
+    attn = multihead_attention(split_heads(q), split_heads(kk),
+                               split_heads(v), causal=True,
+                               impl=cfg.attn_impl,
+                               dropout_rate=cfg.dropout,
+                               dropout_rng=r1, train=train)
+    attn = attn.reshape(B, S, D)
+    attn = attn @ p["attn"]["proj"]["w"].astype(h.dtype) + \
+        p["attn"]["proj"]["b"].astype(h.dtype)
+    x = x + _dropout(attn, cfg.dropout, r2, train)
+    x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
+
+    h = layer_norm(x, p["ln2"], cfg.layer_norm_eps)
+    h = h @ p["mlp"]["fc1"]["w"].astype(h.dtype) + \
+        p["mlp"]["fc1"]["b"].astype(h.dtype)
+    h = jax.nn.gelu(h, approximate=True)
+    h = _constrain(h, cfg, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+    h = h @ p["mlp"]["fc2"]["w"].astype(h.dtype) + \
+        p["mlp"]["fc2"]["b"].astype(h.dtype)
+    x = x + _dropout(h, cfg.dropout, r3, train)
+    return _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
+
+
+class GPT(TrainModule):
+    """Decoder-only LM implementing the engine's TrainModule protocol."""
+
+    def __init__(self, config: GPTConfig):
+        self.config = config
+        self.param_specs = self._build_specs()
+
+    # -- init ----------------------------------------------------------
+    def init(self, rng):
+        cfg = self.config
+        keys = jax.random.split(rng, cfg.num_layers + 3)
+        dt = cfg.param_dtype
+        params = {
+            "wte": (jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model))
+                    * 0.02).astype(dt),
+            "wpe": (jax.random.normal(keys[1], (cfg.max_seq_len, cfg.d_model))
+                    * 0.01).astype(dt),
+            "blocks": [_init_block(keys[2 + i], cfg)
+                       for i in range(cfg.num_layers)],
+            "ln_f": {"scale": jnp.ones((cfg.d_model,), dt),
+                     "bias": jnp.zeros((cfg.d_model,), dt)},
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = (jax.random.normal(
+                keys[-1], (cfg.d_model, cfg.vocab_size)) * 0.02).astype(dt)
+        return params
+
+    def _build_specs(self):
+        cfg = self.config
+        specs = {
+            "wte": P(MODEL_AXIS, None),   # vocab-parallel embedding
+            "wpe": P(),
+            "blocks": [_block_specs(cfg) for _ in range(cfg.num_layers)],
+            "ln_f": {"scale": P(), "bias": P()},
+        }
+        if not cfg.tie_embeddings:
+            specs["lm_head"] = P(None, MODEL_AXIS)
+        return specs
+
+    # -- forward -------------------------------------------------------
+    def apply(self, params, tokens, rng=None, train=False, pld_mask=None):
+        """tokens [B, S] int32 -> logits [B, S, V]."""
+        cfg = self.config
+        B, S = tokens.shape
+        x = params["wte"][tokens] + params["wpe"][:S][None, :, :]
+        if rng is not None:
+            rng, sub = jax.random.split(rng)
+            x = _dropout(x, cfg.embed_dropout, sub, train)
+        x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
+
+        block_fn = gpt_block
+        if cfg.remat:
+            block_fn = jax.checkpoint(
+                gpt_block, static_argnums=(2, 4),
+                policy=jax.checkpoint_policies.nothing_saveable)
+
+        for i, bp in enumerate(params["blocks"]):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            out = block_fn(x, bp, cfg, sub, train)
+            if pld_mask is not None:
+                # progressive layer drop: keep probability theta per layer
+                # (reference progressive_layer_drop.py; engine.py:972-973)
+                out = jnp.where(pld_mask[i], out, x)
+            x = out
+
+        x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+        if cfg.tie_embeddings:
+            logits = x @ params["wte"].T.astype(x.dtype)
+        else:
+            logits = x @ params["lm_head"].astype(x.dtype)
+        return logits
+
+    def loss(self, params, batch, rng=None, train=True,
+             progressive_layer_drop=False, pld_theta=None):
+        """Next-token cross entropy. batch: (tokens, labels) or dict with
+        input_ids/labels; labels == -100 positions are masked (HF parity)."""
+        if isinstance(batch, dict):
+            tokens = batch["input_ids"]
+            labels = batch.get("labels")
+        else:
+            tokens, labels = batch
+        if labels is None:
+            tokens, labels = tokens[:, :-1], tokens[:, 1:]
+
+        pld_mask = None
+        if progressive_layer_drop and pld_theta is not None and train:
+            # per-layer keep gates drawn once per micro step
+            if rng is None:
+                rng = jax.random.PRNGKey(0)
+            rng, sub = jax.random.split(rng)
+            pld_mask = jax.random.bernoulli(
+                sub, pld_theta, (self.config.num_layers,))
+
+        logits = self.apply(params, tokens, rng=rng, train=train,
+                            pld_mask=pld_mask)
+        logits = logits.astype(jnp.float32)
+        valid = (labels >= 0)
+        safe_labels = jnp.where(valid, labels, 0)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None],
+                                   axis=-1)[..., 0]
+        nll = jnp.where(valid, nll, 0.0)
+        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+
+    # -- convenience ---------------------------------------------------
+    def num_params(self, params=None) -> int:
+        if params is None:
+            shapes = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+            return sum(int(np_prod(l.shape))
+                       for l in jax.tree_util.tree_leaves(shapes))
+        return sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
+
+
+def np_prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out

@@ -1,0 +1,86 @@
+"""Multi-head attention dispatch — Pallas flash attention on TPU, fused XLA
+elsewhere.
+
+Reference: the fused CUDA transformer kernel's attention core
+(/root/reference/csrc/transformer/ds_transformer_cuda.cpp:147-295 — QKV
+strided-batch GEMM + softmax kernels + dropout). TPU-native design: one
+flash-attention Pallas kernel (ops/transformer/flash_attention.py) computes
+softmax(QK^T)V in VMEM-resident tiles without materialising the [S, S]
+score matrix; off-TPU (and for shapes the kernel doesn't tile) an XLA
+einsum path that the compiler fuses.
+
+Shapes follow [batch, seq, heads, head_dim] (BSHD).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_FLASH_MIN_SEQ = 256  # below this the [S,S] buffer fits easily; XLA wins
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def xla_attention(q, k, v, causal=True, bias=None, dropout_rate=0.0,
+                  dropout_rng=None, train=False, scale=None):
+    """Reference attention in pure XLA. [B,S,H,D] -> [B,S,H,D].
+
+    fp32 softmax regardless of input dtype (parity with the reference's
+    softmax kernel which upcasts — csrc/transformer/softmax_kernels.cu).
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    scale = (D ** -0.5) if scale is None else scale
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias.astype(scores.dtype)
+    if causal:
+        qi = jnp.arange(S)[:, None] + (Sk - S)  # offset for cached decoding
+        ki = jnp.arange(Sk)[None, :]
+        scores = jnp.where(qi >= ki, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if train and dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask, probs / keep, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(q.dtype)
+
+
+def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
+                        bias=None, dropout_rate: float = 0.0,
+                        dropout_rng=None, train: bool = False,
+                        scale: Optional[float] = None):
+    """Dispatching attention entry point used by the GPT family and the
+    DeepSpeedTransformerLayer.
+
+    impl: "auto" (pallas on TPU when tileable), "pallas", "xla".
+    The Pallas path has no attention-matrix dropout (flash kernels keep
+    probabilities implicit); with dropout active in training we use XLA.
+    """
+    S, D = q.shape[1], q.shape[3]
+    want_dropout = train and dropout_rate > 0.0 and dropout_rng is not None
+    use_pallas = False
+    if impl == "pallas":
+        use_pallas = True
+    elif impl == "auto":
+        use_pallas = (_on_tpu() and not want_dropout and bias is None
+                      and S >= _FLASH_MIN_SEQ and S % 128 == 0
+                      and k.shape[1] % 128 == 0 and D in (64, 128, 256))
+    if use_pallas:
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return xla_attention(q, k, v, causal=causal, bias=bias,
+                         dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                         train=train, scale=scale)

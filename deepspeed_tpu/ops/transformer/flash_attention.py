@@ -1,0 +1,305 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+TPU-native replacement for the reference's attention kernel chain
+(/root/reference/csrc/transformer/ds_transformer_cuda.cpp:147-295: QKV
+strided-batch cuBLAS GEMMs + softmax_kernels.cu + dropout): instead of
+materialising the [S, S] score matrix in HBM, each (batch·head, q-block)
+program streams k/v blocks through VMEM with an online-softmax accumulator,
+so HBM traffic is O(S·D) and the MXU sees dense 128×128 tiles.
+
+Layout: kernels operate on [BH, S, D]; the public entry accepts BSHD.
+Backward is the standard flash recomputation: forward saves only
+out + logsumexp; dq and dk/dv kernels re-form each score block on the fly.
+
+Grid iteration relies on the TPU's sequential innermost grid dimension:
+(bh, q_block) are parallel, the k-block sweep is `ARBITRARY` so the VMEM
+scratch accumulators persist across it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _compiler_params():
+    return pltpu.CompilerParams(
+        dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY))
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
+                scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_s[:] = jnp.full_like(m_s, NEG_INF)
+        l_s[:] = jnp.zeros_like(l_s)
+
+    live = (ki * bk <= qi * bq + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qidx >= kidx, s, NEG_INF)
+        m_prev = m_s[:, :1]
+        l_prev = l_s[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jnp.dot(
+            p.astype(v_ref.dtype), v_ref[0],
+            preferred_element_type=jnp.float32)
+        m_s[:, :1] = m_new
+        l_s[:, :1] = l_new
+
+    last = (ki == qi * bq // bk + (bq - 1) // bk) if causal else (ki == nk - 1)
+
+    @pl.when(last)
+    def _finish():
+        l = l_s[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
+        lse_ref[0] = (m_s[:, 0] + jnp.log(safe_l[:, 0]))
+
+
+def _fwd(q, k, v, causal, scale, bq, bk):
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = S // bq, Sk // bk
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nk=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_acc, *, scale, causal, bq, bk, nk):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = (ki * bk <= qi * bq + bq - 1) if causal else (ki >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qidx >= kidx, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])
+        do = do_ref[0].astype(jnp.float32)
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dq_acc[:] += scale * jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
+                                     preferred_element_type=jnp.float32)
+
+    last = (ki == qi * bq // bk + (bq - 1) // bk) if causal else (ki == nk - 1)
+
+    @pl.when(last)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = (qi * bq + bq - 1 >= ki * bk) if causal else (qi >= 0)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qidx >= kidx, s, NEG_INF)
+        p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
+        do = do_ref[0].astype(jnp.float32)             # (bq, D)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # p^T @ do
+        dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, None])
+        dk_acc[:] += scale * jax.lax.dot_general(
+            ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # ds^T @ q (unscaled q)
+    last = qi == nq - 1
+
+    @pl.when(last)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(causal, scale, bq, bk, res, dout):
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    nq, nk = S // bq, Sk // bk
+    delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)  # (BH, S)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=_compiler_params(),
+        interpret=_interpret(),
+    )(q, k, v, dout, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public entry (BSHD) with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, causal, scale, bq, bk):
+    out, _ = _fwd(q, k, v, causal, scale, bq, bk)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, bq, bk):
+    out, lse = _fwd(q, k, v, causal, scale, bq, bk)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, scale, bq, bk, res, dout):
+    return _bwd(causal, scale, bq, bk, res, dout)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q, k, v, causal: bool = True,
+                    scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Flash attention over [B, S, H, D] inputs (BSHD), causal or full.
+
+    Requires S % block_q == 0 and S_k % block_k == 0 (the dispatcher in
+    attention.py falls back to XLA otherwise).
+    """
+    B, S, H, D = q.shape
+    Sk = k.shape[1]
+    if S % block_q or Sk % block_k:
+        raise ValueError(f"seq lens ({S},{Sk}) not divisible by blocks "
+                         f"({block_q},{block_k})")
+    scale = (D ** -0.5) if scale is None else scale
+    to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, t.shape[1], D)
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, scale,
+                      block_q, block_k)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -1,0 +1,71 @@
+"""Flash-attention kernel parity vs the XLA reference path.
+
+Mirrors the reference's kernel tests (tests/unit/test_cuda_forward.py /
+test_cuda_backward.py: fused kernel vs BERT reference within tolerance) —
+here the Pallas kernels run in interpreter mode on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.transformer import (flash_attention,
+                                           multihead_attention,
+                                           xla_attention)
+
+
+def _make_qkv(rng, B=2, S=256, H=4, D=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, D), dtype)
+    k = jax.random.normal(kk, (B, S, H, D), dtype)
+    v = jax.random.normal(kv, (B, S, H, D), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_forward_matches_xla(causal):
+    q, k, v = _make_qkv(jax.random.PRNGKey(0))
+    ref = xla_attention(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_backward_matches_xla(causal):
+    q, k, v = _make_qkv(jax.random.PRNGKey(1), B=1, S=256, H=2, D=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=1e-3, rtol=1e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_rejects_untileable():
+    q, k, v = _make_qkv(jax.random.PRNGKey(2), S=100)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v)
+
+
+def test_dispatch_auto_on_cpu_uses_xla():
+    # On CPU auto must route to XLA (no TPU); just verify it runs + shape
+    q, k, v = _make_qkv(jax.random.PRNGKey(3), S=64)
+    out = multihead_attention(q, k, v, impl="auto")
+    assert out.shape == q.shape
+
+
+def test_xla_attention_dropout_changes_output():
+    q, k, v = _make_qkv(jax.random.PRNGKey(4), S=64)
+    base = xla_attention(q, k, v)
+    drop = xla_attention(q, k, v, dropout_rate=0.5,
+                         dropout_rng=jax.random.PRNGKey(5), train=True)
+    assert not np.allclose(np.asarray(base), np.asarray(drop))
