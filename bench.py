@@ -1,0 +1,85 @@
+"""Benchmark: GPT-2 training throughput through the DeepSpeed-TPU engine.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Metric is tokens/sec/chip training GPT-2 (ZeRO-2, bf16) — the BASELINE.json
+north-star axis. vs_baseline converts the achieved model FLOPS/chip
+(6 * params * tokens/sec) against the reference's headline 64 TFLOPS/GPU
+(BASELINE.md row 1, docs/_tutorials/bert-pretraining.md:387) — the only
+published absolute compute-rate number in the reference docs.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_TFLOPS = 64.0  # reference headline TFLOPS/GPU (BASELINE.md)
+
+
+def main():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = jax.device_count()
+    if on_tpu:
+        size, seq, micro, steps = "small", 1024, 8, 20
+    else:  # smoke mode for CPU dev runs
+        size, seq, micro, steps = "nano", 128, 4, 5
+
+    cfg = gpt2_config(size, max_seq_len=seq,
+                      shard_activations=n_dev > 1, remat=False)
+    model = GPT(cfg)
+    config = {
+        "train_batch_size": micro * n_dev,
+        "train_micro_batch_size_per_gpu": micro,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": n_dev},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=config)
+    n_params = model.num_params()
+    global_batch = micro * n_dev
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (global_batch, seq + 1), 0,
+                                cfg.vocab_size)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+
+    def step():
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        return loss
+
+    # warmup / compile
+    step().block_until_ready()
+    step().block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step()
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = steps * global_batch * seq / dt
+    tokens_per_sec_chip = tokens_per_sec / n_dev
+    achieved_tflops = 6.0 * n_params * tokens_per_sec_chip / 1e12
+
+    print(json.dumps({
+        "metric": f"gpt2_{size}_zero2_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(achieved_tflops / REFERENCE_TFLOPS, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

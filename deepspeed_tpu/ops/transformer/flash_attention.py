@@ -86,7 +86,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         l = l_s[:, :1]
         safe_l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / safe_l).astype(o_ref.dtype)
-        lse_ref[0] = (m_s[:, 0] + jnp.log(safe_l[:, 0]))
+        # lse carries a broadcast 128-lane trailing dim (TPU tiling: the
+        # lane dimension must be 128; same layout as jax's in-tree kernel)
+        lse_ref[0] = jnp.broadcast_to(m_s[:, :1] + jnp.log(safe_l),
+                                      (bq, 128))
 
 
 def _fwd(q, k, v, causal, scale, bq, bk):
@@ -105,11 +108,11 @@ def _fwd(q, k, v, causal, scale, bq, bk):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
@@ -147,12 +150,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qidx >= kidx, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])
+        p = jnp.exp(s - lse_ref[0][:, :1])
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         dq_acc[:] += scale * jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
                                      preferred_element_type=jnp.float32)
 
@@ -185,7 +188,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qidx >= kidx, s, NEG_INF)
-        p = jnp.exp(s - lse_ref[0][:, None])           # (bq, bk)
+        p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk)
         do = do_ref[0].astype(jnp.float32)             # (bq, D)
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -193,7 +196,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta_ref[0][:, None])
+        ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[:] += scale * jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)        # ds^T @ q (unscaled q)
@@ -212,6 +215,7 @@ def _bwd(causal, scale, bq, bk, res, dout):
     nq, nk = S // bq, Sk // bk
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # (BH, S)
+    delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
@@ -222,8 +226,8 @@ def _bwd(causal, scale, bq, bk, res, dout):
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -241,8 +245,8 @@ def _bwd(causal, scale, bq, bk, res, dout):
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, j, i: (b, i)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
