@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..comm.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from ..comm.mesh import DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS
 from ..ops.transformer.attention import multihead_attention
 from ..runtime.module import TrainModule
 
@@ -50,6 +50,8 @@ class GPTConfig:
     shard_activations: bool = True   # seq/data sharding constraints
     attn_impl: str = "auto"          # auto|pallas|xla (ops/transformer)
     param_dtype: Any = jnp.float32
+    pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
+    pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -206,8 +208,7 @@ class GPT(TrainModule):
                     * 0.02).astype(dt),
             "wpe": (jax.random.normal(keys[1], (cfg.max_seq_len, cfg.d_model))
                     * 0.01).astype(dt),
-            "blocks": [_init_block(keys[2 + i], cfg)
-                       for i in range(cfg.num_layers)],
+            "blocks": self._init_blocks(keys[2:2 + cfg.num_layers], cfg),
             "ln_f": {"scale": jnp.ones((cfg.d_model,), dt),
                      "bias": jnp.zeros((cfg.d_model,), dt)},
         }
@@ -216,12 +217,27 @@ class GPT(TrainModule):
                 keys[-1], (cfg.d_model, cfg.vocab_size)) * 0.02).astype(dt)
         return params
 
+    def _init_blocks(self, keys, cfg):
+        blocks = [_init_block(k, cfg) for k in keys]
+        if cfg.pipeline_stages > 1:
+            from ..parallel.pipeline import stack_stage_params
+
+            return stack_stage_params(blocks)
+        return blocks
+
     def _build_specs(self):
         cfg = self.config
+        if cfg.pipeline_stages > 1:
+            # stacked blocks: leading layer dim sharded over `pipe`
+            blocks = jax.tree_util.tree_map(
+                lambda s: P(PIPE_AXIS, *s), _block_specs(cfg),
+                is_leaf=lambda x: isinstance(x, P))
+        else:
+            blocks = [_block_specs(cfg) for _ in range(cfg.num_layers)]
         specs = {
             "wte": P(MODEL_AXIS, None),   # vocab-parallel embedding
             "wpe": P(),
-            "blocks": [_block_specs(cfg) for _ in range(cfg.num_layers)],
+            "blocks": blocks,
             "ln_f": {"scale": P(), "bias": P()},
         }
         if not cfg.tie_embeddings:
@@ -239,22 +255,31 @@ class GPT(TrainModule):
             x = _dropout(x, cfg.embed_dropout, sub, train)
         x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
 
-        block_fn = gpt_block
-        if cfg.remat:
-            block_fn = jax.checkpoint(
-                gpt_block, static_argnums=(2, 4),
-                policy=jax.checkpoint_policies.nothing_saveable)
+        if cfg.pipeline_stages > 1:
+            from ..comm.mesh import get_current_mesh
+            from ..parallel.pipeline import spmd_pipeline
 
-        for i, bp in enumerate(params["blocks"]):
-            sub = None
-            if rng is not None:
-                rng, sub = jax.random.split(rng)
-            out = block_fn(x, bp, cfg, sub, train)
-            if pld_mask is not None:
-                # progressive layer drop: keep probability theta per layer
-                # (reference progressive_layer_drop.py; engine.py:972-973)
-                out = jnp.where(pld_mask[i], out, x)
-            x = out
+            x = spmd_pipeline(
+                lambda p, h: gpt_block(h, p, cfg, None, train),
+                params["blocks"], x, get_current_mesh(),
+                num_micro=cfg.pipeline_micro_batches, remat=cfg.remat)
+        else:
+            block_fn = gpt_block
+            if cfg.remat:
+                block_fn = jax.checkpoint(
+                    gpt_block, static_argnums=(2, 4),
+                    policy=jax.checkpoint_policies.nothing_saveable)
+
+            for i, bp in enumerate(params["blocks"]):
+                sub = None
+                if rng is not None:
+                    rng, sub = jax.random.split(rng)
+                out = block_fn(x, bp, cfg, sub, train)
+                if pld_mask is not None:
+                    # progressive layer drop: keep probability theta per layer
+                    # (reference progressive_layer_drop.py; engine.py:972-973)
+                    out = jnp.where(pld_mask[i], out, x)
+                x = out
 
         x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
         if cfg.tie_embeddings:

@@ -1,0 +1,6 @@
+"""Parallelism runtimes beyond plain sharding annotations: SPMD pipeline
+execution over the `pipe` mesh axis and ring attention over the `seq` axis."""
+
+from .pipeline import spmd_pipeline, stack_stage_params
+
+__all__ = ["spmd_pipeline", "stack_stage_params"]

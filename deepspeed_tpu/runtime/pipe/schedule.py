@@ -1,0 +1,202 @@
+"""Pipeline instruction schedules — the reference's clean ISA abstraction.
+
+Reference: deepspeed/runtime/pipe/schedule.py (TrainSchedule :182,
+InferenceSchedule :129, DataParallelSchedule :292; instruction classes
+:336-474). Each schedule yields, per "clock step", a list of instructions
+for one stage. The reference interprets these eagerly with NCCL p2p
+(pipe/engine.py:1280-1306); here the SPMD executor
+(deepspeed_tpu/parallel/pipeline.py) compiles the whole schedule into one
+jitted scan-over-ticks program — the ISA remains the portable description
+(and drives schedule-shape tests mirroring tests/unit/test_pipe_schedule.py).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+
+
+class PipeInstruction:
+    def __init__(self, **kwargs):
+        self.name = self.__class__.__name__
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{self.name}({args})"
+
+    def __eq__(self, other):
+        return (type(self) is type(other)) and self.kwargs == other.kwargs
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class ReduceTiedGrads(PipeInstruction):
+    pass
+
+
+class BufferOpInstruction(PipeInstruction):
+    def __init__(self, buffer_id, **kwargs):
+        super().__init__(buffer_id=buffer_id, **kwargs)
+
+
+class LoadMicroBatch(BufferOpInstruction):
+    pass
+
+
+class ForwardPass(BufferOpInstruction):
+    pass
+
+
+class BackwardPass(BufferOpInstruction):
+    pass
+
+
+class SendActivation(BufferOpInstruction):
+    pass
+
+
+class RecvActivation(BufferOpInstruction):
+    pass
+
+
+class SendGrad(BufferOpInstruction):
+    pass
+
+
+class RecvGrad(BufferOpInstruction):
+    pass
+
+
+class PipeSchedule(ABC):
+    """Generates stage-local instruction streams (reference schedule.py:12)."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int):
+        assert 0 <= stage_id < stages
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+        self.prev_stage = stage_id - 1
+        self.next_stage = stage_id + 1
+
+    @abstractmethod
+    def steps(self):
+        """Yield a list of PipeInstructions per clock step."""
+
+    def num_pipe_buffers(self) -> int:
+        return self.micro_batches
+
+    def _buffer_idx(self, micro_batch_id) -> int:
+        return micro_batch_id % self.num_pipe_buffers()
+
+    @property
+    def stage(self):
+        return self.stage_id
+
+    @property
+    def num_stages(self):
+        return self.stages
+
+    @property
+    def num_micro_batches(self):
+        return self.micro_batches
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def __iter__(self):
+        return iter(self.steps())
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only stream (reference schedule.py:129)."""
+
+    def num_pipe_buffers(self):
+        return 2
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            b = self._buffer_idx(mb)
+            cmds = []
+            if self.is_first_stage:
+                cmds.append(LoadMicroBatch(b))
+            else:
+                cmds.append(RecvActivation(b))
+            cmds.append(ForwardPass(b))
+            if not self.is_last_stage:
+                cmds.append(SendActivation(b))
+            yield cmds
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: warmup forwards, steady one-forward-one-backward, cooldown
+    backwards, then grad reduction + optimizer step (reference
+    schedule.py:182-289's interleaved even/odd schedule has the same
+    steady-state occupancy; this is the canonical 1F1B formulation)."""
+
+    def num_pipe_buffers(self):
+        # in-flight activations per stage: distance to the last stage + 1
+        return min(self.stages - self.stage_id, self.micro_batches) or 1
+
+    def _fwd_cmds(self, mb):
+        b = self._buffer_idx(mb)
+        cmds = []
+        if self.is_first_stage:
+            cmds.append(LoadMicroBatch(b))
+        else:
+            cmds.append(RecvActivation(b))
+        cmds.append(ForwardPass(b))
+        if not self.is_last_stage:
+            cmds.append(SendActivation(b))
+        return cmds
+
+    def _bwd_cmds(self, mb):
+        b = self._buffer_idx(mb)
+        cmds = []
+        if not self.is_last_stage:
+            cmds.append(RecvGrad(b))
+        cmds.append(BackwardPass(b))
+        if not self.is_first_stage:
+            cmds.append(SendGrad(b))
+        return cmds
+
+    def steps(self):
+        warmup = min(self.stages - self.stage_id - 1, self.micro_batches)
+        steady = self.micro_batches - warmup
+        fwd = bwd = 0
+        for _ in range(warmup):
+            yield self._fwd_cmds(fwd)
+            fwd += 1
+        for _ in range(steady):
+            yield self._fwd_cmds(fwd)
+            fwd += 1
+            yield self._bwd_cmds(bwd)
+            bwd += 1
+        for _ in range(warmup):
+            yield self._bwd_cmds(bwd)
+            bwd += 1
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
+class DataParallelSchedule(PipeSchedule):
+    """Degenerate single-stage schedule (reference schedule.py:292)."""
+
+    def num_pipe_buffers(self):
+        return 1
+
+    def steps(self):
+        for mb in range(self.micro_batches):
+            yield [LoadMicroBatch(0), ForwardPass(0), BackwardPass(0)]
+        yield [ReduceGrads(), OptimizerStep()]

@@ -1,0 +1,195 @@
+"""Pipeline tests: schedule ISA invariants (mirrors reference
+tests/unit/test_pipe_schedule.py) and SPMD pipeline numerical parity vs the
+sequential model on the 8-device CPU mesh (mirrors test_pipe.py's PP-vs-DP
+parity)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.models import GPT, gpt2_config
+from deepspeed_tpu.parallel.pipeline import (spmd_pipeline,
+                                             stack_stage_params,
+                                             unstack_stage_params)
+from deepspeed_tpu.runtime.pipe import schedule as sched
+
+
+# ---------------------------------------------------------------------------
+# schedule ISA
+# ---------------------------------------------------------------------------
+
+def _flat(s):
+    return [c for step in s.steps() for c in step]
+
+
+def test_train_schedule_counts():
+    for stages in (2, 4):
+        for stage_id in range(stages):
+            s = sched.TrainSchedule(micro_batches=8, stages=stages,
+                                    stage_id=stage_id)
+            cmds = _flat(s)
+            fwd = [c for c in cmds if isinstance(c, sched.ForwardPass)]
+            bwd = [c for c in cmds if isinstance(c, sched.BackwardPass)]
+            assert len(fwd) == 8 and len(bwd) == 8
+            assert sum(isinstance(c, sched.OptimizerStep) for c in cmds) == 1
+
+
+def test_train_schedule_send_recv_pairing():
+    """Total sends from stage s must equal recvs at stage s+1."""
+    stages, mb = 4, 8
+    scheds = [sched.TrainSchedule(mb, stages, i) for i in range(stages)]
+    for s in range(stages - 1):
+        sends = sum(isinstance(c, sched.SendActivation)
+                    for c in _flat(scheds[s]))
+        recvs = sum(isinstance(c, sched.RecvActivation)
+                    for c in _flat(scheds[s + 1]))
+        assert sends == recvs == mb
+
+
+def test_train_schedule_first_last_stage_roles():
+    s0 = sched.TrainSchedule(4, 2, 0)
+    s1 = sched.TrainSchedule(4, 2, 1)
+    assert any(isinstance(c, sched.LoadMicroBatch) for c in _flat(s0))
+    assert not any(isinstance(c, sched.LoadMicroBatch) for c in _flat(s1))
+    assert not any(isinstance(c, sched.SendActivation) for c in _flat(s1))
+    assert not any(isinstance(c, sched.RecvGrad) for c in _flat(s1))
+
+
+def test_inference_schedule():
+    s = sched.InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+    cmds = _flat(s)
+    assert sum(isinstance(c, sched.ForwardPass) for c in cmds) == 4
+    assert not any(isinstance(c, sched.BackwardPass) for c in cmds)
+    assert s.num_pipe_buffers() == 2
+
+
+def test_backward_never_precedes_forward_same_buffer():
+    s = sched.TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+    seen_fwd = set()
+    for c in _flat(s):
+        if isinstance(c, sched.ForwardPass):
+            seen_fwd.add(c.buffer_id)
+        if isinstance(c, sched.BackwardPass):
+            assert c.buffer_id in seen_fwd
+
+
+# ---------------------------------------------------------------------------
+# SPMD pipeline executor
+# ---------------------------------------------------------------------------
+
+def _mlp_block(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _make_layers(rng, L, d):
+    keys = jax.random.split(rng, L)
+    return [{"w": jax.random.normal(k, (d, d)) * 0.3,
+             "b": jnp.zeros((d,))} for k in keys]
+
+
+@pytest.mark.parametrize("pipe,micro", [(2, 2), (4, 4), (2, 4)])
+def test_pipeline_forward_matches_sequential(pipe, micro):
+    L, d, B = 4, 16, 8
+    layers = _make_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, d))
+    ref = x
+    for p in layers:
+        ref = _mlp_block(p, ref)
+
+    info = comm.make_mesh(data=1, pipe=pipe,
+                          devices=jax.devices()[:pipe])
+    stacked = stack_stage_params(layers)
+    with info.mesh:
+        out = jax.jit(lambda sp, x: spmd_pipeline(
+            _mlp_block, sp, x, info, num_micro=micro))(stacked, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_pipeline_gradients_match_sequential():
+    L, d, B = 4, 16, 8
+    layers = _make_layers(jax.random.PRNGKey(0), L, d)
+    x = jax.random.normal(jax.random.PRNGKey(1), (B, d))
+    stacked = stack_stage_params(layers)
+
+    def seq_loss(sp, x):
+        def body(h, p):
+            return _mlp_block(p, h), None
+        out, _ = jax.lax.scan(body, x, sp)
+        return jnp.sum(out ** 2)
+
+    info = comm.make_mesh(data=1, pipe=4,
+                          devices=jax.devices()[:4])
+
+    def pipe_loss(sp, x):
+        return jnp.sum(spmd_pipeline(_mlp_block, sp, x, info,
+                                     num_micro=4) ** 2)
+
+    g_ref = jax.grad(seq_loss)(stacked, x)
+    with info.mesh:
+        g_pipe = jax.jit(jax.grad(pipe_loss))(stacked, x)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-4)
+
+
+def test_unstack_roundtrip():
+    layers = _make_layers(jax.random.PRNGKey(0), 3, 4)
+    stacked = stack_stage_params(layers)
+    back = unstack_stage_params(stacked, 3)
+    for a, b in zip(jax.tree_util.tree_leaves(back),
+                    jax.tree_util.tree_leaves(layers)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# GPT end-to-end with pipeline stages through the engine
+# ---------------------------------------------------------------------------
+
+def test_gpt_pipeline_matches_sequential_loss():
+    cfg_seq = gpt2_config("nano", num_layers=4, shard_activations=False)
+    cfg_pipe = gpt2_config("nano", num_layers=4, pipeline_stages=2,
+                           pipeline_micro_batches=2, shard_activations=False)
+    m_seq, m_pipe = GPT(cfg_seq), GPT(cfg_pipe)
+    params = m_seq.init(jax.random.PRNGKey(0))
+    stacked = dict(params)
+    stacked["blocks"] = stack_stage_params(params["blocks"])
+
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0,
+                                cfg_seq.vocab_size)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    ref = float(m_seq.loss(params, batch))
+
+    info = comm.make_mesh(data=1, pipe=2,
+                          devices=jax.devices()[:2])
+    with info.mesh:
+        out = float(jax.jit(lambda p, b: m_pipe.loss(p, b))(stacked, batch))
+    np.testing.assert_allclose(out, ref, rtol=2e-5)
+
+
+def test_gpt_pipeline_trains_through_engine():
+    cfg = gpt2_config("nano", num_layers=4, pipeline_stages=2,
+                      pipeline_micro_batches=2)
+    model = GPT(cfg)
+    config = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "mesh": {"data": 4, "pipe": 2},
+        "steps_per_print": 0,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                               config_params=config)
+    tokens = jax.random.randint(jax.random.PRNGKey(7), (8, 33), 0,
+                                cfg.vocab_size)
+    batch = (tokens[:, :-1], tokens[:, 1:])  # fixed batch: memorize it
+    losses = []
+    for _ in range(8):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
