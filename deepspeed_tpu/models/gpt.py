@@ -52,6 +52,7 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
     pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
+    sequence_parallel: bool = False  # ring attention over the `seq` axis
 
     def __post_init__(self):
         if self.d_ff is None:
@@ -169,11 +170,17 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         p["attn"]["qkv"]["b"].astype(h.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
     split_heads = lambda t: t.reshape(B, S, H, D // H)
-    attn = multihead_attention(split_heads(q), split_heads(kk),
-                               split_heads(v), causal=True,
-                               impl=cfg.attn_impl,
-                               dropout_rate=cfg.dropout,
-                               dropout_rng=r1, train=train)
+    if cfg.sequence_parallel:
+        from ..parallel.ring_attention import ring_attention
+
+        attn = ring_attention(split_heads(q), split_heads(kk),
+                              split_heads(v), causal=True)
+    else:
+        attn = multihead_attention(split_heads(q), split_heads(kk),
+                                   split_heads(v), causal=True,
+                                   impl=cfg.attn_impl,
+                                   dropout_rate=cfg.dropout,
+                                   dropout_rng=r1, train=train)
     attn = attn.reshape(B, S, D)
     attn = attn @ p["attn"]["proj"]["w"].astype(h.dtype) + \
         p["attn"]["proj"]["b"].astype(h.dtype)

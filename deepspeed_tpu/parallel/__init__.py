@@ -1,6 +1,8 @@
 """Parallelism runtimes beyond plain sharding annotations: SPMD pipeline
 execution over the `pipe` mesh axis and ring attention over the `seq` axis."""
 
-from .pipeline import spmd_pipeline, stack_stage_params
+from .pipeline import spmd_pipeline, stack_stage_params, unstack_stage_params
+from .ring_attention import ring_attention
 
-__all__ = ["spmd_pipeline", "stack_stage_params"]
+__all__ = ["spmd_pipeline", "stack_stage_params", "unstack_stage_params",
+           "ring_attention"]

@@ -1,0 +1,101 @@
+"""Ring attention — sequence-parallel exact attention over the `seq` axis.
+
+The reference has NO sequence parallelism (SURVEY.md §2.2: absent in
+v0.3.15; its long-sequence story is block-sparse attention + activation
+partitioning). This is the TPU-native long-context path: the sequence
+dimension is sharded over the `seq` mesh axis; each device holds local
+Q/K/V chunks and K/V blocks rotate around the ring via `ppermute` (ICI
+neighbor traffic), combined with an online-softmax accumulator — flash
+attention at the inter-chip level. Compute and memory per chip are
+O(S/n · S) and O(S/n), enabling sequences n× longer than one chip's HBM
+would allow.
+
+Backward is reverse-mode autodiff through the scan+ppermute program (the
+ppermute transpose reverses the ring), so no hand-written backward pass.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import SEQ_AXIS, MeshInfo
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k, v, n, causal, scale):
+    """Per-device ring loop. q/k/v: local [B, Sc, H, D] chunks."""
+    idx = jax.lax.axis_index(SEQ_AXIS)
+    B, Sc, H, D = q.shape
+    qf = q.astype(jnp.float32) * scale
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    iota_q = jax.lax.broadcasted_iota(jnp.int32, (Sc, Sc), 0)
+    iota_k = jax.lax.broadcasted_iota(jnp.int32, (Sc, Sc), 1)
+
+    def step(carry, t):
+        acc, m, l, kc, vc = carry
+        src = (idx - t) % n  # global chunk id currently held in kc/vc
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kc.astype(jnp.float32),
+                       preferred_element_type=jnp.float32)
+        if causal:
+            qpos = idx * Sc + iota_q
+            kpos = src * Sc + iota_k
+            mask = (qpos >= kpos)[None, None]
+            s = jnp.where(mask, s, NEG_INF)
+        else:
+            mask = jnp.ones((1, 1, Sc, Sc), bool)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)  # fully-masked chunks contribute zero
+        alpha = jnp.exp(m - m_new)
+        l = alpha * l + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vc.astype(jnp.float32),
+            preferred_element_type=jnp.float32)
+        kc = jax.lax.ppermute(kc, SEQ_AXIS, perm)
+        vc = jax.lax.ppermute(vc, SEQ_AXIS, perm)
+        return (acc, m_new, l, kc, vc), None
+
+    # mark fresh accumulators device-varying so the scan carry type is
+    # stable (they become varying after the first masked update)
+    vary = lambda x: jax.lax.pcast(x, (SEQ_AXIS,), to="varying")
+    acc0 = vary(jnp.zeros((B, H, Sc, D), jnp.float32))
+    m0 = vary(jnp.full((B, H, Sc), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((B, H, Sc), jnp.float32))
+    (acc, m, l, _, _), _ = jax.lax.scan(
+        step, (acc0, m0, l0, k, v), jnp.arange(n))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)  # -> [B, Sc, H, D]
+
+
+def ring_attention(q, k, v, mesh_info: Optional[MeshInfo] = None,
+                   causal: bool = True, scale: Optional[float] = None):
+    """Sequence-parallel attention. [B, S, H, D] with S sharded over `seq`.
+
+    Falls back to a single-device flash/XLA path when the seq axis is 1.
+    """
+    if mesh_info is None:
+        from ..comm.mesh import get_current_mesh
+
+        mesh_info = get_current_mesh()
+    n = mesh_info.axis_size(SEQ_AXIS)
+    scale = (q.shape[-1] ** -0.5) if scale is None else scale
+    if n == 1:
+        from ..ops.transformer.attention import multihead_attention
+
+        return multihead_attention(q, k, v, causal=causal, scale=scale)
+
+    spec = P(None, SEQ_AXIS, None, None)
+    fn = jax.shard_map(
+        lambda q, k, v: _ring_body(q, k, v, n, causal, scale),
+        mesh=mesh_info.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        axis_names={SEQ_AXIS},
+    )
+    return fn(q, k, v)
