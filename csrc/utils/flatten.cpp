@@ -1,0 +1,51 @@
+// Contiguous flatten/unflatten of tensor lists (byte-level).
+//
+// Equivalent of the reference's flatten_unflatten extension
+// (/root/reference/csrc/utils/flatten_unflatten.cpp:21-24, backed by
+// torch's _flatten_dense_tensors): packs N host buffers into one
+// contiguous arena and back, OpenMP-parallel across tensors. Used by the
+// offload runtime to stage shards for aio writes and host optimizer steps.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+void ds_flatten(int64_t n_tensors,
+                const void** srcs,
+                const int64_t* nbytes,
+                void* out) {
+    int64_t offset = 0;
+    // prefix offsets first (cheap), copies in parallel
+    int64_t* offs = new int64_t[n_tensors];
+    for (int64_t i = 0; i < n_tensors; ++i) {
+        offs[i] = offset;
+        offset += nbytes[i];
+    }
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < n_tensors; ++i) {
+        memcpy(static_cast<char*>(out) + offs[i], srcs[i],
+               static_cast<size_t>(nbytes[i]));
+    }
+    delete[] offs;
+}
+
+void ds_unflatten(int64_t n_tensors,
+                  void** dsts,
+                  const int64_t* nbytes,
+                  const void* flat) {
+    int64_t offset = 0;
+    int64_t* offs = new int64_t[n_tensors];
+    for (int64_t i = 0; i < n_tensors; ++i) {
+        offs[i] = offset;
+        offset += nbytes[i];
+    }
+#pragma omp parallel for schedule(dynamic)
+    for (int64_t i = 0; i < n_tensors; ++i) {
+        memcpy(dsts[i], static_cast<const char*>(flat) + offs[i],
+               static_cast<size_t>(nbytes[i]));
+    }
+    delete[] offs;
+}
+
+}  // extern "C"

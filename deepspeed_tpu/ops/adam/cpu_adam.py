@@ -1,0 +1,90 @@
+"""DeepSpeedCPUAdam — native host Adam for optimizer-state offload.
+
+Reference: /root/reference/ops/adam/cpu_adam.py + csrc/adam/cpu_adam.cpp
+(AVX/OpenMP host Adam used by ZeRO-Offload). The TPU build keeps fp32
+master params + moments in host RAM (numpy), runs the vectorized C++ step
+(csrc/adam/cpu_adam.cpp via ctypes), and hands bf16/fp32 weights back for
+device upload — freeing HBM of all optimizer state.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+from ..op_builder import CPUAdamBuilder, get_op
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+class HostAdam:
+    """Flat-buffer host Adam over numpy arrays (one buffer per param leaf).
+
+    Not a jax optimizer: it mutates host state in place — by design, this
+    is the offload path that keeps optimizer state out of HBM.
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adam_w_mode=True, bias_correction=True):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adam_w_mode = adam_w_mode
+        self.bias_correction = bias_correction
+        self.step_count = 0
+        self._lib = get_op(CPUAdamBuilder.NAME)
+        self._state: Dict[int, Dict[str, np.ndarray]] = {}
+
+    def state_for(self, key: int, n: int):
+        if key not in self._state:
+            self._state[key] = {
+                "m": np.zeros(n, np.float32),
+                "v": np.zeros(n, np.float32),
+            }
+        return self._state[key]
+
+    def begin_step(self):
+        self.step_count += 1
+        if self.bias_correction:
+            self._bc1 = 1.0 - self.betas[0] ** self.step_count
+            self._bc2 = 1.0 - self.betas[1] ** self.step_count
+        else:
+            self._bc1 = self._bc2 = 1.0
+
+    def update_flat(self, key: int, params: np.ndarray, grads: np.ndarray,
+                    lr=None, out_bf16: np.ndarray = None):
+        """In-place Adam on one flat fp32 buffer; optionally emit bf16."""
+        assert params.dtype == np.float32 and grads.dtype == np.float32
+        n = params.size
+        st = self.state_for(key, n)
+        args = (n, _f32p(params), _f32p(grads), _f32p(st["m"]),
+                _f32p(st["v"]))
+        hp = (ctypes.c_float(self.lr if lr is None else lr),
+              ctypes.c_float(self.betas[0]), ctypes.c_float(self.betas[1]),
+              ctypes.c_float(self.eps), ctypes.c_float(self.weight_decay),
+              1 if self.adam_w_mode else 0,
+              ctypes.c_float(self._bc1), ctypes.c_float(self._bc2))
+        if out_bf16 is not None:
+            assert out_bf16.dtype == np.uint16 and out_bf16.size == n
+            u16 = out_bf16.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+            self._lib.ds_adam_step_bf16(*args, u16, *hp)
+        else:
+            self._lib.ds_adam_step(*args, *hp)
+
+    # checkpointing ----------------------------------------------------
+    def state_dict(self):
+        # string keys: msgpack (checkpoint wire format) rejects int map keys
+        return {"step": self.step_count,
+                "state": {str(k): {kk: vv.copy() for kk, vv in s.items()}
+                          for k, s in self._state.items()}}
+
+    def load_state_dict(self, sd):
+        self.step_count = int(sd["step"])
+        self._state = {int(k): {kk: np.asarray(vv, np.float32)
+                                for kk, vv in s.items()}
+                       for k, s in sd["state"].items()}

@@ -1,0 +1,3 @@
+from .handle import AsyncIOHandle, aio_read, aio_write
+
+__all__ = ["AsyncIOHandle", "aio_read", "aio_write"]

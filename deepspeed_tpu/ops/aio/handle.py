@@ -1,0 +1,97 @@
+"""Python wrapper over the native async file-I/O engine.
+
+Reference API: /root/reference/csrc/aio/py_lib/deepspeed_py_aio_handle.cpp
+(aio_handle with read/write/pread/pwrite + wait) and ops/aio. Backing
+engine: csrc/aio/ds_aio.cpp (thread pool + pread/pwrite, O_DIRECT when the
+filesystem supports it — this image has no libaio headers).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..op_builder import get_op
+
+
+class AsyncIOHandle:
+    """Submit async reads/writes of numpy buffers against files.
+
+    Usage:
+        h = AsyncIOHandle(n_threads=4)
+        h.async_pwrite(arr, "/ssd/shard0.bin")
+        ... overlap compute ...
+        h.wait()
+    """
+
+    def __init__(self, n_threads: int = 4, block_size: int = 1 << 20,
+                 o_direct: bool = False):
+        self._lib = get_op("async_io")
+        self._h = self._lib.aio_handle_create(int(n_threads), int(block_size),
+                                              1 if o_direct else 0)
+        self._pinned = []  # keep submitted buffers alive until wait()
+
+    def _buf(self, arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "aio requires contiguous buffers"
+        self._pinned.append(arr)
+        return arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes
+
+    def async_pwrite(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        ptr, nbytes = self._buf(arr)
+        rc = self._lib.aio_pwrite(self._h, ptr, path.encode(), nbytes,
+                                  file_offset, 1)
+        if rc != 0:
+            raise IOError(f"aio_pwrite submit failed for {path}")
+
+    def async_pread(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        ptr, nbytes = self._buf(arr)
+        rc = self._lib.aio_pread(self._h, ptr, path.encode(), nbytes,
+                                 file_offset, 1)
+        if rc != 0:
+            raise IOError(f"aio_pread submit failed for {path}")
+
+    def sync_pwrite(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        self.async_pwrite(arr, path, file_offset)
+        self.wait()
+
+    def sync_pread(self, arr: np.ndarray, path: str, file_offset: int = 0):
+        self.async_pread(arr, path, file_offset)
+        self.wait()
+
+    def wait(self):
+        errors = self._lib.aio_wait(self._h)
+        self._pinned.clear()
+        if errors:
+            raise IOError(f"aio: {errors} operation(s) failed")
+
+    def close(self):
+        if self._h is not None:
+            self._lib.aio_handle_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_DEFAULT: Optional[AsyncIOHandle] = None
+
+
+def _default() -> AsyncIOHandle:
+    global _DEFAULT
+    if _DEFAULT is None:
+        _DEFAULT = AsyncIOHandle()
+    return _DEFAULT
+
+
+def aio_write(arr: np.ndarray, path: str):
+    """Blocking convenience write (reference deepspeed_py_aio.cpp)."""
+    _default().sync_pwrite(arr, path)
+
+
+def aio_read(arr: np.ndarray, path: str):
+    _default().sync_pread(arr, path)

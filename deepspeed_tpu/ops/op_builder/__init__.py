@@ -1,0 +1,5 @@
+from .builder import (ALL_OPS, AsyncIOBuilder, CPUAdamBuilder, OpBuilder,
+                      UtilsBuilder, get_op)
+
+__all__ = ["ALL_OPS", "OpBuilder", "CPUAdamBuilder", "AsyncIOBuilder",
+           "UtilsBuilder", "get_op"]

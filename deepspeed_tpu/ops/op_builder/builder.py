@@ -1,0 +1,183 @@
+"""Native-op build system: JIT-compile C++ sources to shared libs, ctypes.
+
+Reference: /root/reference/op_builder/builder.py (OpBuilder/CUDAOpBuilder —
+per-op builder classes with is_compatible(), JIT load via
+torch.utils.cpp_extension, DS_BUILD_* env switches). TPU-native version:
+device kernels are Pallas/XLA (no build step), so this builder only covers
+the HOST-native C++ components (cpu_adam, aio, flatten); it compiles with
+g++ -O3 -march=native -fopenmp into a content-hashed cache and loads the
+result with ctypes (pybind11 is not in this image).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ...utils.logging import logger
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+CSRC = REPO_ROOT / "csrc"
+
+
+def _cache_dir() -> Path:
+    base = os.environ.get("DSTPU_OPS_CACHE",
+                          os.path.join(os.path.expanduser("~"), ".cache",
+                                       "deepspeed_tpu", "ops"))
+    p = Path(base)
+    p.mkdir(parents=True, exist_ok=True)
+    return p
+
+
+class OpBuilder:
+    NAME: str = "base"
+
+    def sources(self) -> List[str]:
+        """Source paths relative to csrc/."""
+        raise NotImplementedError
+
+    def extra_cflags(self) -> List[str]:
+        return []
+
+    def extra_ldflags(self) -> List[str]:
+        return []
+
+    def compiler(self) -> Optional[str]:
+        return shutil.which(os.environ.get("CXX", "g++"))
+
+    def is_compatible(self) -> bool:
+        if os.environ.get(f"DS_BUILD_{self.NAME.upper()}", "1") == "0":
+            return False
+        return self.compiler() is not None
+
+    def compatibility_message(self) -> str:
+        if self.compiler() is None:
+            return "no C++ compiler found"
+        return "compatible"
+
+    def _hash(self, srcs: List[Path]) -> str:
+        h = hashlib.sha256()
+        for s in srcs:
+            h.update(s.read_bytes())
+        h.update(" ".join(self.extra_cflags() + self.extra_ldflags()).encode())
+        return h.hexdigest()[:16]
+
+    def lib_path(self) -> Path:
+        srcs = [CSRC / s for s in self.sources()]
+        return _cache_dir() / f"{self.NAME}_{self._hash(srcs)}.so"
+
+    def build(self) -> Path:
+        srcs = [CSRC / s for s in self.sources()]
+        out = self.lib_path()
+        if out.exists():
+            return out
+        cxx = self.compiler()
+        if cxx is None:
+            raise RuntimeError(f"op {self.NAME}: no C++ compiler")
+        cmd = [cxx, "-O3", "-shared", "-fPIC", "-std=c++17",
+               "-march=native", "-fopenmp",
+               *self.extra_cflags(),
+               *[str(s) for s in srcs],
+               "-o", str(out),
+               *self.extra_ldflags()]
+        logger.info(f"building native op {self.NAME}: {' '.join(cmd)}")
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"op {self.NAME} build failed:\n{e.stderr}") from e
+        return out
+
+    def load(self) -> ctypes.CDLL:
+        if not self.is_compatible():
+            raise RuntimeError(
+                f"op {self.NAME} unavailable: {self.compatibility_message()}")
+        lib = ctypes.CDLL(str(self.build()))
+        self._bind(lib)
+        return lib
+
+    def _bind(self, lib: ctypes.CDLL) -> None:
+        """Set argtypes/restype on the loaded library (subclass hook)."""
+
+
+class CPUAdamBuilder(OpBuilder):
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["adam/cpu_adam.cpp"]
+
+    def _bind(self, lib):
+        f32p = ctypes.POINTER(ctypes.c_float)
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        lib.ds_adam_step.restype = None
+        lib.ds_adam_step.argtypes = [
+            ctypes.c_int64, f32p, f32p, f32p, f32p,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float]
+        lib.ds_adam_step_bf16.restype = None
+        lib.ds_adam_step_bf16.argtypes = [
+            ctypes.c_int64, f32p, f32p, f32p, f32p, u16p,
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_float, ctypes.c_float]
+
+
+class AsyncIOBuilder(OpBuilder):
+    NAME = "async_io"
+
+    def sources(self):
+        return ["aio/ds_aio.cpp"]
+
+    def extra_ldflags(self):
+        return ["-lpthread"]
+
+    def _bind(self, lib):
+        lib.aio_handle_create.restype = ctypes.c_void_p
+        lib.aio_handle_create.argtypes = [ctypes.c_int, ctypes.c_int,
+                                          ctypes.c_int]
+        lib.aio_handle_destroy.restype = None
+        lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
+        common = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,
+                  ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.aio_pwrite.restype = ctypes.c_int
+        lib.aio_pwrite.argtypes = common
+        lib.aio_pread.restype = ctypes.c_int
+        lib.aio_pread.argtypes = common
+        lib.aio_wait.restype = ctypes.c_int
+        lib.aio_wait.argtypes = [ctypes.c_void_p]
+
+
+class UtilsBuilder(OpBuilder):
+    NAME = "utils"
+
+    def sources(self):
+        return ["utils/flatten.cpp"]
+
+    def _bind(self, lib):
+        vpp = ctypes.POINTER(ctypes.c_void_p)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.ds_flatten.restype = None
+        lib.ds_flatten.argtypes = [ctypes.c_int64, vpp, i64p, ctypes.c_void_p]
+        lib.ds_unflatten.restype = None
+        lib.ds_unflatten.argtypes = [ctypes.c_int64, vpp, i64p,
+                                     ctypes.c_void_p]
+
+
+ALL_OPS: Dict[str, type] = {
+    CPUAdamBuilder.NAME: CPUAdamBuilder,
+    AsyncIOBuilder.NAME: AsyncIOBuilder,
+    UtilsBuilder.NAME: UtilsBuilder,
+}
+
+_LOADED: Dict[str, ctypes.CDLL] = {}
+
+
+def get_op(name: str) -> ctypes.CDLL:
+    """Load (building if needed) a native op library, cached per process."""
+    if name not in _LOADED:
+        _LOADED[name] = ALL_OPS[name]().load()
+    return _LOADED[name]

@@ -1,0 +1,56 @@
+"""Native flatten/unflatten of numpy tensor lists.
+
+Reference: /root/reference/csrc/utils/flatten_unflatten.cpp:21-24 (loaded by
+engine.py:218-220 and ZeRO stage2.py:122-124 for contiguous grad buffers).
+On TPU the jitted step keeps device tensors unflattened (XLA fuses); this
+native path serves the HOST side: staging offload shards contiguously for
+aio writes and host-Adam steps.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Sequence
+
+import numpy as np
+
+from .op_builder import UtilsBuilder, get_op
+
+
+def _ptr_array(arrs: Sequence[np.ndarray], writable: bool):
+    n = len(arrs)
+    ptrs = (ctypes.c_void_p * n)()
+    sizes = (ctypes.c_int64 * n)()
+    for i, a in enumerate(arrs):
+        assert a.flags["C_CONTIGUOUS"]
+        if writable:
+            assert a.flags["WRITEABLE"]
+        ptrs[i] = a.ctypes.data
+        sizes[i] = a.nbytes
+    return ptrs, sizes
+
+
+def flatten(tensors: Sequence[np.ndarray]) -> np.ndarray:
+    """Pack tensors into one contiguous 1-D byte-compatible buffer (same
+    dtype required)."""
+    dtype = tensors[0].dtype
+    assert all(t.dtype == dtype for t in tensors)
+    total = sum(t.size for t in tensors)
+    out = np.empty(total, dtype)
+    lib = get_op(UtilsBuilder.NAME)
+    ptrs, sizes = _ptr_array(tensors, writable=False)
+    lib.ds_flatten(len(tensors),
+                   ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                   sizes, out.ctypes.data_as(ctypes.c_void_p))
+    return out
+
+
+def unflatten(flat: np.ndarray, like: Sequence[np.ndarray]) -> List[np.ndarray]:
+    """Split a flat buffer back into tensors shaped like `like`."""
+    outs = [np.empty_like(t) for t in like]
+    lib = get_op(UtilsBuilder.NAME)
+    ptrs, sizes = _ptr_array(outs, writable=True)
+    lib.ds_unflatten(len(outs),
+                     ctypes.cast(ptrs, ctypes.POINTER(ctypes.c_void_p)),
+                     sizes, flat.ctypes.data_as(ctypes.c_void_p))
+    return outs

@@ -110,9 +110,20 @@ class DeepSpeedEngine:
 
         # optimizer
         self.optimizer = self._configure_optimizer()
-        opt_state = self.optimizer.init(self._params)
-        self._opt_state = jax.device_put(
-            opt_state, self.zero_plan.opt_state_shardings(opt_state))
+        self._offload = self._configure_offload(params)
+        if self._offload is not None:
+            # optimizer state lives on host (RAM or NVMe); device keeps
+            # compute-dtype working weights only
+            self._params = jax.device_put(
+                jax.tree_util.tree_map(
+                    lambda p: p.astype(self.compute_dtype)
+                    if jnp.issubdtype(p.dtype, jnp.floating) else p, params),
+                self.zero_plan.param_shardings())
+            self._opt_state = None
+        else:
+            opt_state = self.optimizer.init(self._params)
+            self._opt_state = jax.device_put(
+                opt_state, self.zero_plan.opt_state_shardings(opt_state))
         self._scaler_state = self.loss_scaler.jit_state()
         self._grad_acc = None  # lazily built zeros, sharded per grad_spec
         self._cached = None    # (loss, grads) from forward awaiting backward
@@ -178,6 +189,25 @@ class DeepSpeedEngine:
             return OnebitAdam(**params)
         raise ValueError(f"unknown optimizer {name!r}; supported: "
                          f"{const.DEEPSPEED_OPTIMIZERS}")
+
+    def _configure_offload(self, params):
+        """ZeRO-Offload: host-RAM or NVMe optimizer state + native CPU-Adam
+        (reference stage2.py:1450-1461 / swap_tensor; SURVEY.md §2.4)."""
+        zc = self._config.zero_config
+        if not (zc.cpu_offload or zc.offload_optimizer is not None):
+            return None
+        from .zero.offload import CPUOffloadRuntime
+
+        nvme = None
+        if zc.offload_optimizer is not None and \
+                zc.offload_optimizer.device == "nvme":
+            nvme = zc.offload_optimizer.nvme_path
+        hparams = dict(self._config.optimizer_params or {})
+        adam_w = bool(hparams.pop(const.ADAM_W_MODE, True))
+        return CPUOffloadRuntime(
+            params, hparams, adam_w_mode=adam_w, nvme_path=nvme,
+            param_dtype=self.compute_dtype,
+            param_shardings=self.zero_plan.param_shardings())
 
     def _configure_lr_scheduler(self, client_scheduler):
         if client_scheduler is not None:
@@ -347,6 +377,8 @@ class DeepSpeedEngine:
         """Weight update at accumulation boundaries (reference :1201)."""
         if self.micro_steps == 0 or not self.is_gradient_accumulation_boundary():
             return
+        if self._offload is not None:
+            return self._offload_step()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         (self._params, self._opt_state, self._scaler_state, self._grad_acc,
@@ -373,6 +405,31 @@ class DeepSpeedEngine:
                 f"loss_scale={float(self._scaler_state['cur_scale'])}, "
                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
                 ranks=[0])
+
+    def _offload_step(self):
+        """Host-side step: grads D2H -> native CPU-Adam on fp32 masters ->
+        updated weights H2D. Loss-scale bookkeeping mirrors the device path."""
+        denom = float(self._scaler_state["cur_scale"]) * \
+            self.gradient_accumulation_steps()
+        if self._config.prescale_gradients:
+            denom /= float(self._config.gradient_predivide_factor or 1.0)
+        grad_leaves = jax.tree_util.tree_leaves(self._grad_acc)
+        new_params, overflow, _norm = self._offload.step(
+            grad_leaves, denom, self._current_lr(),
+            clip=float(self._config.gradient_clipping or 0.0))
+        self._scaler_state = self.loss_scaler.jit_update(
+            self._scaler_state, jnp.asarray(overflow))
+        self.global_steps += 1
+        if overflow:
+            self.skipped_steps += 1
+            log_dist(f"offload step overflow: skipping, new loss scale "
+                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
+        else:
+            self._params = new_params
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+        self._grad_acc = None
+        self.tput_timer.stop(report_speed=False)
 
     def train_batch(self, data_iter=None):
         """Convenience: run a full global batch (gas micro steps + update).
@@ -482,8 +539,16 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
+        if self._offload is not None:
+            # host fp32 masters are the source of truth under offload
+            module_np = jax.tree_util.tree_unflatten(
+                self._offload.treedef,
+                [m.reshape(s) for m, s in zip(self._offload.masters,
+                                              self._offload.shapes)])
+        else:
+            module_np = jax.tree_util.tree_map(np.asarray, self._params)
         model_state = {
-            "module": jax.tree_util.tree_map(np.asarray, self._params),
+            "module": module_np,
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if self.lr_scheduler is not None else None),
             "loss_scaler": {
@@ -492,8 +557,10 @@ class DeepSpeedEngine:
             **self._client_state(client_state),
         }
         optim_state = {
-            "optimizer_state": jax.tree_util.tree_map(np.asarray,
-                                                      self._opt_state),
+            "optimizer_state": (
+                self._offload.state_dict() if self._offload is not None
+                else jax.tree_util.tree_map(np.asarray, self._opt_state)),
+            "offload": self._offload is not None,
             # json round-trip: msgpack rejects tuples (betas); lists restore fine
             "optimizer_hparams": (json.loads(json.dumps(
                 self.optimizer.state_dict()))
@@ -525,8 +592,19 @@ class DeepSpeedEngine:
             return None, {}
 
         params = jax.tree_util.tree_map(jnp.asarray, model_state["module"])
+        if self._offload is not None:
+            self._offload.masters = [
+                np.asarray(l, np.float32).ravel().copy()
+                for l in jax.tree_util.tree_leaves(model_state["module"])]
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
         self._params = jax.device_put(params, self.zero_plan.param_shardings())
-        if load_optimizer_states and optim_state is not None:
+        if load_optimizer_states and optim_state is not None and \
+                self._offload is not None and optim_state.get("offload"):
+            self._offload.load_state_dict(optim_state["optimizer_state"])
+        elif load_optimizer_states and optim_state is not None and \
+                self._offload is None:
             opt = jax.tree_util.tree_map(jnp.asarray,
                                          optim_state["optimizer_state"])
             self._opt_state = jax.device_put(

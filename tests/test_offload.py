@@ -1,0 +1,98 @@
+"""ZeRO-Offload engine tests: host CPU-Adam training parity, NVMe paging,
+checkpoint round-trip (reference tests: test_fp16.py cpu_offload variants,
+test_checkpointing.py ZeRO x offload)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, gpt2_config
+from tests.simple_model import SimpleModel  # noqa: F401 (fixture reuse)
+
+
+def _config(offload_device=None, **over):
+    zero = {"stage": 2}
+    if offload_device == "cpu":
+        zero["cpu_offload"] = True
+    elif offload_device == "nvme":
+        zero["offload_optimizer"] = {"device": "nvme",
+                                     "nvme_path": over.pop("nvme_path")}
+    cfg = {
+        "train_batch_size": 8,
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": zero,
+        "mesh": {"data": 8},
+        "steps_per_print": 0,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _train(engine, steps=6, seed=7):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (8, 33), 0, 256)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    losses = []
+    for _ in range(steps):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    return losses
+
+
+def test_cpu_offload_trains():
+    model = GPT(gpt2_config("nano"))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config_params=_config("cpu"))
+    assert engine._offload is not None and engine._opt_state is None
+    losses = _train(engine)
+    assert losses[-1] < losses[0], losses
+
+
+def test_cpu_offload_matches_device_adam():
+    """Offloaded host Adam must track the device FusedAdam trajectory."""
+    losses = {}
+    for mode in ("device", "cpu"):
+        model = GPT(gpt2_config("nano"))
+        cfg = _config(None if mode == "device" else "cpu")
+        engine, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                                   config_params=cfg)
+        losses[mode] = _train(engine, steps=5)
+    np.testing.assert_allclose(losses["cpu"], losses["device"], rtol=2e-2)
+
+
+def test_nvme_offload_trains(tmp_path):
+    model = GPT(gpt2_config("nano"))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config_params=_config("nvme", nvme_path=str(tmp_path)))
+    assert engine._offload is not None and engine._offload.nvme is not None
+    losses = _train(engine)
+    assert losses[-1] < losses[0], losses
+    # moments actually paged to disk
+    import glob
+    files = glob.glob(str(tmp_path / "dstpu_offload_*" / "*.bin"))
+    assert files, "no NVMe state files written"
+
+
+def test_offload_checkpoint_roundtrip(tmp_path):
+    model = GPT(gpt2_config("nano"))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config_params=_config("cpu"))
+    _train(engine, steps=3)
+    engine.save_checkpoint(str(tmp_path), tag="t3")
+
+    model2 = GPT(gpt2_config("nano"))
+    engine2, _, _, _ = deepspeed_tpu.initialize(
+        model=model2, config_params=_config("cpu"))
+    engine2.load_checkpoint(str(tmp_path), tag="t3")
+    for a, b in zip(engine._offload.masters, engine2._offload.masters):
+        np.testing.assert_array_equal(a, b)
+    assert engine2._offload.adam.step_count == engine._offload.adam.step_count
+    # training continues identically
+    l1 = _train(engine, steps=2, seed=9)
+    l2 = _train(engine2, steps=2, seed=9)
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
