@@ -1,0 +1,12 @@
+from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
+                              DenseSparsityConfig, FixedSparsityConfig,
+                              LocalSlidingWindowSparsityConfig, SparsityConfig,
+                              VariableSparsityConfig)
+from .sparse_attention import (SparseSelfAttention, block_sparse_attention,
+                               layout_to_gather)
+
+__all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+           "VariableSparsityConfig", "BigBirdSparsityConfig",
+           "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
+           "SparseSelfAttention", "block_sparse_attention",
+           "layout_to_gather"]

@@ -1,0 +1,126 @@
+"""Block-sparse attention compute for SparsityConfig layouts.
+
+Reference: the Triton SDD/DSD/DDS matmuls + block-sparse softmax
+(/root/reference/deepspeed/ops/sparse_attention/matmul.py:749,
+softmax.py:315, trsrc/*.tr) driven by
+sparse_self_attention.py:14. TPU-native design: the layout is STATIC, so
+each (head, query-block) row's nonzero key-block indices become a static
+gather; XLA then runs dense [blk x W*blk] attention per row — compute and
+memory O(S * W * blk) instead of O(S^2), tiled on the MXU. No Triton, no
+LUT C++ helper (csrc/sparse_attention/utils.cpp): the gather indices ARE
+the LUT.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sparsity_config import SparsityConfig
+
+NEG_INF = -1e30
+
+
+def layout_to_gather(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """[H, nb, nb] 0/1 layout -> (idx [H, nb, W], valid [H, nb, W]).
+
+    W = max nonzeros per row; rows pad with index 0 + valid=False."""
+    layout = np.asarray(layout)
+    H, nb, _ = layout.shape
+    counts = layout.sum(-1)
+    W = max(1, int(counts.max()))
+    idx = np.zeros((H, nb, W), np.int32)
+    valid = np.zeros((H, nb, W), bool)
+    for h in range(H):
+        for i in range(nb):
+            nz = np.nonzero(layout[h, i])[0]
+            idx[h, i, :len(nz)] = nz
+            valid[h, i, :len(nz)] = True
+    return idx, valid
+
+
+def block_sparse_attention(q, k, v, layout, block: int,
+                           causal_token_mask: bool = False,
+                           scale=None):
+    """Sparse attention over [B, S, H, D] inputs.
+
+    layout: [H, nb, nb] numpy array (static — from SparsityConfig).
+    causal_token_mask: additionally mask within-block future tokens
+    (unidirectional layouts handle block granularity; this handles the
+    diagonal block's token granularity).
+    """
+    B, S, H, D = q.shape
+    nb = S // block
+    assert S % block == 0
+    assert layout.shape == (H, nb, nb), (layout.shape, (H, nb, nb))
+    scale = (D ** -0.5) if scale is None else scale
+
+    idx_np, valid_np = layout_to_gather(layout)
+    W = idx_np.shape[-1]
+    idx = jnp.asarray(idx_np)
+    valid = jnp.asarray(valid_np)
+
+    # [B, H, nb, blk, D]
+    to_blocks = lambda t: t.transpose(0, 2, 1, 3).reshape(B, H, nb, block, D)
+    qb, kb, vb = to_blocks(q), to_blocks(k), to_blocks(v)
+
+    h_ix = jnp.arange(H)[:, None, None]
+    kg = kb[:, h_ix, idx]  # [B, H, nb, W, blk, D]
+    vg = vb[:, h_ix, idx]
+
+    scores = jnp.einsum("bhiqd,bhiwkd->bhiqwk", qb.astype(jnp.float32),
+                        kg.astype(jnp.float32),
+                        preferred_element_type=jnp.float32) * scale
+
+    mask = valid[None, :, :, None, :, None]  # block-level validity
+    if causal_token_mask:
+        qpos = (jnp.arange(nb)[:, None] * block +
+                jnp.arange(block)[None, :])              # [nb, blk]
+        kpos = idx[..., None] * block + jnp.arange(block)  # [H, nb, W, blk]
+        tok = qpos[None, :, :, None, None] >= kpos[:, :, None, :, :]
+        mask = jnp.logical_and(mask, tok[None])
+    scores = jnp.where(mask, scores, NEG_INF)
+
+    flat = scores.reshape(B, H, nb, block, W * block)
+    probs = jax.nn.softmax(flat, axis=-1).reshape(scores.shape)
+    probs = jnp.where(mask, probs, 0.0)  # fully-masked rows -> zero output
+
+    out = jnp.einsum("bhiqwk,bhiwkd->bhiqd", probs,
+                     vg.astype(jnp.float32),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, H, S, D).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+class SparseSelfAttention:
+    """Module-level wrapper (reference sparse_self_attention.py:14).
+
+    Computes softmax(QK^T)V under a SparsityConfig layout; inputs BSHD.
+    The layout (and its gather indices) is computed once per seq_len and
+    cached — it is static compile-time structure.
+    """
+
+    def __init__(self, sparsity_config: SparsityConfig = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config or SparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layouts = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, query, key, value):
+        B, S, H, D = query.shape
+        layout = self.get_layout(S)
+        causal = getattr(self.sparsity_config, "attention",
+                         "bidirectional") == "unidirectional"
+        return block_sparse_attention(
+            query, key, value, layout, self.sparsity_config.block,
+            causal_token_mask=causal)

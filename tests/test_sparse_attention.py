@@ -1,0 +1,114 @@
+"""Sparse attention tests (mirrors reference tests/unit/test_sparse_attention.py:
+block-sparse results vs dense reference under the layout mask)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, LocalSlidingWindowSparsityConfig,
+    SparseSelfAttention, VariableSparsityConfig, block_sparse_attention,
+    layout_to_gather)
+
+
+def _dense_masked_attention(q, k, v, layout, block, causal_tokens=False):
+    """Reference: dense attention with the block layout expanded to a token
+    mask."""
+    B, S, H, D = q.shape
+    nb = S // block
+    tok_mask = np.kron(np.asarray(layout), np.ones((block, block)))  # [H,S,S]
+    if causal_tokens:
+        tok_mask = tok_mask * np.tril(np.ones((S, S)))
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    scores = jnp.where(jnp.asarray(tok_mask[None]) > 0, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.asarray(tok_mask[None]) > 0, probs, 0.0)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return np.asarray(out)
+
+
+def _qkv(rng, B=2, S=64, H=4, D=16):
+    keys = jax.random.split(rng, 3)
+    return tuple(jax.random.normal(k, (B, S, H, D)) for k in keys)
+
+
+CONFIGS = [
+    FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2),
+    FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                        attention="unidirectional"),
+    VariableSparsityConfig(num_heads=4, block=16, num_random_blocks=1,
+                           local_window_blocks=[1, 2],
+                           global_block_indices=[0]),
+    BigBirdSparsityConfig(num_heads=4, block=16, num_random_blocks=1,
+                          num_sliding_window_blocks=3, num_global_blocks=1),
+    BSLongformerSparsityConfig(num_heads=4, block=16,
+                               num_sliding_window_blocks=3),
+    LocalSlidingWindowSparsityConfig(num_heads=4, block=16,
+                                     num_sliding_window_blocks=3),
+    DenseSparsityConfig(num_heads=4, block=16),
+]
+
+
+@pytest.mark.parametrize("cfg", CONFIGS, ids=lambda c: type(c).__name__)
+def test_block_sparse_matches_masked_dense(cfg):
+    q, k, v = _qkv(jax.random.PRNGKey(0))
+    layout = cfg.make_layout(64)
+    causal = getattr(cfg, "attention", "bidirectional") == "unidirectional"
+    out = block_sparse_attention(q, k, v, layout, cfg.block,
+                                 causal_token_mask=causal)
+    ref = _dense_masked_attention(q, k, v, layout, cfg.block,
+                                  causal_tokens=causal)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=2e-5)
+
+
+def test_layout_shapes_and_propagation():
+    cfg = FixedSparsityConfig(num_heads=8, block=16, num_local_blocks=2)
+    layout = cfg.make_layout(128)
+    assert layout.shape == (8, 8, 8)
+    # same layout across heads when different_layout_per_head=False
+    assert (layout[0] == layout[3]).all()
+
+
+def test_unidirectional_layout_is_lower_triangular():
+    cfg = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2,
+                              attention="unidirectional")
+    layout = cfg.make_layout(96)
+    assert np.triu(layout[0], 1).sum() == 0
+
+
+def test_layout_to_gather_roundtrip():
+    layout = np.zeros((1, 4, 4), np.int64)
+    layout[0, 2, [0, 2]] = 1
+    layout[0, 0, 0] = 1
+    idx, valid = layout_to_gather(layout)
+    assert idx.shape[-1] == 2
+    assert list(idx[0, 2][valid[0, 2]]) == [0, 2]
+    assert valid[0, 1].sum() == 0  # empty row stays invalid
+
+
+def test_sparse_self_attention_module():
+    attn = SparseSelfAttention(
+        FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2,
+                            attention="unidirectional"))
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    out = attn(q, k, v)
+    assert out.shape == q.shape
+    # cached layout reused
+    assert 64 in attn._layouts
+
+
+def test_sparse_grad_flows():
+    cfg = BSLongformerSparsityConfig(num_heads=2, block=16,
+                                     num_sliding_window_blocks=3)
+    q, k, v = _qkv(jax.random.PRNGKey(2), H=2)
+    layout = cfg.make_layout(64)
+
+    def loss(q, k, v):
+        return jnp.sum(block_sparse_attention(q, k, v, layout, 16) ** 2)
+
+    grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
