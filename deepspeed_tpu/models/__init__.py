@@ -1,6 +1,8 @@
 """Model zoo — TPU-native model families (the reference has none in-tree;
 its model tests drive an external Megatron GPT-2, SURVEY.md §1)."""
 
+from .bert import Bert, BertConfig, bert_config, BERT_SIZES
 from .gpt import GPT, GPTConfig, gpt2_config, GPT2_SIZES
 
-__all__ = ["GPT", "GPTConfig", "gpt2_config", "GPT2_SIZES"]
+__all__ = ["GPT", "GPTConfig", "gpt2_config", "GPT2_SIZES",
+           "Bert", "BertConfig", "bert_config", "BERT_SIZES"]

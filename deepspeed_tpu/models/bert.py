@@ -1,0 +1,226 @@
+"""BERT model family, built on the fused DeepSpeedTransformerLayer.
+
+The reference's fused transformer kernels target BERT pretraining
+(BASELINE.md rows 1-3; docs/_tutorials/bert-pretraining.md) but ship no
+in-tree model — tests carry full BERT modeling copies
+(reference tests/unit/modeling.py / modelingpreln.py). Here BERT is a
+first-class in-tree family: embeddings + N fused encoder layers + MLM/NSP
+heads, expressed as a TrainModule so deepspeed_tpu.initialize() drives it
+directly.
+
+TPU-first choices: bf16 activations; one [h,3h] QKV matmul per layer
+(MXU-friendly); tensor parallelism via PartitionSpecs on the `model` axis
+(column-parallel qkv/inter, row-parallel proj/output — XLA inserts psum);
+per-layer rematerialisation behind `remat`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import MODEL_AXIS
+from ..ops.transformer.transformer import (DeepSpeedTransformerConfig,
+                                           init_transformer_params,
+                                           transformer_layer_forward)
+from ..runtime.module import TrainModule
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30528          # 30522 padded to a 64 multiple
+    max_seq_len: int = 512
+    num_layers: int = 12
+    num_heads: int = 12
+    d_model: int = 768
+    d_ff: Optional[int] = None
+    type_vocab_size: int = 2
+    attn_dropout: float = 0.1
+    hidden_dropout: float = 0.1
+    layer_norm_eps: float = 1e-12
+    initializer_range: float = 0.02
+    pre_layer_norm: bool = True      # reference ships both (modelingpreln.py)
+    remat: bool = False
+    attn_impl: str = "auto"
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+
+    def __post_init__(self):
+        if self.d_ff is None:
+            self.d_ff = 4 * self.d_model
+        assert self.d_model % self.num_heads == 0
+
+    def layer_config(self) -> DeepSpeedTransformerConfig:
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.d_model,
+            intermediate_size=self.d_ff,
+            heads=self.num_heads,
+            attn_dropout_ratio=self.attn_dropout,
+            hidden_dropout_ratio=self.hidden_dropout,
+            num_hidden_layers=self.num_layers,
+            initializer_range=self.initializer_range,
+            layer_norm_eps=self.layer_norm_eps,
+            pre_layer_norm=self.pre_layer_norm,
+            attn_impl=self.attn_impl,
+            dtype=self.compute_dtype)
+
+
+# bert-large @ seq 128/512 is the reference's headline benchmark config
+# (docs/_tutorials/bert-pretraining.md:387)
+BERT_SIZES = {
+    "bert-base": dict(num_layers=12, num_heads=12, d_model=768),
+    "bert-large": dict(num_layers=24, num_heads=16, d_model=1024),
+}
+
+
+def bert_config(name: str = "bert-base", **overrides) -> BertConfig:
+    return BertConfig(**{**BERT_SIZES[name], **overrides})
+
+
+class Bert(TrainModule):
+    """Masked-LM + next-sentence-prediction BERT.
+
+    batch dict: input_ids [B,S], token_type_ids [B,S] (optional),
+    attention_mask [B,S] 1=keep (optional), mlm_labels [B,S] with -100 at
+    unmasked positions, nsp_labels [B] (optional).
+    """
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.param_specs = self._build_param_specs()
+
+    # ------------------------------------------------------------------
+    def init(self, rng):
+        cfg = self.config
+        pd = cfg.param_dtype
+        k_emb, k_layers, k_pool = jax.random.split(rng, 3)
+        std = cfg.initializer_range
+        n = lambda k, s: (std * jax.random.normal(k, s)).astype(pd)
+        ke = jax.random.split(k_emb, 3)
+        layer_cfg = cfg.layer_config()
+        layers = [init_transformer_params(layer_cfg, k, pd)
+                  for k in jax.random.split(k_layers, cfg.num_layers)]
+        kp = jax.random.split(k_pool, 3)
+        return {
+            "embeddings": {
+                "word": n(ke[0], (cfg.vocab_size, cfg.d_model)),
+                "position": n(ke[1], (cfg.max_seq_len, cfg.d_model)),
+                "token_type": n(ke[2], (cfg.type_vocab_size, cfg.d_model)),
+                "ln_w": jnp.ones((cfg.d_model,), pd),
+                "ln_b": jnp.zeros((cfg.d_model,), pd),
+            },
+            "layers": layers,
+            "final_ln_w": jnp.ones((cfg.d_model,), pd),
+            "final_ln_b": jnp.zeros((cfg.d_model,), pd),
+            "pooler": {"w": n(kp[0], (cfg.d_model, cfg.d_model)),
+                       "b": jnp.zeros((cfg.d_model,), pd)},
+            "mlm_head": {"w": n(kp[1], (cfg.d_model, cfg.d_model)),
+                         "b": jnp.zeros((cfg.d_model,), pd),
+                         "ln_w": jnp.ones((cfg.d_model,), pd),
+                         "ln_b": jnp.zeros((cfg.d_model,), pd),
+                         "decoder_b": jnp.zeros((cfg.vocab_size,), pd)},
+            "nsp_head": {"w": n(kp[2], (cfg.d_model, 2)),
+                         "b": jnp.zeros((2,), pd)},
+        }
+
+    def _build_param_specs(self):
+        """Megatron-style TP over the `model` axis for the per-layer
+        matrices; embeddings vocab-parallel."""
+        m = MODEL_AXIS
+        layer = {
+            "attn_qkvw": P(None, m), "attn_qkvb": P(m),
+            "attn_ow": P(m, None), "attn_ob": P(),
+            "attn_nw": P(), "attn_nb": P(),
+            "inter_w": P(None, m), "inter_b": P(m),
+            "output_w": P(m, None), "output_b": P(),
+            "norm_w": P(), "norm_b": P(),
+        }
+        return {
+            "embeddings": {"word": P(m, None), "position": P(),
+                           "token_type": P(), "ln_w": P(), "ln_b": P()},
+            "layers": [dict(layer) for _ in range(self.config.num_layers)],
+            "final_ln_w": P(), "final_ln_b": P(),
+            "pooler": {"w": P(), "b": P()},
+            "mlm_head": {"w": P(), "b": P(), "ln_w": P(), "ln_b": P(),
+                         "decoder_b": P(m)},
+            "nsp_head": {"w": P(), "b": P()},
+        }
+
+    # ------------------------------------------------------------------
+    def _ln(self, x, w, b):
+        eps = self.config.layer_norm_eps
+        xf = x.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+        y = (xf - mu) * jax.lax.rsqrt(var + eps)
+        return (y * w.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+    def encode(self, params, input_ids, token_type_ids=None,
+               attention_mask=None, rng=None, train=False):
+        cfg = self.config
+        dtype = cfg.compute_dtype
+        B, S = input_ids.shape
+        emb = params["embeddings"]
+        x = emb["word"][input_ids] + emb["position"][:S][None, :, :]
+        if token_type_ids is not None:
+            x = x + emb["token_type"][token_type_ids]
+        x = self._ln(x.astype(dtype), emb["ln_w"], emb["ln_b"])
+
+        bias = None
+        if attention_mask is not None:
+            # additive mask broadcastable to [B, heads, S, S]
+            bias = (1.0 - attention_mask[:, None, None, :].astype(
+                jnp.float32)) * jnp.finfo(jnp.float32).min
+
+        layer_cfg = cfg.layer_config()
+        rngs = (jax.random.split(rng, cfg.num_layers)
+                if rng is not None else [None] * cfg.num_layers)
+
+        def block(x, lp, r):
+            return transformer_layer_forward(
+                lp, x, bias, config=layer_cfg, rng=r, train=train)
+
+        if cfg.remat:
+            block = jax.checkpoint(block)
+        for lp, r in zip(params["layers"], rngs):
+            x = block(x, lp, r)
+        if cfg.pre_layer_norm:
+            x = self._ln(x, params["final_ln_w"], params["final_ln_b"])
+        return x
+
+    def apply(self, params, batch, rng=None, train=False):
+        x = self.encode(params, batch["input_ids"],
+                        batch.get("token_type_ids"),
+                        batch.get("attention_mask"), rng=rng, train=train)
+        mh = params["mlm_head"]
+        h = jax.nn.gelu(x @ mh["w"].astype(x.dtype) + mh["b"].astype(x.dtype),
+                        approximate=True)
+        h = self._ln(h, mh["ln_w"], mh["ln_b"])
+        # tied decoder: embeddings.word^T (reference BERT ties MLM decoder)
+        logits = h @ params["embeddings"]["word"].astype(x.dtype).T + \
+            mh["decoder_b"].astype(x.dtype)
+        pooled = jnp.tanh(x[:, 0, :] @ params["pooler"]["w"].astype(x.dtype) +
+                          params["pooler"]["b"].astype(x.dtype))
+        nsp = pooled @ params["nsp_head"]["w"].astype(x.dtype) + \
+            params["nsp_head"]["b"].astype(x.dtype)
+        return logits, nsp
+
+    def loss(self, params, batch, rng=None, train=True):
+        logits, nsp = self.apply(params, batch, rng=rng, train=train)
+        labels = batch["mlm_labels"]
+        mask = (labels != -100)
+        safe = jnp.where(mask, labels, 0)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(mask.sum(), 1)
+        loss = jnp.where(mask, nll, 0.0).sum() / denom
+        if "nsp_labels" in batch:
+            nsp_logp = jax.nn.log_softmax(nsp.astype(jnp.float32), axis=-1)
+            loss = loss - jnp.mean(
+                jnp.take_along_axis(nsp_logp,
+                                    batch["nsp_labels"][:, None], axis=-1))
+        return loss

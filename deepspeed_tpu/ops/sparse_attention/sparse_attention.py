@@ -45,13 +45,20 @@ def layout_to_gather(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 
 def block_sparse_attention(q, k, v, layout, block: int,
                            causal_token_mask: bool = False,
-                           scale=None):
+                           scale=None, key_padding_bias=None,
+                           attn_bias=None):
     """Sparse attention over [B, S, H, D] inputs.
 
     layout: [H, nb, nb] numpy array (static — from SparsityConfig).
     causal_token_mask: additionally mask within-block future tokens
     (unidirectional layouts handle block granularity; this handles the
     diagonal block's token granularity).
+    key_padding_bias: [B, S] additive fp32 bias on key positions
+    (large-negative at padded keys).
+    attn_bias: [S, S] or [Hb, S, S] additive bias (relative position
+    embeddings / arbitrary attention masks, reference
+    sparse_self_attention.py forward rpe/attn_mask); gathered along the
+    key axis with the same static indices as K/V.
     """
     B, S, H, D = q.shape
     nb = S // block
@@ -75,6 +82,21 @@ def block_sparse_attention(q, k, v, layout, block: int,
     scores = jnp.einsum("bhiqd,bhiwkd->bhiqwk", qb.astype(jnp.float32),
                         kg.astype(jnp.float32),
                         preferred_element_type=jnp.float32) * scale
+
+    if key_padding_bias is not None:
+        kpb = jnp.asarray(key_padding_bias, jnp.float32) \
+            .reshape(B, nb, block)[:, idx]          # [B, H, nb, W, blk]
+        scores = scores + kpb[:, :, :, None, :, :]
+    if attn_bias is not None:
+        ab = jnp.asarray(attn_bias, jnp.float32)
+        if ab.ndim == 2:
+            ab = ab[None]
+        # [Hb, nb, blk_q, nb, blk_k] -> gather key blocks per (h, i, w)
+        abb = ab.reshape(ab.shape[0], nb, block, nb, block)
+        abb = abb[jnp.arange(H) % ab.shape[0]]      # broadcast heads
+        gathered = jnp.take_along_axis(
+            abb, idx[:, :, None, :, None], axis=3)  # [H, nb, blk_q, W, blk_k]
+        scores = scores + gathered[None]
 
     mask = valid[None, :, :, None, :, None]  # block-level validity
     if causal_token_mask:
@@ -116,11 +138,34 @@ class SparseSelfAttention:
             self._layouts[seq_len] = self.sparsity_config.make_layout(seq_len)
         return self._layouts[seq_len]
 
-    def __call__(self, query, key, value):
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        """reference sparse_self_attention.py forward(query, key, value,
+        rpe, key_padding_mask, attn_mask). Masks follow the configured
+        modes: "add" = already-additive float bias, "mul" = 0/1 keep
+        mask converted to additive large-negative."""
         B, S, H, D = query.shape
         layout = self.get_layout(S)
         causal = getattr(self.sparsity_config, "attention",
                          "bidirectional") == "unidirectional"
+
+        def to_additive(m, mode):
+            m = jnp.asarray(m)
+            if mode == "mul" or m.dtype == jnp.bool_:
+                return (1.0 - m.astype(jnp.float32)) * NEG_INF
+            return m.astype(jnp.float32)
+
+        key_padding_bias = None
+        if key_padding_mask is not None:
+            key_padding_bias = to_additive(key_padding_mask,
+                                           self.key_padding_mask_mode)
+        attn_bias = None
+        if attn_mask is not None:
+            attn_bias = to_additive(attn_mask, self.attn_mask_mode)
+        if rpe is not None:
+            rpe = jnp.asarray(rpe, jnp.float32)
+            attn_bias = rpe if attn_bias is None else attn_bias + rpe
         return block_sparse_attention(
             query, key, value, layout, self.sparsity_config.block,
-            causal_token_mask=causal)
+            causal_token_mask=causal, key_padding_bias=key_padding_bias,
+            attn_bias=attn_bias)

@@ -52,6 +52,21 @@ class DenseSparsityConfig(SparsityConfig):
         return layout
 
 
+def _set_sliding_window(h: int, layout: np.ndarray,
+                        num_sliding_window_blocks: int) -> np.ndarray:
+    """Symmetric block sliding window around the diagonal (shared by
+    BigBird / BSLongformer / LocalSlidingWindow configs)."""
+    nb = layout.shape[1]
+    if num_sliding_window_blocks > nb:
+        raise ValueError("window wider than the sequence")
+    w = num_sliding_window_blocks // 2
+    for row in range(nb):
+        lo = max(0, row - w)
+        hi = min(nb, row + w + 1)
+        layout[h, row, lo:hi] = 1
+    return layout
+
+
 def _apply_unidirectional(layout: np.ndarray) -> np.ndarray:
     """Zero the strict upper block-triangle (autoregressive masking)."""
     nb = layout.shape[1]
@@ -104,14 +119,21 @@ class FixedSparsityConfig(SparsityConfig):
         if self.num_global_blocks == 0:
             return layout
         # representative blocks: a num_global_blocks-wide slice of each
-        # local window, version selected per head pattern
+        # local window, version selected per head pattern (reference
+        # sparsity_config.py:176-224). Vertical global attention is visible
+        # to ALL rows; make_layout's trailing tril restores causality for
+        # unidirectional attention.
         version = h % self.num_different_global_patterns
         first = (self.num_local_blocks -
                  (version + 1) * self.num_global_blocks)
-        for start in range(first, nb, self.num_local_blocks):
+        full_end = nb - (nb % self.num_local_blocks)
+        starts = list(range(first, full_end, self.num_local_blocks))
+        if full_end < nb:  # short last window still gets a representative
+            starts.append(max(0, min(full_end + first,
+                                     nb - self.num_global_blocks)))
+        for start in starts:
             end = min(start + self.num_global_blocks, nb)
-            # vertical: every later block attends to the representatives
-            layout[h, start:, start:end] = 1
+            layout[h, :, start:end] = 1
             if self.horizontal_global_attention:
                 layout[h, start:end, :] = 1
         return layout
@@ -241,15 +263,7 @@ class BigBirdSparsityConfig(SparsityConfig):
         return layout
 
     def set_sliding_window_layout(self, h, layout):
-        nb = layout.shape[1]
-        if self.num_sliding_window_blocks > nb:
-            raise ValueError("window wider than the sequence")
-        w = self.num_sliding_window_blocks // 2
-        for row in range(nb):
-            lo = max(0, row - w)
-            hi = min(nb, row + w + 1)
-            layout[h, row, lo:hi] = 1
-        return layout
+        return _set_sliding_window(h, layout, self.num_sliding_window_blocks)
 
     def set_global_layout_itc(self, h, layout):
         nb = layout.shape[1]
@@ -293,7 +307,7 @@ class BSLongformerSparsityConfig(SparsityConfig):
         self.attention = attention
 
     def set_sliding_window_layout(self, h, layout):
-        return BigBirdSparsityConfig.set_sliding_window_layout(self, h, layout)
+        return _set_sliding_window(h, layout, self.num_sliding_window_blocks)
 
     def set_global_layout(self, h, layout):
         nb = layout.shape[1]
@@ -332,13 +346,8 @@ class LocalSlidingWindowSparsityConfig(SparsityConfig):
 
     def make_layout(self, seq_len):
         layout = self.setup_layout(seq_len)
-        nb = layout.shape[1]
-        w = self.num_sliding_window_blocks // 2
         for h in range(self.num_layout_heads):
-            for row in range(nb):
-                lo = max(0, row - w)
-                hi = min(nb, row + w + 1)
-                layout[h, row, lo:hi] = 1
+            _set_sliding_window(h, layout, self.num_sliding_window_blocks)
         layout = self.check_and_propagate_first_head_layout(layout)
         if self.attention == "unidirectional":
             layout = _apply_unidirectional(layout)

@@ -1,4 +1,10 @@
 from .attention import multihead_attention, xla_attention
 from .flash_attention import flash_attention
+from .transformer import (DeepSpeedTransformerConfig,
+                          DeepSpeedTransformerLayer,
+                          init_transformer_params,
+                          transformer_layer_forward)
 
-__all__ = ["multihead_attention", "xla_attention", "flash_attention"]
+__all__ = ["multihead_attention", "xla_attention", "flash_attention",
+           "DeepSpeedTransformerConfig", "DeepSpeedTransformerLayer",
+           "init_transformer_params", "transformer_layer_forward"]

@@ -72,7 +72,10 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
     want_dropout = train and dropout_rate > 0.0 and dropout_rng is not None
     use_pallas = False
     if impl == "pallas":
-        use_pallas = True
+        # the flash kernel carries no bias/probability-dropout; honoring
+        # those args wins over the impl request (silent mask-dropping is
+        # numerically wrong)
+        use_pallas = not (want_dropout or bias is not None)
     elif impl == "auto":
         use_pallas = (_on_tpu() and not want_dropout and bias is None
                       and S >= _FLASH_MIN_SEQ and S % 128 == 0

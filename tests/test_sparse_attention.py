@@ -112,3 +112,58 @@ def test_sparse_grad_flows():
     grads = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
     for g in grads:
         assert np.isfinite(np.asarray(g)).all()
+
+
+def test_fixed_global_columns_visible_to_all_rows():
+    """Bidirectional Fixed layout: representative (global) columns are
+    visible from EVERY query row, including rows before the window
+    (reference sparsity_config.py:196-199 first_row=0)."""
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(16 * 8)  # 8 blocks, windows of 4
+    # representative of the SECOND window is column 7; row 0 must see it
+    assert layout[0, 0, 7] == 1
+    assert layout[0, 1, 3] == 1  # first window's representative
+
+
+def test_fixed_global_short_last_window():
+    """A trailing partial window still gets a representative column."""
+    cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=4,
+                              num_global_blocks=1)
+    layout = cfg.make_layout(16 * 6)  # 6 blocks: one full window + 2 extra
+    # short window (blocks 4-5) representative clamped to nb-1 = 5
+    assert layout[0, :, 5].all()
+    # shorter than one window: global column still set
+    tiny = cfg.make_layout(16 * 2)
+    assert tiny[0, :, 1].all()
+
+
+def test_key_padding_mask_blocks_padded_keys():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4)
+    attn = SparseSelfAttention(cfg, key_padding_mask_mode="mul")
+    q, k, v = _qkv(jax.random.PRNGKey(1))
+    keep = np.ones((2, 64), np.float32)
+    keep[:, 48:] = 0.0  # pad the last block
+    out = np.asarray(attn(q, k, v, key_padding_mask=keep))
+    # perturb padded keys/values: unpadded outputs must not change
+    k2 = np.asarray(k).copy(); k2[:, 48:] = 9.0
+    v2 = np.asarray(v).copy(); v2[:, 48:] = -9.0
+    out2 = np.asarray(attn(jnp.asarray(q), jnp.asarray(k2), jnp.asarray(v2),
+                           key_padding_mask=keep))
+    np.testing.assert_allclose(out[:, :48], out2[:, :48], rtol=1e-5, atol=1e-6)
+
+
+def test_rpe_and_attn_mask_change_scores():
+    cfg = FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=4)
+    attn = SparseSelfAttention(cfg)
+    q, k, v = _qkv(jax.random.PRNGKey(2))
+    base = np.asarray(attn(q, k, v))
+    rpe = 0.5 * np.asarray(
+        jax.random.normal(jax.random.PRNGKey(3), (64, 64)))
+    with_rpe = np.asarray(attn(q, k, v, rpe=rpe))
+    assert not np.allclose(base, with_rpe)
+    # additive attn mask fully blocking keys 32.. for queries < 32
+    m = np.zeros((64, 64), np.float32)
+    m[:32, 32:] = -1e30
+    masked = np.asarray(attn(q, k, v, attn_mask=m))
+    assert np.isfinite(masked).all()
