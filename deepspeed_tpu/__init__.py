@@ -8,7 +8,9 @@ are sharding specs, kernels are Pallas/XLA.
 
 from .version import __version__, git_hash  # noqa: F401
 from . import comm  # noqa: F401
+from . import module_inject  # noqa: F401
 from .comm import init_distributed  # noqa: F401
+from .runtime.activation_checkpointing import checkpointing  # noqa: F401
 
 __git_hash__ = git_hash
 __git_branch__ = "main"

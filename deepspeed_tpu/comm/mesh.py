@@ -147,6 +147,12 @@ def set_current_mesh(info: MeshInfo) -> None:
     _CURRENT_MESH = info
 
 
+def peek_mesh() -> Optional["MeshInfo"]:
+    """Current mesh or None — never constructs one (unlike
+    get_current_mesh)."""
+    return _CURRENT_MESH
+
+
 def get_current_mesh() -> MeshInfo:
     global _CURRENT_MESH
     if _CURRENT_MESH is None:

@@ -1,0 +1,6 @@
+from .replace_module import (HFBertLayerPolicy, InjectionPolicy,
+                             replace_module, replace_transformer_layer,
+                             revert_transformer_layer)
+
+__all__ = ["InjectionPolicy", "HFBertLayerPolicy", "replace_module",
+           "replace_transformer_layer", "revert_transformer_layer"]

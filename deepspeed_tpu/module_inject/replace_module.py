@@ -1,0 +1,199 @@
+"""Kernel injection: swap user transformer layers for the fused layer.
+
+Reference: deepspeed/module_inject/replace_module.py:6-193
+(replace_transformer_layer, generic replace_module policy walker :161-193)
+and inject.py:6-121. The reference mutates a torch module tree, moving each
+HF/Megatron layer's weights into a DeepSpeedTransformerLayer and back
+(revert). Here models are params PYTREES, so injection is a pure tree
+transformation: a policy recognizes a layer's param subtree by shape/keys
+and converts it to the fused layer's 12-tensor dict (transformer.py param
+names), or back. The model then runs those params through
+transformer_layer_forward — same capability (run HF weights on the fused
+kernel path), no monkey-patching.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..ops.transformer.transformer import DeepSpeedTransformerConfig
+
+FUSED_KEYS = ("attn_qkvw", "attn_qkvb", "attn_ow", "attn_ob", "attn_nw",
+              "attn_nb", "inter_w", "inter_b", "output_w", "output_b",
+              "norm_w", "norm_b")
+
+
+class InjectionPolicy:
+    """Recognize + convert one layer family. Subclasses implement:
+
+    matches(subtree) -> bool             does this dict hold one layer?
+    convert(subtree) -> fused dict       -> transformer.py param names
+    revert(fused) -> subtree             inverse mapping
+    layer_config_overrides() -> dict     e.g. pre_layer_norm for the family
+    """
+
+    def matches(self, subtree: Dict[str, Any]) -> bool:
+        raise NotImplementedError
+
+    def convert(self, subtree: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def revert(self, fused: Dict[str, Any]) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def layer_config_overrides(self) -> Dict[str, Any]:
+        return {}
+
+
+def _dense(kernel, bias, transpose):
+    k = jnp.asarray(kernel)
+    return (k.T if transpose else k), jnp.asarray(bias)
+
+
+class HFBertLayerPolicy(InjectionPolicy):
+    """HuggingFace BERT encoder layer (reference replace_module.py:12-63
+    HFBertLayerPolicy).
+
+    Recognizes the flax layout
+      {attention: {self: {query,key,value}, output: {dense, LayerNorm}},
+       intermediate: {dense}, output: {dense, LayerNorm}}
+    with [in, out] kernels (set torch_layout=True for [out, in] weights
+    from a torch state dict). HF BERT is post-LN.
+    """
+
+    def __init__(self, torch_layout: bool = False):
+        self.torch_layout = torch_layout
+
+    @staticmethod
+    def _get(d, *names):
+        for n in names:
+            if n in d:
+                return d[n]
+        raise KeyError(names)
+
+    def matches(self, t) -> bool:
+        try:
+            return ("attention" in t and "intermediate" in t
+                    and "output" in t and "self" in t["attention"])
+        except TypeError:
+            return False
+
+    def _wb(self, d):
+        w = self._get(d, "kernel", "weight")
+        b = self._get(d, "bias")
+        return _dense(w, b, self.torch_layout or "weight" in d)
+
+    def _ln(self, d):
+        return (jnp.asarray(self._get(d, "scale", "weight", "gamma")),
+                jnp.asarray(self._get(d, "bias", "beta")))
+
+    def convert(self, t):
+        sa = t["attention"]["self"]
+        qw, qb = self._wb(sa["query"])
+        kw, kb = self._wb(sa["key"])
+        vw, vb = self._wb(sa["value"])
+        ow, ob = self._wb(t["attention"]["output"]["dense"])
+        anw, anb = self._ln(t["attention"]["output"]["LayerNorm"])
+        iw, ib = self._wb(t["intermediate"]["dense"])
+        pw, pb = self._wb(t["output"]["dense"])
+        nw, nb = self._ln(t["output"]["LayerNorm"])
+        return {
+            "attn_qkvw": jnp.concatenate([qw, kw, vw], axis=-1),
+            "attn_qkvb": jnp.concatenate([qb, kb, vb], axis=-1),
+            "attn_ow": ow, "attn_ob": ob,
+            "attn_nw": anw, "attn_nb": anb,
+            "inter_w": iw, "inter_b": ib,
+            "output_w": pw, "output_b": pb,
+            "norm_w": nw, "norm_b": nb,
+        }
+
+    def revert(self, fused):
+        qw, kw, vw = jnp.split(jnp.asarray(fused["attn_qkvw"]), 3, axis=-1)
+        qb, kb, vb = jnp.split(jnp.asarray(fused["attn_qkvb"]), 3, axis=-1)
+        mk = (lambda w: w.T) if self.torch_layout else (lambda w: w)
+        kkey = "weight" if self.torch_layout else "kernel"
+        skey = "weight" if self.torch_layout else "scale"
+        dense = lambda w, b: {kkey: mk(w), "bias": b}
+        ln = lambda w, b: {skey: w, "bias": b}
+        return {
+            "attention": {
+                "self": {"query": dense(qw, qb), "key": dense(kw, kb),
+                         "value": dense(vw, vb)},
+                "output": {"dense": dense(fused["attn_ow"], fused["attn_ob"]),
+                           "LayerNorm": ln(fused["attn_nw"],
+                                           fused["attn_nb"])},
+            },
+            "intermediate": {"dense": dense(fused["inter_w"],
+                                            fused["inter_b"])},
+            "output": {"dense": dense(fused["output_w"], fused["output_b"]),
+                       "LayerNorm": ln(fused["norm_w"], fused["norm_b"])},
+        }
+
+    def layer_config_overrides(self):
+        return {"pre_layer_norm": False}  # HF BERT is post-LN
+
+
+def replace_module(params: Any, policy: InjectionPolicy,
+                   _path: Tuple = ()) -> Tuple[Any, List[Tuple]]:
+    """Generic walker (reference replace_module.py:161-193): descend the
+    params tree; whenever `policy.matches` a subtree, replace it with the
+    converted fused dict. Returns (new_tree, list of replaced paths)."""
+    replaced = []
+    if isinstance(params, dict):
+        if policy.matches(params):
+            return policy.convert(params), [_path]
+        out = {}
+        for key, sub in params.items():
+            out[key], r = replace_module(sub, policy, _path + (key,))
+            replaced.extend(r)
+        return out, replaced
+    if isinstance(params, (list, tuple)):
+        out = []
+        for i, sub in enumerate(params):
+            new, r = replace_module(sub, policy, _path + (i,))
+            out.append(new)
+            replaced.extend(r)
+        return type(params)(out), replaced
+    return params, replaced
+
+
+def replace_transformer_layer(policy: InjectionPolicy, params: Any,
+                              config: Optional[DeepSpeedTransformerConfig]
+                              = None):
+    """reference replace_module.py:66-145. Returns (new_params, layer_config,
+    replaced_paths): params with every recognized layer subtree converted to
+    fused-layer params, plus the DeepSpeedTransformerConfig to run them with
+    (family overrides applied, e.g. post-LN for HF BERT)."""
+    new_params, replaced = replace_module(params, policy)
+    if config is not None:
+        for k, v in policy.layer_config_overrides().items():
+            setattr(config, k, v)
+    return new_params, config, replaced
+
+
+def revert_transformer_layer(policy: InjectionPolicy, params: Any):
+    """Inverse of replace_transformer_layer (reference
+    replace_module.py:148-158): fused dicts -> original family layout."""
+
+    def walk(t):
+        if isinstance(t, dict):
+            if all(k in t for k in FUSED_KEYS):
+                return policy.revert(t), 1
+            out, n = {}, 0
+            for key, sub in t.items():
+                out[key], m = walk(sub)
+                n += m
+            return out, n
+        if isinstance(t, (list, tuple)):
+            outs, n = [], 0
+            for sub in t:
+                new, m = walk(sub)
+                outs.append(new)
+                n += m
+            return type(t)(outs), n
+        return t, 0
+
+    reverted, _n = walk(params)
+    return reverted

@@ -1,0 +1,229 @@
+"""Activation checkpointing — TPU-native rematerialisation.
+
+Reference: deepspeed/runtime/activation_checkpointing/checkpointing.py
+(CheckpointFunction :418-472, configure :687-746, partitioning math
+:240-292, CUDA RNG tracker :98-197). The reference re-implements
+torch.utils.checkpoint with three extras: (a) saved inputs partitioned
+across model-parallel ranks, (b) optional CPU offload of the saved
+tensors, (c) a fork-able CUDA RNG tracker so dropout patterns match
+between the original forward and the recompute.
+
+TPU mapping:
+* checkpoint(fn, *args) -> jax.checkpoint: XLA re-runs the forward in the
+  backward pass; "what to save" is a remat policy, not autograd surgery.
+* partition_activations -> the saved inputs get a sharding constraint over
+  the `model` mesh axis (each rank materialises 1/mp of every saved
+  activation — same memory effect as reference :240-292's scatter +
+  backward all-gather, but XLA inserts the collectives).
+* cpu_checkpointing -> remat policy offloading saved residuals to
+  pinned_host memory (TPU runtime streams them back for the backward).
+* RNG correctness is free: jax.checkpoint replays the SAME functional
+  PRNG keys in the recompute, so the reference's CudaRNGStatesTracker
+  machinery (:98-197) has no TPU equivalent to build. A tracker-shaped
+  shim is provided for API parity.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...comm.mesh import MODEL_AXIS, peek_mesh
+from ...utils.logging import logger
+
+# module-level configuration (reference keeps the same globals :60-96)
+_CONFIG = {
+    "configured": False,
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,  # accepted no-op: XLA layout
+    "synchronize": False,                     # accepted no-op: XLA ordering
+    "profile": False,
+    "num_checkpoints": None,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """reference checkpointing.py:687-746 (same keyword surface)."""
+    cfg = None
+    if deepspeed_config is not None:
+        cfg = getattr(deepspeed_config, "activation_checkpointing_config",
+                      None)
+    if cfg is not None:
+        _CONFIG.update(
+            partition_activations=cfg.partition_activations,
+            cpu_checkpointing=cfg.cpu_checkpointing,
+            contiguous_memory_optimization=cfg.contiguous_memory_optimization,
+            synchronize=cfg.synchronize_checkpoint_boundary,
+            profile=cfg.profile,
+            num_checkpoints=cfg.number_checkpoints)
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization",
+                      contiguous_checkpointing),
+                     ("num_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize),
+                     ("profile", profile)):
+        if val is not None:
+            _CONFIG[key] = val
+    _CONFIG["configured"] = True
+
+
+def is_configured() -> bool:
+    return _CONFIG["configured"]
+
+
+def reset():
+    """reference checkpointing.py:668-684 (buffer reset; here: config)."""
+    _CONFIG.update(configured=False, partition_activations=False,
+                   cpu_checkpointing=False, num_checkpoints=None)
+
+
+def _partition_spec_for(x) -> Optional[PartitionSpec]:
+    """Shard the largest divisible dim over the model axis (the reference
+    flattens and scatters 1/mp per rank, :240-292; sharding a whole dim is
+    the XLA-friendly equivalent)."""
+    info = peek_mesh()
+    mesh = info.mesh if info is not None else None
+    if mesh is None or MODEL_AXIS not in mesh.shape:
+        return None
+    mp = mesh.shape[MODEL_AXIS]
+    if mp <= 1 or x.ndim == 0:
+        return None
+    for dim in range(x.ndim):
+        if x.shape[dim] % mp == 0 and x.shape[dim] >= mp:
+            spec = [None] * x.ndim
+            spec[dim] = MODEL_AXIS
+            return PartitionSpec(*spec)
+    return None
+
+
+def _constrain_tree(tree):
+    def put(x):
+        if not hasattr(x, "ndim"):
+            return x
+        spec = _partition_spec_for(x)
+        if spec is None:
+            return x
+        sharding = NamedSharding(peek_mesh().mesh, spec)
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def _remat_policy():
+    if _CONFIG["cpu_checkpointing"]:
+        try:
+            # save nothing on-device; offloadable residuals go to host
+            return jax.checkpoint_policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:  # pragma: no cover - older jax
+            logger.warning("cpu_checkpointing: offload policy unavailable; "
+                           "falling back to full rematerialisation")
+    return None  # default policy: save inputs only, recompute the rest
+
+
+def checkpoint(function, *args):
+    """reference checkpointing.py:748-759 `checkpoint(function, *args)`.
+
+    Returns function(*args) with rematerialisation in the backward pass.
+    With partition_activations configured, the checkpoint boundary inputs
+    (= the saved tensors) carry a model-axis sharding constraint.
+    """
+    fn = function
+    if _CONFIG["partition_activations"]:
+        inner = function
+
+        def fn(*a):  # noqa: F811 - deliberate wrapper
+            return inner(*_constrain_tree(a))
+
+        args = _constrain_tree(args)
+    policy = _remat_policy()
+    kwargs = {"policy": policy} if policy is not None else {}
+    return jax.checkpoint(fn, **kwargs)(*args)
+
+
+def checkpoint_wrapper(function):
+    """Decorator form: returns a rematerialising version of `function`."""
+
+    def wrapped(*args):
+        return checkpoint(function, *args)
+
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# RNG tracker shims (reference :98-237). JAX PRNG keys are explicit values
+# replayed identically during recompute, so these exist for API parity and
+# for deriving distinct-but-deterministic per-model-parallel-rank keys.
+# ---------------------------------------------------------------------------
+
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+class RNGStatesTracker:
+    """Key registry keyed by name (reference CudaRNGStatesTracker :110)."""
+
+    def __init__(self):
+        self.states_ = {}
+
+    def reset(self):
+        self.states_ = {}
+
+    def get_states(self):
+        return dict(self.states_)
+
+    def set_states(self, states):
+        self.states_ = dict(states)
+
+    def add(self, name, seed):
+        if name in self.states_:
+            raise Exception(f"rng state {name} already exists")
+        self.states_[name] = jax.random.PRNGKey(seed)
+
+    def fork(self, name=_MODEL_PARALLEL_RNG):
+        """Split the named key; returns the fresh subkey (functional analog
+        of the reference's context-manager fork :166-197)."""
+        if name not in self.states_:
+            raise Exception(f"rng state {name} is not added")
+        self.states_[name], sub = jax.random.split(self.states_[name])
+        return sub
+
+
+_RNG_TRACKER = RNGStatesTracker()
+
+
+def get_cuda_rng_tracker() -> RNGStatesTracker:  # parity name
+    return _RNG_TRACKER
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """reference checkpointing.py:198-237: seed the model-parallel stream
+    offset by the mp rank so parallel regions (dropout) differ per rank
+    while the default stream stays identical."""
+    info = peek_mesh()
+    mp_rank = 0
+    if info is not None and MODEL_AXIS in info.mesh.shape:
+        # single-controller: derive rank 0's offset; per-device offsets come
+        # from folding the axis index inside shard_map'd code
+        mp_rank = 0
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, seed + 2718 + mp_rank)
+    return _RNG_TRACKER
+
+
+def model_parallel_rng_key(base_key, axis: str = MODEL_AXIS):
+    """Inside shard_map/jit: per-model-parallel-rank key (fold in the axis
+    index) — the functional version of the reference's per-rank seed."""
+    try:
+        idx = jax.lax.axis_index(axis)
+    except NameError:
+        idx = 0
+    return jax.random.fold_in(base_key, idx)

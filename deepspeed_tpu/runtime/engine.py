@@ -47,6 +47,7 @@ from .lr_schedules import SCHEDULERS
 from .module import TrainModule
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import ThroughputTimer, clip_grad_norm, has_overflow
+from ..utils.timer import SynchronizedWallClockTimer
 from .zero.partition import ZeroShardingPlan
 
 DTYPES = {"float32": jnp.float32, "float16": jnp.float16,
@@ -147,6 +148,18 @@ class DeepSpeedEngine:
             steps_per_output=self.steps_per_print() or 50)
         self._step_fns = self._build_step_fns()
         self._last_lr = self._current_lr()
+
+        # observability (reference engine.py:177-181, 966-1019, 1058-1068)
+        self.timers = SynchronizedWallClockTimer()
+        self.wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
+        self.monitor = None
+        if self._config.tensorboard_enabled and comm.get_rank() == 0:
+            from ..utils.tensorboard import TensorBoardMonitor
+            self.monitor = TensorBoardMonitor(
+                self._config.tensorboard_output_path,
+                self._config.tensorboard_job_name)
+        self._flops_profiled = False
+        self._last_loss = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -353,11 +366,43 @@ class DeepSpeedEngine:
         theta = jnp.asarray(
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop else 1.0, jnp.float32)
+        profiling = self._maybe_profile_flops(batch, rng, theta)
+        if self.wall_clock_breakdown:
+            self.timers("forward").start()
         loss, self._grad_acc = self._step_fns["micro"](
             self._params, self._grad_acc, batch, rng,
             self._scaler_state["cur_scale"], theta)
+        if self.wall_clock_breakdown:
+            # one fused fwd+bwd program: this IS forward+backward time
+            self.timers("forward").stop(sync=loss)
+        if profiling is not None:
+            profiling.stop_profile(params=self._params, sync=loss)
+            profiling.stats.update(self._flops_stats)
+            profiling.print_model_profile(
+                profile_step=self.global_steps,
+                top_modules=self._config.flops_profiler_config.top_modules,
+                detailed=self._config.flops_profiler_config.detailed)
         self._cached = loss
+        self._last_loss = loss
         return loss
+
+    def _maybe_profile_flops(self, batch, rng, theta):
+        """FLOPS profiler hook (reference engine.py:966-1019): at
+        profile_step, statically analyze the jitted micro-step and time
+        this invocation."""
+        cfg = self._config.flops_profiler_config
+        if not cfg.enabled or self._flops_profiled or \
+                self.global_steps != cfg.profile_step:
+            return None
+        from ..profiling.flops_profiler.profiler import (FlopsProfiler,
+                                                         analyze_fn)
+        self._flops_profiled = True
+        self._flops_stats = analyze_fn(
+            self._step_fns["micro"], self._params, self._grad_acc, batch,
+            rng, self._scaler_state["cur_scale"], theta)
+        prof = FlopsProfiler()
+        prof.start_profile()
+        return prof
 
     def backward(self, loss=None, allreduce_gradients=True):
         """Gradients were produced in forward(); this advances the
@@ -379,6 +424,8 @@ class DeepSpeedEngine:
             return
         if self._offload is not None:
             return self._offload_step()
+        if self.wall_clock_breakdown:
+            self.timers("step").start()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         (self._params, self._opt_state, self._scaler_state, self._grad_acc,
@@ -395,6 +442,10 @@ class DeepSpeedEngine:
                 self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if self.wall_clock_breakdown:
+            self.timers("step").stop(sync=grad_norm)
+            self._log_timers()
+        self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
         if self.steps_per_print() and \
                 self.global_steps % self.steps_per_print() == 0:
@@ -406,9 +457,35 @@ class DeepSpeedEngine:
                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
                 ranks=[0])
 
+    def _log_timers(self):
+        """Windowed wall-clock breakdown (reference engine.py:1239-1284):
+        per-step means over the steps_per_print window."""
+        window = self.steps_per_print() or 1
+        if self.global_steps % window == 0:
+            self.timers.log(["forward", "step"], normalizer=window,
+                            memory_breakdown=self._config.memory_breakdown)
+
+    def _emit_monitor_scalars(self):
+        """TensorBoard scalars (reference engine.py:1223-1237)."""
+        if self.monitor is None:
+            return
+        if self._last_loss is not None:
+            self.monitor.add_scalar("Train/Samples/train_loss",
+                                    float(self._last_loss),
+                                    self.global_samples)
+        cur = self._current_lr()
+        if cur is not None:
+            self.monitor.add_scalar("Train/Samples/lr", cur,
+                                    self.global_samples)
+        self.monitor.add_scalar("Train/Samples/loss_scale",
+                                float(self._scaler_state["cur_scale"]),
+                                self.global_samples)
+
     def _offload_step(self):
         """Host-side step: grads D2H -> native CPU-Adam on fp32 masters ->
         updated weights H2D. Loss-scale bookkeeping mirrors the device path."""
+        if self.wall_clock_breakdown:
+            self.timers("step").start()
         denom = float(self._scaler_state["cur_scale"]) * \
             self.gradient_accumulation_steps()
         if self._config.prescale_gradients:
@@ -429,6 +506,10 @@ class DeepSpeedEngine:
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
         self._grad_acc = None
+        if self.wall_clock_breakdown:
+            self.timers("step").stop()  # host step: already synchronous
+            self._log_timers()
+        self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
 
     def train_batch(self, data_iter=None):

@@ -1,0 +1,39 @@
+"""TensorBoard scalar monitor.
+
+Reference: the engine writes Train/Samples/* scalars from rank 0 when
+tensorboard is configured (runtime/engine.py:1058-1068,1223-1237). Same
+here; the writer is torch.utils.tensorboard (cpu torch is a baked-in dep),
+gracefully disabled if unavailable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .logging import logger
+
+
+class TensorBoardMonitor:
+    def __init__(self, output_path: str = "", job_name: str = "DeepSpeedJobName"):
+        self.enabled = False
+        self.summary_writer = None
+        base = output_path or os.path.join(os.path.expanduser("~"),
+                                           "tensorboard")
+        log_dir = os.path.join(base, job_name)
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            os.makedirs(log_dir, exist_ok=True)
+            self.summary_writer = SummaryWriter(log_dir=log_dir)
+            self.enabled = True
+        except Exception as e:  # pragma: no cover - no tensorboard install
+            logger.warning(f"tensorboard disabled: {e}")
+
+    def add_scalar(self, tag: str, value, step: int):
+        if self.enabled:
+            self.summary_writer.add_scalar(tag, float(value), step)
+
+    def flush(self):
+        if self.enabled:
+            self.summary_writer.flush()

@@ -1,0 +1,98 @@
+"""Wall-clock timers (reference: deepspeed/utils/timer.py:19-103
+SynchronizedWallClockTimer).
+
+The reference cuda-synchronizes before reading the clock; the JAX analog is
+blocking on a marker value (jax.block_until_ready) or, with no marker,
+jax.effects_barrier-less wall time — dispatch is async, so timing a region
+that ends in device work REQUIRES passing that work's output to stop().
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+
+from .logging import log_dist
+
+
+class _Timer:
+    """reference timer.py:25-69."""
+
+    def __init__(self, name: str):
+        self.name_ = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self):
+        assert not self.started_, f"timer {self.name_} already started"
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, sync=None):
+        assert self.started_, f"timer {self.name_} not started"
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True) -> float:
+        started = self.started_
+        if started:
+            self.stop()
+        out = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return out
+
+    def mean(self, count: int) -> float:
+        return self.elapsed(reset=False) / max(count, 1)
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference timer.py:19-103)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has(self, name: str) -> bool:
+        return name in self.timers
+
+    @staticmethod
+    def memory_usage() -> str:
+        """Device-memory line (reference reports cuda alloc/cache peaks)."""
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            used = stats.get("bytes_in_use", 0) / 2**30
+            peak = stats.get("peak_bytes_in_use", 0) / 2**30
+            return f"mem: in_use {used:.2f} GB | peak {peak:.2f} GB"
+        except Exception:
+            return "mem: unavailable"
+
+    def log(self, names: List[str], normalizer: float = 1.0,
+            reset: bool = True, ranks: Optional[List[int]] = None,
+            memory_breakdown: bool = False):
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / \
+                    normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        line = "time (ms) | " + " | ".join(parts)
+        if memory_breakdown:
+            line += " | " + self.memory_usage()
+        log_dist(line, ranks=ranks or [0])

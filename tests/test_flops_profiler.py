@@ -1,0 +1,90 @@
+"""FLOPS profiler + timer tests (mirrors reference
+tests/unit/test_flops_profiler.py which asserts the profiled FLOPs of a
+known model are within 10% of the analytic count)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, gpt2_config
+from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler,
+                                                    analyze_fn,
+                                                    get_model_profile,
+                                                    number_to_string)
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+
+def test_analyze_matmul_flops():
+    a = jnp.ones((64, 128))
+    b = jnp.ones((128, 32))
+    stats = analyze_fn(lambda x, y: x @ y, a, b)
+    # 2*M*N*K
+    expect = 2 * 64 * 32 * 128
+    assert stats["by_primitive"].get("dot_general") == expect
+    assert stats["flops"] >= expect
+
+
+def test_analyze_descends_jit_and_remat():
+    def inner(x, w):
+        return jnp.tanh(x @ w)
+
+    def fn(x, w):
+        return jax.checkpoint(inner)(x, w) + jax.jit(inner)(x, w)
+
+    x = jnp.ones((8, 16))
+    w = jnp.ones((16, 16))
+    stats = analyze_fn(fn, x, w)
+    assert stats["by_primitive"].get("dot_general", 0) >= 2 * 2 * 8 * 16 * 16
+
+
+def test_get_model_profile_gpt():
+    cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64)
+    model = GPT(cfg)
+    tokens = jnp.zeros((2, 32), jnp.int32)
+    batch = (tokens, tokens)
+    flops, macs, params = get_model_profile(model, batch)
+    assert flops > 0 and macs == flops / 2
+    # analytic params lower bound: 12*L*d^2 dominates; just sanity-check scale
+    assert params > cfg.num_layers * 4 * cfg.d_model ** 2
+    s = get_model_profile(model, batch, as_string=True)
+    assert all(isinstance(x, str) for x in s)
+
+
+def test_profiler_through_engine(capsys):
+    cfg = gpt2_config("nano", vocab_size=256, max_seq_len=64)
+    model = GPT(cfg)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8},
+        "flops_profiler": {"enabled": True, "profile_step": 1},
+        "wall_clock_breakdown": True,
+        "steps_per_print": 1,
+    })
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 33), 0, 256)
+    batch = (tokens[:, :-1], tokens[:, 1:])
+    for _ in range(2):
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+    assert engine._flops_profiled
+    assert engine._flops_stats["flops"] > 0
+
+
+def test_number_to_string():
+    assert number_to_string(2.5e12, "FLOPs") == "2.50 TFLOPs"
+    assert number_to_string(1500, "") == "1.50 K"
+
+
+def test_sync_wallclock_timer():
+    timers = SynchronizedWallClockTimer()
+    t = timers("region")
+    t.start()
+    x = jnp.ones((256, 256)) @ jnp.ones((256, 256))
+    t.stop(sync=x)
+    assert t.elapsed(reset=False) > 0
+    timers.log(["region"])  # smoke: formats without error
+    assert timers.has("region")

@@ -1,0 +1,123 @@
+"""module_inject tests: HF BERT layer params -> fused layer params and
+back (reference module_inject/replace_module.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.module_inject import (HFBertLayerPolicy, replace_module,
+                                         replace_transformer_layer,
+                                         revert_transformer_layer)
+from deepspeed_tpu.ops.transformer import (DeepSpeedTransformerConfig,
+                                           transformer_layer_forward)
+
+H, FFN, HEADS = 64, 256, 4
+
+
+def _hf_flax_layer(rng):
+    ks = iter(jax.random.split(rng, 8))
+    dense = lambda i, o: {"kernel": jax.random.normal(next(ks), (i, o)) * 0.02,
+                          "bias": jnp.zeros((o,))}
+    ln = lambda: {"scale": jnp.ones((H,)), "bias": jnp.zeros((H,))}
+    return {
+        "attention": {
+            "self": {"query": dense(H, H), "key": dense(H, H),
+                     "value": dense(H, H)},
+            "output": {"dense": dense(H, H), "LayerNorm": ln()},
+        },
+        "intermediate": {"dense": dense(H, FFN)},
+        "output": {"dense": dense(FFN, H), "LayerNorm": ln()},
+    }
+
+
+def _hf_naive_forward(t, x, eps=1e-12):
+    """Post-LN BERT layer computed the HF way (separate q/k/v)."""
+    def ln(h, p):
+        mu = h.mean(-1, keepdims=True)
+        var = ((h - mu) ** 2).mean(-1, keepdims=True)
+        return (h - mu) / np.sqrt(var + eps) * np.asarray(p["scale"]) + \
+            np.asarray(p["bias"])
+
+    d = lambda h, p: h @ np.asarray(p["kernel"]) + np.asarray(p["bias"])
+    B, S, _ = x.shape
+    hd = H // HEADS
+    sa = t["attention"]["self"]
+    q = d(x, sa["query"]).reshape(B, S, HEADS, hd).transpose(0, 2, 1, 3)
+    k = d(x, sa["key"]).reshape(B, S, HEADS, hd).transpose(0, 2, 1, 3)
+    v = d(x, sa["value"]).reshape(B, S, HEADS, hd).transpose(0, 2, 1, 3)
+    scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(hd)
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ctx = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, H)
+    attn = ln(d(ctx, t["attention"]["output"]["dense"]) + x,
+              t["attention"]["output"]["LayerNorm"])
+    inter = d(attn, t["intermediate"]["dense"])
+    gelu = 0.5 * inter * (1.0 + np.tanh(
+        np.sqrt(2.0 / np.pi) * (inter + 0.044715 * inter ** 3)))
+    return ln(d(gelu, t["output"]["dense"]) + attn, t["output"]["LayerNorm"])
+
+
+def _cfg():
+    return DeepSpeedTransformerConfig(
+        hidden_size=H, intermediate_size=FFN, heads=HEADS,
+        attn_dropout_ratio=0.0, hidden_dropout_ratio=0.0,
+        num_hidden_layers=1, initializer_range=0.02, dtype=jnp.float32)
+
+
+def test_convert_matches_hf_forward():
+    t = _hf_flax_layer(jax.random.PRNGKey(0))
+    policy = HFBertLayerPolicy()
+    fused, cfg, replaced = replace_transformer_layer(policy, t, _cfg())
+    assert replaced == [()]
+    assert cfg.pre_layer_norm is False
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 16, H))
+    got = np.asarray(transformer_layer_forward(fused, x, config=cfg))
+    want = _hf_naive_forward(
+        jax.tree_util.tree_map(np.asarray, t), np.asarray(x))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_roundtrip_revert():
+    t = _hf_flax_layer(jax.random.PRNGKey(2))
+    policy = HFBertLayerPolicy()
+    fused, _ = replace_module(t, policy)
+    back = revert_transformer_layer(policy, fused)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)), t, back)
+
+
+def test_walker_replaces_nested_layers():
+    layers = [_hf_flax_layer(jax.random.PRNGKey(i)) for i in range(3)]
+    tree = {"encoder": {"layer": layers}, "embeddings": {"word": jnp.ones(4)}}
+    new, replaced = replace_module(tree, HFBertLayerPolicy())
+    assert len(replaced) == 3
+    assert replaced[0] == ("encoder", "layer", 0)
+    for lp in new["encoder"]["layer"]:
+        assert "attn_qkvw" in lp
+    np.testing.assert_array_equal(np.asarray(new["embeddings"]["word"]),
+                                  np.ones(4))
+
+
+def test_torch_layout_transposed():
+    t = _hf_flax_layer(jax.random.PRNGKey(3))
+    # rebuild as a torch-style tree: [out, in] "weight" tensors
+    def to_torch(d):
+        if isinstance(d, dict):
+            if "kernel" in d:
+                return {"weight": jnp.asarray(d["kernel"]).T,
+                        "bias": d["bias"]}
+            if "scale" in d:
+                return {"weight": d["scale"], "bias": d["bias"]}
+            return {k: to_torch(v) for k, v in d.items()}
+        return d
+
+    torch_tree = to_torch(t)
+    fused_flax, _ = replace_module(t, HFBertLayerPolicy())
+    fused_torch, _ = replace_module(torch_tree,
+                                    HFBertLayerPolicy(torch_layout=True))
+    for k in fused_flax:
+        np.testing.assert_allclose(np.asarray(fused_flax[k]),
+                                   np.asarray(fused_torch[k]), atol=1e-6)
