@@ -1,0 +1,37 @@
+"""`ds_elastic` CLI (reference bin/ds_elastic + elasticity API):
+given a DeepSpeed config with an `elasticity` block, print the computed
+compatible global batch sizes / micro-batch / world-size combinations."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from .elasticity import compute_elastic_config
+
+
+def main(args=None):
+    parser = argparse.ArgumentParser(description="DeepSpeed elasticity")
+    parser.add_argument("-c", "--config", type=str, required=True,
+                        help="DeepSpeed config json")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="intended world size (0: show all)")
+    args = parser.parse_args(args=args)
+    with open(args.config) as fh:
+        ds_config = json.load(fh)
+
+    if args.world_size > 0:
+        batch, _valid, micro = compute_elastic_config(
+            ds_config, world_size=args.world_size)
+        grad_acc = batch // (micro * args.world_size)
+        print(f"world_size={args.world_size}: train_batch_size={batch}, "
+              f"micro_batch_per_gpu={micro}, grad_acc_steps={grad_acc}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"final batch size: {batch}")
+        print(f"valid world sizes: {sorted(valid)}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,88 @@
+"""`ds_report` — environment and op-compatibility report.
+
+Reference: deepspeed/env_report.py:23-109 (op install/compat table, torch
+and CUDA versions). TPU version: jax/jaxlib/libtpu versions, device
+inventory, native-extension (C++) build status from the op_builder
+registry.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+SUCCESS = f"{GREEN}[OKAY]{END}"
+WARNING = f"{YELLOW}[WARNING]{END}"
+FAIL = f"{RED}[FAIL]{END}"
+NO = f"{YELLOW}[NO]{END}"
+
+
+def op_report(out=sys.stdout):
+    from .ops.op_builder import ALL_OPS
+
+    max_dots = 23
+    print("-" * 74, file=out)
+    print("op name" + "." * (max_dots - len("op name")) +
+          " compatible | built", file=out)
+    print("-" * 74, file=out)
+    for name, builder_cls in sorted(ALL_OPS.items()):
+        builder = builder_cls()
+        try:
+            compatible = builder.is_compatible()
+        except Exception:
+            compatible = False
+        # probe the cached artifact only — a status report must not
+        # compile extensions as a side effect
+        try:
+            built = builder.lib_path().exists()
+        except Exception:
+            built = False
+        status = SUCCESS if compatible else NO
+        built_s = SUCCESS if built else (WARNING if compatible else NO)
+        print(f"{name}{'.' * (max_dots - len(name))} {status:>18} | "
+              f"{built_s}", file=out)
+    print("-" * 74, file=out)
+
+
+def debug_report(out=sys.stdout):
+    import jax
+
+    rows = [("deepspeed_tpu version",
+             importlib.import_module("deepspeed_tpu").__version__),
+            ("python version", sys.version.split()[0]),
+            ("jax version", jax.__version__)]
+    try:
+        import jaxlib
+        rows.append(("jaxlib version", jaxlib.__version__))
+    except Exception:
+        pass
+    for mod in ("flax", "optax", "numpy"):
+        try:
+            rows.append((f"{mod} version",
+                         importlib.import_module(mod).__version__))
+        except Exception:
+            rows.append((f"{mod} version", "not installed"))
+    try:
+        devs = jax.devices()
+        rows.append(("backend", jax.default_backend()))
+        rows.append(("devices", f"{len(devs)} x {devs[0].device_kind}"))
+    except Exception as e:
+        rows.append(("devices", f"unavailable ({e})"))
+    print("DeepSpeed-TPU general environment info:", file=out)
+    for name, val in rows:
+        print(f"{name} {'.' * max(1, 24 - len(name))} {val}", file=out)
+
+
+def main(out=sys.stdout):
+    op_report(out=out)
+    debug_report(out=out)
+
+
+cli_main = main
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Per-host launcher.
+
+Reference: deepspeed/launcher/launch.py:69-176 — decode world info, set
+rank env vars, spawn one subprocess per local GPU, kill the local group on
+any child failure, forward SIGINT/SIGTERM.
+
+TPU difference: JAX is single-controller per host, so ONE user process per
+host drives all local chips (the reference's proc-per-device model would
+fight the TPU runtime for chip ownership). The spawned process gets:
+  DSTPU_COORDINATOR / DSTPU_NUM_PROCESSES / DSTPU_PROCESS_ID  (jax.distributed)
+  RANK / LOCAL_RANK / WORLD_SIZE / MASTER_ADDR / MASTER_PORT  (torch-style parity)
+`--procs_per_node N` (testing / CPU meshes) restores proc-per-slot
+spawning with per-process DSTPU_PROCESS_ID — the reference behavior.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+from typing import List
+
+from ..utils.logging import logger
+from .runner import decode_world_info
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world_info", type=str, required=True)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--procs_per_node", type=int, default=1)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def main(args=None):
+    args = parse_args(args)
+    world_info = decode_world_info(args.world_info)
+    hosts = list(world_info.keys())
+    node_rank = args.node_rank
+    if node_rank < 0:  # from MPI env (reference launch.py via OMPI)
+        node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", 0))
+    num_nodes = len(hosts)
+    ppn = max(1, args.procs_per_node)
+    world_size = num_nodes * ppn
+
+    processes: List[subprocess.Popen] = []
+    for local_rank in range(ppn):
+        rank = node_rank * ppn + local_rank
+        env = os.environ.copy()
+        env.update({
+            "DSTPU_COORDINATOR": f"{args.master_addr}:{args.master_port}",
+            "DSTPU_NUM_PROCESSES": str(world_size),
+            "DSTPU_PROCESS_ID": str(rank),
+            "RANK": str(rank),
+            "LOCAL_RANK": str(local_rank),
+            "WORLD_SIZE": str(world_size),
+            "MASTER_ADDR": args.master_addr,
+            "MASTER_PORT": str(args.master_port),
+        })
+        cmd = [sys.executable, "-u", args.user_script,
+               f"--local_rank={local_rank}"] + args.user_args
+        logger.info(f"launching process {rank}/{world_size}: {' '.join(cmd)}")
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    # signal fan-out + fail-fast group kill (reference launch.py:139-175)
+    def sig_handler(signum, frame):
+        for p in processes:
+            p.terminate()
+        sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sig_handler)
+    signal.signal(signal.SIGTERM, sig_handler)
+
+    alive = list(processes)
+    rc = 0
+    while alive:
+        for p in list(alive):
+            ret = p.poll()
+            if ret is None:
+                continue
+            alive.remove(p)
+            if ret != 0:
+                logger.error(f"process {p.pid} exited with code {ret}; "
+                             f"terminating local group")
+                for q in alive:
+                    q.terminate()
+                for q in alive:
+                    q.wait()
+                return ret
+        if alive:
+            try:
+                alive[0].wait(timeout=1)
+            except subprocess.TimeoutExpired:
+                pass
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

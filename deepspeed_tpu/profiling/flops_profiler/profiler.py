@@ -81,7 +81,8 @@ def _prim_flops(eqn) -> int:
     return 0
 
 
-def _walk_jaxpr(jaxpr, scope: Tuple[str, ...], by_scope, by_prim):
+def _walk_jaxpr(jaxpr, scope: Tuple[str, ...], by_scope, by_prim,
+                mult: int = 1):
     for eqn in jaxpr.eqns:
         # descend into sub-jaxprs (pjit/remat/scan/cond carry inner jaxprs)
         inner = [v for k, v in eqn.params.items()
@@ -89,21 +90,23 @@ def _walk_jaxpr(jaxpr, scope: Tuple[str, ...], by_scope, by_prim):
         name = eqn.params.get("name")
         sub_scope = scope + ((name,) if isinstance(name, str) else ())
         if inner:
+            sub_mult = mult
+            if eqn.primitive.name == "scan":
+                sub_mult = mult * int(eqn.params.get("length", 1))
             for sj in inner:
                 _walk_jaxpr(getattr(sj, "jaxpr", sj), sub_scope, by_scope,
-                            by_prim)
-            if eqn.primitive.name == "scan":
-                # scan body runs `length` times
-                pass
+                            by_prim, sub_mult)
             continue
         branches = eqn.params.get("branches")
         if branches:
+            # cond: one branch executes; count the max as the estimate
             for br in branches:
                 _walk_jaxpr(getattr(br, "jaxpr", br), sub_scope, by_scope,
-                            by_prim)
+                            by_prim, mult)
             continue
         f = _prim_flops(eqn)
         if f:
+            f *= mult
             key = "/".join(scope) or "<top>"
             by_scope[key] = by_scope.get(key, 0) + f
             p = eqn.primitive.name

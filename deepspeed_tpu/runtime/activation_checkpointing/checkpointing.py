@@ -119,11 +119,13 @@ def _constrain_tree(tree):
 def _remat_policy():
     if _CONFIG["cpu_checkpointing"]:
         try:
-            # save nothing on-device; offloadable residuals go to host
-            return jax.checkpoint_policies.save_and_offload_only_these_names(
-                names_which_can_be_saved=[],
-                names_which_can_be_offloaded=[],
-                offload_src="device", offload_dst="pinned_host")
+            # offload the expensive residuals (matmul outputs) to host
+            # memory instead of keeping them in HBM; everything else is
+            # rematerialised. This is the policy that actually moves bytes
+            # — name-based offload would require checkpoint_name tags the
+            # user's model doesn't have.
+            return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+                "device", "pinned_host")
         except Exception:  # pragma: no cover - older jax
             logger.warning("cpu_checkpointing: offload policy unavailable; "
                            "falling back to full rematerialisation")

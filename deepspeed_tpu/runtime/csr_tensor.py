@@ -1,0 +1,62 @@
+"""Row-sparse ("CSR") tensor for sparse embedding gradients.
+
+Reference: deepspeed/runtime/csr_tensor.py:11 — row-compressed
+representation (IndexedSlices-style) used by the engine's
+`sparse_gradients` allreduce path (reference engine.py:1397-1449): only
+the touched embedding rows travel over the wire.
+
+TPU note: inside jit XLA already averages dense grads with psum; this
+class serves the out-of-jit path (host-side grad exchange, e.g. the
+offload runtime) and API parity. `add` concatenates (duplicate row
+indices accumulate on to_dense via scatter-add) exactly like the
+reference.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+
+class CSRTensor:
+    def __init__(self, dense_tensor=None):
+        self.orig_dense_tensor = dense_tensor
+        if dense_tensor is not None:
+            assert dense_tensor.ndim == 2, "CSRTensor expects [rows, dim]"
+            row_mass = jnp.sum(jnp.abs(dense_tensor), axis=1)
+            self.indices = jnp.nonzero(row_mass)[0]
+            self.values = dense_tensor[self.indices]
+            self.dense_size = list(dense_tensor.shape)
+        else:
+            self.indices = None
+            self.values = None
+            self.dense_size: Optional[List[int]] = None
+
+    @staticmethod
+    def type():
+        return "deepspeed.CSRTensor"
+
+    def to_dense(self):
+        out = jnp.zeros(self.dense_size, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+    def sparse_size(self):
+        index_size = int(self.indices.shape[0])
+        value_size = int(self.values.shape[0] * self.values.shape[1])
+        dense_size = self.dense_size[0] * self.dense_size[1]
+        return index_size + value_size, dense_size
+
+    def add(self, b: "CSRTensor"):
+        assert self.dense_size == b.dense_size
+        self.indices = jnp.concatenate([self.indices, b.indices])
+        self.values = jnp.concatenate([self.values, b.values])
+
+    def __str__(self):
+        sparse_size, dense_size = self.sparse_size()
+        return (f"deepspeed_tpu.CSRTensor(indices_size={self.indices.shape}, "
+                f"values_size={self.values.shape}, "
+                f"dense_size={self.dense_size}, "
+                f"reduction_factor={dense_size / max(sparse_size, 1):.2f})")
+
+    __repr__ = __str__

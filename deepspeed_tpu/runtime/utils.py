@@ -212,3 +212,143 @@ class ThroughputTimer:
             return self.batch_size * self.num_workers * counted / \
                 self.total_elapsed_time
         return 0.0
+
+
+# ---------------------------------------------------------------------------
+# PartitionedTensor (reference runtime/utils.py:417) — flat 1/N slices of a
+# tensor across a process/axis group with a meta handshake, used by the
+# reference pipeline to shard activations across MP ranks in flight. Here
+# the SPMD pipeline shards via sharding constraints, so this is the host-
+# side parity utility (explicit num_parts/rank; a mesh axis name supplies
+# defaults).
+# ---------------------------------------------------------------------------
+
+class PartitionedTensor:
+    def __init__(self, tensor, group: Optional[str] = None,
+                 num_parts: Optional[int] = None, rank: Optional[int] = None):
+        self.group = group
+        if num_parts is None:
+            if group is None:
+                num_parts = 1  # single-controller default: trivial partition
+            else:
+                from ..comm.mesh import peek_mesh
+
+                info = peek_mesh()
+                if info is None or group not in info.mesh.shape:
+                    raise ValueError(
+                        f"group {group!r} is not an axis of the current "
+                        f"mesh; pass num_parts explicitly")
+                num_parts = info.mesh.shape[group]
+        self.num_parts = num_parts
+        if rank is None:
+            if self.num_parts != 1:
+                raise ValueError(
+                    "PartitionedTensor needs an explicit rank when "
+                    "num_parts > 1 (single-controller processes have no "
+                    "implicit per-axis rank)")
+            rank = 0
+        self.rank = rank
+        self.orig_size = list(tensor.shape)
+        flat = jnp.ravel(tensor)
+        self.partition = partition_uniform(flat.size, self.num_parts)
+        start = self.partition[self.rank]
+        end = self.partition[self.rank + 1]
+        self.local_data = flat[start:end]
+
+    def to_meta(self):
+        """[ndims, *shape, num_parts, rank, *boundaries] int32 vector
+        (reference encodes the same fields :454-476)."""
+        return jnp.asarray(
+            [len(self.orig_size)] + self.orig_size +
+            [self.num_parts, self.rank] + list(self.partition), jnp.int32)
+
+    @classmethod
+    def from_meta(cls, meta, local_part, group: Optional[str] = None):
+        meta = [int(x) for x in meta]
+        nd = meta[0]
+        obj = cls.__new__(cls)
+        obj.group = group
+        obj.orig_size = meta[1:1 + nd]
+        obj.num_parts = meta[1 + nd]
+        obj.rank = meta[2 + nd]
+        obj.partition = meta[3 + nd:]
+        obj.local_data = local_part
+        return obj
+
+    def data(self):
+        return self.local_data
+
+    def local_size(self):
+        return self.local_data.size
+
+    def full(self, parts: Optional[Sequence] = None):
+        """Reassemble. In multi-process mode callers pass the gathered
+        parts (one per rank, e.g. via comm.all_gather of local_data);
+        single-controller callers omit `parts` only when num_parts == 1."""
+        if parts is None:
+            if self.num_parts != 1:
+                raise ValueError(
+                    "full() without parts requires num_parts == 1; gather "
+                    "the per-rank local_data slices and pass them in")
+            parts = [self.local_data]
+        flat = jnp.concatenate([jnp.ravel(p) for p in parts])
+        return flat.reshape(self.orig_size)
+
+
+# ---------------------------------------------------------------------------
+# Gradient noise scale (reference runtime/utils.py:618): "An Empirical
+# Model of Large-Batch Training" estimator from per-micro-batch gradients.
+# ---------------------------------------------------------------------------
+
+class GradientNoiseScale:
+    """Feed per-micro-batch flattened gradients via update(); every
+    n_batches updates it compares |g_small|^2 (one micro batch) with
+    |g_big|^2 (mean of the window) and EMA-smooths the scale/noise
+    estimates exactly as the reference does."""
+
+    def __init__(self, batch_size_small: int, n_batches: int,
+                 beta: float = 0.99):
+        self.batch_size_small = batch_size_small
+        self.batch_size_large = batch_size_small * n_batches
+        self.n_batches = n_batches
+        self.beta = beta
+        self.buffer = []
+        self.ema_scale = None
+        self.ema_noise = None
+        self.scale = None
+        self.noise = None
+        self.noise_scale = None
+        self.n_updates = 0
+
+    def _ema(self, avg, yi, i):
+        if avg is None:
+            avg = 0.0
+        avg = self.beta * avg + (1 - self.beta) * yi
+        return avg, avg / (1 - self.beta ** (i + 1))
+
+    @staticmethod
+    def flatten_grads(grads) -> jnp.ndarray:
+        leaves = [jnp.ravel(l) for l in jax.tree_util.tree_leaves(grads)]
+        return jnp.concatenate(leaves)
+
+    def update(self, grads):
+        curr = self.flatten_grads(grads)
+        self.buffer.append(curr)
+        if self.n_updates % self.n_batches == self.n_batches - 1:
+            past = jnp.stack(self.buffer, axis=1)
+            self.buffer = []
+            big = past.mean(axis=1)
+            g_big = float(jnp.mean(big ** 2))
+            g_small = float(jnp.mean(curr ** 2))
+            bs, bl = self.batch_size_small, self.batch_size_large
+            noise = (bl * g_big - bs * g_small) / (bl - bs)
+            scale = (g_small - g_big) / ((1.0 / bs) - (1.0 / bl))
+            self.ema_scale, scale = self._ema(self.ema_scale, scale,
+                                              self.n_updates)
+            self.ema_noise, noise = self._ema(self.ema_noise, noise,
+                                              self.n_updates)
+            self.scale = scale
+            self.noise = noise
+            self.noise_scale = scale / noise if noise else None
+        self.n_updates += 1
+        return self.noise_scale

@@ -1,0 +1,66 @@
+"""CSRTensor / PartitionedTensor / GradientNoiseScale tests (reference
+tests/unit/test_csr.py and test_partition.py)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.csr_tensor import CSRTensor
+from deepspeed_tpu.runtime.utils import (GradientNoiseScale,
+                                         PartitionedTensor,
+                                         partition_uniform)
+
+
+def test_csr_roundtrip():
+    dense = jnp.zeros((12, 8)).at[jnp.asarray([0, 3, 7])].set(
+        jax.random.normal(jax.random.PRNGKey(0), (3, 8)))
+    csr = CSRTensor(dense)
+    assert csr.indices.shape == (3,)
+    np.testing.assert_allclose(np.asarray(csr.to_dense()),
+                               np.asarray(dense), rtol=1e-6)
+    sparse, full = csr.sparse_size()
+    assert sparse == 3 + 3 * 8 and full == 96
+
+
+def test_csr_add_accumulates_duplicates():
+    a = jnp.zeros((6, 4)).at[1].set(1.0)
+    b = jnp.zeros((6, 4)).at[1].set(2.0).at[3].set(5.0)
+    ca, cb = CSRTensor(a), CSRTensor(b)
+    ca.add(cb)
+    dense = np.asarray(ca.to_dense())
+    np.testing.assert_allclose(dense[1], 3.0)
+    np.testing.assert_allclose(dense[3], 5.0)
+
+
+def test_partitioned_tensor_meta_roundtrip():
+    t = jnp.arange(24.0).reshape(4, 6)
+    parts = [PartitionedTensor(t, num_parts=3, rank=r) for r in range(3)]
+    meta = parts[0].to_meta()
+    rebuilt = PartitionedTensor.from_meta(meta, parts[0].local_data)
+    assert rebuilt.orig_size == [4, 6]
+    assert rebuilt.num_parts == 3
+    full = rebuilt.full(parts=[p.local_data for p in parts])
+    np.testing.assert_array_equal(np.asarray(full), np.asarray(t))
+
+
+def test_partitioned_tensor_boundaries_match_partition_uniform():
+    t = jnp.arange(13.0)
+    pt = PartitionedTensor(t, num_parts=4, rank=2)
+    assert pt.partition == partition_uniform(13, 4)
+    lo, hi = pt.partition[2], pt.partition[3]
+    np.testing.assert_array_equal(np.asarray(pt.local_data),
+                                  np.arange(13.0)[lo:hi])
+
+
+def test_gradient_noise_scale_converges_positive():
+    gns = GradientNoiseScale(batch_size_small=8, n_batches=4, beta=0.9)
+    key = jax.random.PRNGKey(0)
+    for i in range(16):
+        key, k = jax.random.split(key)
+        grads = {"w": 1.0 + 0.3 * jax.random.normal(k, (256,))}
+        gns.update(grads)
+    assert gns.noise_scale is not None
+    assert np.isfinite(gns.noise_scale)
+    assert gns.n_updates == 16

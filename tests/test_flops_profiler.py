@@ -88,3 +88,16 @@ def test_sync_wallclock_timer():
     assert t.elapsed(reset=False) > 0
     timers.log(["region"])  # smoke: formats without error
     assert timers.has("region")
+
+
+def test_analyze_scan_multiplies_by_length():
+    def fn(x, w):
+        def body(c, _):
+            return jnp.tanh(c @ w), None
+        out, _ = jax.lax.scan(body, x, None, length=10)
+        return out
+
+    x = jnp.ones((8, 16))
+    w = jnp.ones((16, 16))
+    stats = analyze_fn(fn, x, w)
+    assert stats["by_primitive"]["dot_general"] == 10 * 2 * 8 * 16 * 16

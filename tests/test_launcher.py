@@ -1,0 +1,155 @@
+"""Launcher tests (mirrors reference tests/unit/test_run.py: hostfile and
+--include/--exclude resource parsing) plus an end-to-end local launch."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (decode_world_info,
+                                           encode_world_info,
+                                           fetch_hostfile,
+                                           parse_resource_filter)
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(textwrap.dedent(text))
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, """\
+        # comment
+        worker-0 slots=4
+        worker-1 slots=8
+        """)
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 8}
+    assert list(pool) == ["worker-0", "worker-1"]  # order preserved
+
+
+def test_fetch_hostfile_missing_returns_none(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+
+
+def test_fetch_hostfile_bad_format(tmp_path):
+    path = _hostfile(tmp_path, "worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def test_fetch_hostfile_duplicate(tmp_path):
+    path = _hostfile(tmp_path, "w0 slots=2\nw0 slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(path)
+
+
+def _pool():
+    return {"worker-0": [0, 1, 2, 3], "worker-1": [0, 1, 2, 3]}
+
+
+def test_include_filter():
+    # reference test_run.py include syntax: host@host:slots
+    out = parse_resource_filter(_pool(), include_str="worker-1:0,2")
+    assert out == {"worker-1": [0, 2]}
+    out = parse_resource_filter(_pool(), include_str="worker-0@worker-1:1")
+    assert out == {"worker-0": [0, 1, 2, 3], "worker-1": [1]}
+
+
+def test_exclude_filter():
+    out = parse_resource_filter(_pool(), exclude_str="worker-1")
+    assert out == {"worker-0": [0, 1, 2, 3]}
+    out = parse_resource_filter(_pool(), exclude_str="worker-0:1,3")
+    assert out == {"worker-0": [0, 2], "worker-1": [0, 1, 2, 3]}
+
+
+def test_include_exclude_mutually_exclusive():
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-0",
+                              exclude_str="worker-1")
+
+
+def test_filter_unknown_host_or_slot():
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-9")
+    with pytest.raises(ValueError):
+        parse_resource_filter(_pool(), include_str="worker-0:7")
+
+
+def test_world_info_roundtrip():
+    info = {"worker-0": [0, 1], "worker-1": [0]}
+    assert decode_world_info(encode_world_info(info)) == info
+
+
+def test_local_launch_end_to_end(tmp_path):
+    """launch.py spawns the user script with the DSTPU_*/RANK env contract
+    and fail-fast group kill (reference launch.py:122-175)."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent("""\
+        import json, os, sys
+        out = {k: os.environ.get(k) for k in
+               ("DSTPU_COORDINATOR", "DSTPU_NUM_PROCESSES",
+                "DSTPU_PROCESS_ID", "RANK", "WORLD_SIZE", "LOCAL_RANK")}
+        with open(os.environ["OUT_FILE"] + os.environ["RANK"], "w") as f:
+            json.dump(out, f)
+        """))
+    out_file = str(tmp_path / "env_")
+    env = os.environ.copy()
+    env["OUT_FILE"] = out_file
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    world = encode_world_info({"localhost": [0, 1]})
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         f"--world_info={world}", "--master_port=29877",
+         "--procs_per_node=2", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    got0 = json.loads(open(out_file + "0").read())
+    got1 = json.loads(open(out_file + "1").read())
+    assert got0["DSTPU_COORDINATOR"] == "127.0.0.1:29877"
+    assert got0["WORLD_SIZE"] == "2" and got1["RANK"] == "1"
+    assert got1["LOCAL_RANK"] == "1"
+
+
+def test_local_launch_failure_propagates(tmp_path):
+    script = tmp_path / "boom.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    env = os.environ.copy()
+    env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
+    world = encode_world_info({"localhost": [0]})
+    proc = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.launcher.launch",
+         f"--world_info={world}", str(script)],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 3
+
+
+def test_ds_report_runs():
+    import io
+
+    from deepspeed_tpu.env_report import main as report_main
+
+    buf = io.StringIO()
+    report_main(out=buf)
+    text = buf.getvalue()
+    assert "op name" in text and "jax version" in text
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    from deepspeed_tpu.elasticity.elastic_agent import main as elastic_main
+
+    cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 1024,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 64, "version": 0.1}}
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps(cfg))
+    assert elastic_main(["-c", str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "final batch size" in out
+    elastic_main(["-c", str(p), "-w", "8"])
+    out = capsys.readouterr().out
+    assert "world_size=8" in out
