@@ -11,6 +11,7 @@ from . import comm  # noqa: F401
 from . import module_inject  # noqa: F401
 from .comm import init_distributed  # noqa: F401
 from .runtime.activation_checkpointing import checkpointing  # noqa: F401
+from .runtime import zero  # noqa: F401
 
 __git_hash__ = git_hash
 __git_branch__ = "main"

@@ -1,0 +1,5 @@
+from .compressed import CompressedBackend, compressed_allreduce
+from .compressed_ar import (compressed_all_reduce, decompose, reconstruct)
+
+__all__ = ["CompressedBackend", "compressed_allreduce",
+           "compressed_all_reduce", "decompose", "reconstruct"]

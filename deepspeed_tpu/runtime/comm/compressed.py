@@ -1,0 +1,99 @@
+"""Error-compensated 1-bit compressed collectives.
+
+Reference: deepspeed/runtime/comm/nccl.py:47-186 (NcclBackend) and
+mpi.py:34-290 (MpiBackend): sign-compress with worker error feedback,
+all_to_all the sign bits + allgather the scales, server-side recompress
+with server error feedback, allgather the result. CuPy packbits supplies
+the bit-packing (runtime/compression/cupy.py).
+
+TPU redesign: ICI is bandwidth-rich and XLA has no packed-int1 wire
+format, so the same ALGORITHM (two-stage sign compression with both error
+feedbacks — that is what 1-bit Adam's convergence proof needs) runs as a
+pure function on mesh axes: signs travel through psum/pmean. The
+`CompressedBackend` class mirrors the reference backend surface for
+out-of-jit callers by shard_map-ping the pure function over the mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import peek_mesh
+
+
+def compressed_allreduce(x, worker_error, server_error, axis: Optional[str]):
+    """1-bit compress with error feedback, average over `axis`, recompress.
+
+    Returns (averaged_tensor, new_worker_error, new_server_error).
+    Mirrors NcclBackend.compressed_allreduce (reference comm/nccl.py:47-186):
+      worker: c = x + worker_error; scale = ||c||_1/n; send sign(c)*scale
+      server: s = avg + server_error; rescale and sign again
+    Call inside jit/shard_map with `axis` a mesh axis name, or axis=None
+    for the single-shard (no-comm) case.
+    """
+    c = x + worker_error
+    scale = jnp.mean(jnp.abs(c))
+    compressed = jnp.sign(c) * scale
+    new_worker_error = c - compressed
+
+    if axis is not None:
+        avg = lax.pmean(compressed, axis)
+    else:
+        avg = compressed
+
+    s = avg + server_error
+    server_scale = jnp.mean(jnp.abs(s))
+    out = jnp.sign(s) * server_scale
+    new_server_error = s - out
+    return out, new_worker_error, new_server_error
+
+
+class CompressedBackend:
+    """Out-of-jit backend surface (reference NcclBackend/MpiBackend).
+
+    Holds the persistent worker/server error-feedback buffers per named
+    tensor (the reference attaches them to optimizer state; standalone
+    callers get the same behavior keyed by `name`).
+    """
+
+    def __init__(self, axis: str = "data", mpu=None):
+        self.axis = axis
+        self._errors = {}
+
+    def _get_errors(self, name, shaped_like):
+        if name not in self._errors:
+            zeros = jnp.zeros(shaped_like.shape, jnp.float32)
+            self._errors[name] = (zeros, zeros)
+        return self._errors[name]
+
+    def compressed_allreduce(self, tensor, name: str = "default"):
+        """Average `tensor`'s per-device shards over the axis with 1-bit
+        compression. The input is interpreted as already sharded over
+        `axis` on dim 0 (each shard is one worker's contribution)."""
+        info = peek_mesh()
+        if info is None or self.axis not in info.mesh.shape or \
+                info.mesh.shape[self.axis] == 1:
+            we, se = self._get_errors(name, tensor)
+            out, we, se = compressed_allreduce(tensor, we, se, None)
+            self._errors[name] = (we, se)
+            return out
+
+        mesh = info.mesh
+        we, se = self._get_errors(name, tensor)
+
+        @partial(jax.shard_map, mesh=mesh,
+                 in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+                 out_specs=(P(self.axis), P(self.axis), P(self.axis)),
+                 check_vma=False)
+        def run(x, we, se):
+            return compressed_allreduce(x, we, se, self.axis)
+
+        out, we, se = run(tensor, we, se)
+        self._errors[name] = (we, se)
+        return out

@@ -42,7 +42,7 @@ from . import constants as const
 from .config import DeepSpeedConfig
 from .dataloader import DeepSpeedDataLoader, RepeatingLoader
 from .fp16.loss_scaler import create_loss_scaler
-from .fp16.onebit import OnebitAdam
+from .fp16.onebit import OnebitAdam, OnebitLamb
 from .lr_schedules import SCHEDULERS
 from .module import TrainModule
 from .progressive_layer_drop import ProgressiveLayerDrop
@@ -200,6 +200,8 @@ class DeepSpeedEngine:
             return FusedLamb(**params)
         if name == const.ONEBIT_ADAM_OPTIMIZER:
             return OnebitAdam(**params)
+        if name == const.ONEBIT_LAMB_OPTIMIZER:
+            return OnebitLamb(**params)
         raise ValueError(f"unknown optimizer {name!r}; supported: "
                          f"{const.DEEPSPEED_OPTIMIZERS}")
 

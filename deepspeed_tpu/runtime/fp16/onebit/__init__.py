@@ -1,1 +1,2 @@
 from .adam import OnebitAdam  # noqa: F401
+from .lamb import OnebitLamb  # noqa: F401

@@ -22,29 +22,9 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def compressed_allreduce(x, worker_error, server_error, axis):
-    """1-bit compress with error feedback, average over `axis`, recompress.
-
-    Returns (averaged_tensor, new_worker_error, new_server_error).
-    Mirrors NcclBackend.compressed_allreduce (reference comm/nccl.py:47-186):
-      worker: c = x + worker_error; scale = ||c||_1/n; send sign(c)*scale
-      server: s = avg + server_error; rescale and sign again
-    """
-    c = x + worker_error
-    scale = jnp.mean(jnp.abs(c))
-    compressed = jnp.sign(c) * scale
-    new_worker_error = c - compressed
-
-    if axis is not None:
-        avg = lax.pmean(compressed, axis)
-    else:
-        avg = compressed
-
-    s = avg + server_error
-    server_scale = jnp.mean(jnp.abs(s))
-    out = jnp.sign(s) * server_scale
-    new_server_error = s - out
-    return out, new_worker_error, new_server_error
+# the compress->reduce->recompress pipeline lives in runtime/comm
+# (shared with OnebitLamb and the standalone CompressedBackend)
+from ...comm.compressed import compressed_allreduce  # noqa: E402,F401
 
 
 class OnebitAdam:
@@ -121,11 +101,18 @@ class OnebitAdam:
                 frozen, frozen_branch, warm_branch, (grad, m, v, we, se))
 
             p32 = p.astype(jnp.float32)
+            # bias corrections apply during warmup only: after freeze the
+            # reference uses the CONSTANT denominator exp_avg_sq.sqrt()+eps
+            # (1-bit adam.py step) — a still-growing 1/bc2 on a frozen v
+            # would act as an unintended lr ramp through the compressed
+            # stage
+            bc1_eff = jnp.where(frozen, 1.0, bc1)
+            bc2_eff = jnp.where(frozen, 1.0, bc2)
             if self.eps_inside_sqrt:
-                denom = jnp.sqrt(new_v / bc2 + eps)
+                denom = jnp.sqrt(new_v / bc2_eff + eps)
             else:
-                denom = jnp.sqrt(new_v / bc2) + eps
-            step_val = (new_m / bc1) / denom
+                denom = jnp.sqrt(new_v / bc2_eff) + eps
+            step_val = (new_m / bc1_eff) / denom
             if wd:
                 step_val = step_val + wd * p32
             return (p32 - lr * step_val).astype(p.dtype), new_m, new_v, new_we, new_se

@@ -1,0 +1,192 @@
+"""1-bit LAMB — error-compensated sign-compressed LAMB.
+
+Reference: deepspeed/runtime/fp16/onebit/lamb.py (paper arXiv:2104.06069).
+Semantics kept:
+
+* warmup (`step <= freeze_step`): regular LAMB; per-tensor lamb
+  coefficients (trust ratios) are EMA-tracked with `coeff_beta`.
+* compression stage: the second moment and the lamb coefficient are
+  FROZEN; only momentum is communicated (1-bit signs + error feedback,
+  same pipeline as 1-bit Adam); the frozen coefficient is modulated by a
+  scaling factor derived from the ratio of a "fresh" second-moment
+  estimate (rebuilt from the decompressed momentum deltas — reference
+  lamb.py's exp_avg_sq_fresh) to the frozen one, clamped to
+  [factor_min, factor_max] and rate-limited by factor_threshold between
+  steps.
+
+TPU design matches OnebitAdam: the whole pipeline is a pure function in
+the jitted step; signs ride pmean over the `data` axis inside shard_map
+(`handles_dp_reduction`), errors/coefficients live in optimizer state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...comm.compressed import compressed_allreduce
+
+
+class OnebitLamb:
+    name = "OnebitLamb"
+    handles_dp_reduction = True
+
+    def __init__(self, params=None, deepspeed=None, lr=1e-3,
+                 freeze_step=100000, bias_correction=True,
+                 betas=(0.9, 0.999), eps=1e-8, eps_inside_sqrt=False,
+                 weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
+                 min_coeff=0.01, amsgrad=False, cuda_aware=False,
+                 comm_backend_name="xla", coeff_beta=0.9, factor_max=4.0,
+                 factor_min=0.5, factor_threshold=0.1):
+        if amsgrad:
+            raise RuntimeError("1-bit Lamb does not support AMSGrad")
+        self.defaults = dict(lr=lr, betas=betas, eps=eps,
+                             weight_decay=weight_decay,
+                             bias_correction=bias_correction,
+                             max_coeff=max_coeff, min_coeff=min_coeff)
+        self.param_groups = [dict(self.defaults)]
+        self.freeze_step = int(freeze_step)
+        self.eps_inside_sqrt = eps_inside_sqrt
+        self.coeff_beta = coeff_beta
+        self.factor_max = factor_max
+        self.factor_min = factor_min
+        self.factor_threshold = factor_threshold
+
+    @property
+    def lr(self):
+        return self.param_groups[0]["lr"]
+
+    def init(self, params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        zt = lambda: jax.tree_util.tree_map(zeros, params)
+        scal = lambda v: jax.tree_util.tree_map(
+            lambda p: jnp.asarray(v, jnp.float32), params)
+        return {
+            "step": jnp.zeros((), jnp.int32),
+            "exp_avg": zt(),
+            "exp_avg_sq": zt(),
+            "exp_avg_sq_fresh": zt(),
+            "worker_error": zt(),
+            "server_error": zt(),
+            "lamb_coeff_freeze": scal(0.0),
+            "last_factor": scal(1.0),
+        }
+
+    def update(self, grads, state, params, lr=None, comm_axis=None):
+        g = self.param_groups[0]
+        lr = g["lr"] if lr is None else lr
+        beta1, beta2 = g["betas"]
+        eps = g["eps"]
+        wd = g["weight_decay"]
+        max_coeff, min_coeff = g["max_coeff"], g["min_coeff"]
+        step = state["step"] + 1
+        fstep = step.astype(jnp.float32)
+        if g["bias_correction"]:
+            bc1 = 1.0 - beta1 ** fstep
+            bc2 = 1.0 - beta2 ** fstep
+        else:
+            bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
+        frozen = step > self.freeze_step
+
+        def denom_of(v):
+            if self.eps_inside_sqrt:
+                return jnp.sqrt(v / bc2 + eps)
+            return jnp.sqrt(v / bc2) + eps
+
+        def lamb_step(p32, adam_step, coeff_lo, coeff_hi, fixed_coeff=None):
+            if wd:
+                adam_step = adam_step + wd * p32
+            p_norm = jnp.linalg.norm(p32.reshape(-1))
+            u_norm = jnp.linalg.norm(adam_step.reshape(-1))
+            if fixed_coeff is None:
+                trust = jnp.where(
+                    u_norm > 0.0, p_norm / jnp.maximum(u_norm, 1e-12), 1.0)
+                trust = jnp.where(p_norm > 0.0, trust, 1.0)
+                trust = jnp.clip(trust, coeff_lo, coeff_hi)
+            else:
+                trust = fixed_coeff
+            return p32 - lr * trust * adam_step, trust
+
+        def upd(p, grad, m, v, v_fresh, we, se, coeff, last_factor):
+            grad = grad.astype(jnp.float32)
+            p32 = p.astype(jnp.float32)
+
+            def warm(ops):
+                grad_, m_, v_, v_fresh_, we_, se_, coeff_, lf_ = ops
+                if comm_axis is not None:
+                    grad_ = lax.pmean(grad_, comm_axis)
+                m_n = beta1 * m_ + (1.0 - beta1) * grad_
+                v_n = beta2 * v_ + (1.0 - beta2) * grad_ * grad_
+                adam_step = (m_n / bc1) / denom_of(v_n)
+                new_p, trust = lamb_step(p32, adam_step, min_coeff, max_coeff)
+                # EMA of the observed trust ratio -> the frozen coefficient
+                coeff_n = self.coeff_beta * coeff_ + \
+                    (1.0 - self.coeff_beta) * trust
+                return new_p, m_n, v_n, v_n, we_, se_, coeff_n, lf_
+
+            def compressed(ops):
+                grad_, m_, v_, v_fresh_, we_, se_, coeff_, lf_ = ops
+                m_local = beta1 * m_ + (1.0 - beta1) * grad_
+                m_n, we_n, se_n = compressed_allreduce(
+                    m_local, we_, se_, comm_axis)
+                # rebuild a fresh second-moment estimate from the
+                # decompressed momentum delta (reference exp_avg_sq_fresh)
+                g_est = (m_n - beta1 * m_) / (1.0 - beta1)
+                v_fresh_n = beta2 * v_fresh_ + (1.0 - beta2) * g_est * g_est
+                # frozen coefficient modulated by sqrt(fresh/frozen),
+                # clamped + rate-limited (reference factor_max/min/threshold)
+                ratio = jnp.sqrt(
+                    (jnp.mean(v_fresh_n) + eps) / (jnp.mean(v_) + eps))
+                factor = jnp.clip(ratio, self.factor_min, self.factor_max)
+                factor = jnp.clip(factor,
+                                  lf_ * (1.0 - self.factor_threshold),
+                                  lf_ * (1.0 + self.factor_threshold))
+                # constant denominator after freeze (no bias corrections):
+                # a growing 1/bc2 on the frozen v would be an unintended
+                # lr ramp (reference 1-bit lamb uses exp_avg_sq.sqrt()+eps)
+                adam_step = m_n / (jnp.sqrt(v_) + eps)
+                new_p, _ = lamb_step(p32, adam_step, min_coeff, max_coeff,
+                                     fixed_coeff=coeff_ * factor)
+                return new_p, m_n, v_, v_fresh_n, we_n, se_n, coeff_, factor
+
+            ops = (grad, m, v, v_fresh, we, se, coeff, last_factor)
+            new_p, m_n, v_n, vf_n, we_n, se_n, coeff_n, lf_n = lax.cond(
+                frozen, compressed, warm, ops)
+            return (new_p.astype(p.dtype), m_n, v_n, vf_n, we_n, se_n,
+                    coeff_n, lf_n)
+
+        p_leaves, treedef = jax.tree_util.tree_flatten(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        m_leaves = treedef.flatten_up_to(state["exp_avg"])
+        v_leaves = treedef.flatten_up_to(state["exp_avg_sq"])
+        vf_leaves = treedef.flatten_up_to(state["exp_avg_sq_fresh"])
+        we_leaves = treedef.flatten_up_to(state["worker_error"])
+        se_leaves = treedef.flatten_up_to(state["server_error"])
+        c_leaves = treedef.flatten_up_to(state["lamb_coeff_freeze"])
+        f_leaves = treedef.flatten_up_to(state["last_factor"])
+        outs = [upd(*args) for args in zip(p_leaves, g_leaves, m_leaves,
+                                           v_leaves, vf_leaves, we_leaves,
+                                           se_leaves, c_leaves, f_leaves)]
+        unf = lambda i: jax.tree_util.tree_unflatten(
+            treedef, [o[i] for o in outs])
+        new_state = {
+            "step": step,
+            "exp_avg": unf(1),
+            "exp_avg_sq": unf(2),
+            "exp_avg_sq_fresh": unf(3),
+            "worker_error": unf(4),
+            "server_error": unf(5),
+            "lamb_coeff_freeze": unf(6),
+            "last_factor": unf(7),
+        }
+        return unf(0), new_state
+
+    def state_dict(self):
+        return {"param_groups": [dict(g) for g in self.param_groups],
+                "freeze_step": self.freeze_step}
+
+    def load_state_dict(self, sd):
+        if "param_groups" in sd:
+            self.param_groups = [dict(g) for g in sd["param_groups"]]
+        self.freeze_step = int(sd.get("freeze_step", self.freeze_step))

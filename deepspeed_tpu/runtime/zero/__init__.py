@@ -1,1 +1,3 @@
 from .config import DeepSpeedZeroConfig  # noqa: F401
+from .partition_parameters import GatheredParameters, Init  # noqa: F401
+from .tiling import TiledLinear  # noqa: F401

@@ -1,0 +1,126 @@
+"""ZeRO-3 parameter-partitioning API surface.
+
+Reference: deepspeed/runtime/zero/partition_parameters.py — `zero.Init`
+(:265) monkey-patches nn.Module.__init__ so every parameter is partitioned
+at construction (1/world per rank, optionally on cpu/nvme), and
+`GatheredParameters` (:1002) temporarily all-gathers partitioned params for
+host-side surgery.
+
+TPU redesign: XLA materializes ARRAYS, not modules, so `Init` wraps the
+model's init function: the init runs under jit with `out_shardings` set to
+the ZeRO-3 plan, meaning every parameter is CREATED already sharded across
+the data axis — no single-device full copy ever exists (the same guarantee
+zero.Init's patching buys, without patching). `GatheredParameters`
+device_puts to replicated for the body and re-shards on exit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...comm.mesh import MeshInfo, get_current_mesh
+from ...utils.logging import log_dist
+from .partition import ZeroShardingPlan
+
+
+class Init:
+    """Materialize parameters directly sharded (reference zero.Init :265).
+
+    Usage:
+        with zero.Init(mesh_info=info) as zinit:
+            params = zinit.materialize(model.init, rng)
+        # params leaves are sharded over the data axis; no device ever
+        # held the full tree
+
+    `remote_device` / `pin_memory` / `config` keywords are accepted for
+    API parity; "cpu" remote_device materializes on host instead.
+    """
+
+    def __init__(self, module=None, data_parallel_group=None,
+                 mem_efficient_linear=True, remote_device: Optional[str] = None,
+                 pin_memory: bool = False, deepspeed_config=None,
+                 param_dict=None, enabled: bool = True,
+                 mesh_info: Optional[MeshInfo] = None,
+                 param_specs=None):
+        self.enabled = enabled
+        self.mesh_info = mesh_info or get_current_mesh()
+        self.remote_device = remote_device
+        self.param_specs = param_specs
+        self._plan = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, init_fn: Callable, *init_args):
+        """Run `init_fn(*init_args)` with ZeRO-3 output shardings."""
+        if not self.enabled:
+            return init_fn(*init_args)
+        abstract = jax.eval_shape(init_fn, *init_args)
+        plan = ZeroShardingPlan(3, self.mesh_info, abstract,
+                                param_specs=self.param_specs)
+        self._plan = plan
+        if self.remote_device == "cpu":
+            # host materialization (reference remote_device='cpu')
+            params = jax.jit(init_fn, backend="cpu")(*init_args) \
+                if jax.default_backend() != "cpu" else init_fn(*init_args)
+            return jax.device_put(params, plan.param_shardings())
+        sharded_init = jax.jit(init_fn,
+                               out_shardings=plan.param_shardings())
+        params = sharded_init(*init_args)
+        log_dist("zero.Init: materialized parameters sharded over the data "
+                 "axis (stage-3 plan)", ranks=[0])
+        return params
+
+    @property
+    def plan(self) -> Optional[ZeroShardingPlan]:
+        return self._plan
+
+
+class GatheredParameters:
+    """reference partition_parameters.py:1002 — temporarily gather
+    partitioned params for host-side reads/writes.
+
+    with GatheredParameters(params) as g:
+        g.params = mutate(g.params)     # full (replicated) values
+    params = g.params                    # re-sharded on exit
+
+    `modifier_rank` is accepted for parity; in single-controller JAX every
+    process sees the same values, so rank-0 broadcast is implicit.
+    """
+
+    def __init__(self, params, modifier_rank: Optional[int] = None,
+                 fwd_module=None, enabled: bool = True,
+                 shardings=None, mesh_info: Optional[MeshInfo] = None):
+        self.enabled = enabled
+        self._orig_shardings = shardings
+        self.mesh_info = mesh_info or get_current_mesh()
+        self.params = params
+
+    def __enter__(self):
+        if not self.enabled:
+            return self
+        if self._orig_shardings is None:
+            self._orig_shardings = jax.tree_util.tree_map(
+                lambda l: l.sharding if hasattr(l, "sharding") else None,
+                self.params)
+        mesh = self.mesh_info.mesh
+        replicated = NamedSharding(mesh, PartitionSpec())
+        self.params = jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, replicated)
+            if hasattr(l, "sharding") else l, self.params)
+        return self
+
+    def __exit__(self, *exc):
+        if not self.enabled:
+            return False
+        self.params = jax.tree_util.tree_map(
+            lambda l, s: jax.device_put(l, s) if s is not None else l,
+            self.params, self._orig_shardings)
+        return False

@@ -1,0 +1,81 @@
+"""zero_to_fp32 — offline checkpoint -> single fp32 state dict.
+
+Reference: deepspeed/utils/zero_to_fp32.py:21-151 merges per-rank ZeRO
+shard files into one fp32 state_dict; the engine drops a copy of the
+script next to every checkpoint (reference engine.py:1800-1808).
+
+This framework's checkpoints already store the consolidated fp32 master
+pytree (runtime/checkpointing.py), so the job here is: load the tagged
+checkpoint, strip training state (optimizer/scaler/scheduler), upcast to
+fp32, and write one portable msgpack (or .npz) file.
+
+Usage:
+    python -m deepspeed_tpu.utils.zero_to_fp32 <checkpoint_dir> <output_file>
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: str = None):
+    """reference zero_to_fp32.py:70-121 (same name/signature)."""
+    from ..runtime import checkpointing as ckpt_io
+
+    _dir, model_state, _optim = ckpt_io.load_checkpoint_state(
+        checkpoint_dir, tag)
+    module = model_state["module"]
+
+    def to_fp32(x):
+        arr = np.asarray(x)
+        if np.issubdtype(arr.dtype, np.floating):
+            return arr.astype(np.float32)
+        return arr
+
+    import jax
+
+    return jax.tree_util.tree_map(to_fp32, module)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: str = None):
+    """reference zero_to_fp32.py:124-141."""
+    state_dict = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    from flax import serialization
+
+    with open(output_file, "wb") as fh:
+        fh.write(serialization.msgpack_serialize(state_dict))
+    print(f"saved fp32 state dict to {output_file}")
+    return state_dict
+
+
+def load_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: str = None):
+    """Parity helper: returns the fp32 pytree ready for jnp.asarray."""
+    return get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("checkpoint_dir",
+                        help="checkpoint dir (holds 'latest' + tag dirs)")
+    parser.add_argument("output_file",
+                        help="output msgpack path for the fp32 state dict")
+    parser.add_argument("-t", "--tag", default=None,
+                        help="checkpoint tag (default: read 'latest')")
+    args = parser.parse_args(argv)
+    if not os.path.isdir(args.checkpoint_dir):
+        print(f"no such checkpoint dir: {args.checkpoint_dir}")
+        return 1
+    convert_zero_checkpoint_to_fp32_state_dict(
+        args.checkpoint_dir, args.output_file, tag=args.tag)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

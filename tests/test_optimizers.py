@@ -142,3 +142,127 @@ def test_onebit_adam_distributed_compressed_allreduce():
     assert np.isfinite(out).all()
     cos = np.dot(-out, dense) / (np.linalg.norm(out) * np.linalg.norm(dense))
     assert cos > 0.5
+
+
+# ---------------------------------------------------------------------------
+# 1-bit LAMB (reference runtime/fp16/onebit/lamb.py)
+# ---------------------------------------------------------------------------
+
+def test_onebit_lamb_warmup_matches_fused_lamb():
+    from deepspeed_tpu.ops.lamb import FusedLamb
+    from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
+
+    rng = np.random.RandomState(5)
+    params = {"w": jnp.asarray(rng.randn(16).astype(np.float32))}
+    grads = {"w": jnp.asarray(rng.randn(16).astype(np.float32))}
+    ol = OnebitLamb(lr=1e-2, freeze_step=100, weight_decay=0.0)
+    fl = FusedLamb(lr=1e-2, weight_decay=0.0)
+    p1, s1 = ol.update(grads, ol.init(params), params)
+    p2, _ = fl.update(grads, fl.init(params), params)
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(p2["w"]),
+                               rtol=1e-5)
+    # trust-ratio EMA began accumulating
+    assert float(s1["lamb_coeff_freeze"]["w"]) > 0.0
+
+
+def test_onebit_lamb_frozen_stage_state_machine():
+    from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
+
+    params = {"w": jnp.asarray(np.linspace(-1, 1, 16), dtype=jnp.float32)}
+    grads = {"w": jnp.asarray(np.linspace(1, -1, 16), dtype=jnp.float32)}
+    ol = OnebitLamb(lr=1e-3, freeze_step=1)
+    state = ol.init(params)
+    params, state = ol.update(grads, state, params)   # warmup step
+    assert np.allclose(np.asarray(state["worker_error"]["w"]), 0)
+    v_frozen = np.asarray(state["exp_avg_sq"]["w"])
+    params, state = ol.update(grads, state, params)   # compressed step
+    assert not np.allclose(np.asarray(state["worker_error"]["w"]), 0)
+    np.testing.assert_allclose(np.asarray(state["exp_avg_sq"]["w"]), v_frozen)
+    # factor rate-limited around 1.0 by factor_threshold
+    assert 0.5 <= float(state["last_factor"]["w"]) <= 4.0
+
+
+def test_onebit_lamb_converges_quadratic():
+    from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
+
+    target = jnp.asarray(np.linspace(0.5, -0.5, 8), dtype=jnp.float32)
+    params = {"w": jnp.zeros((8,))}
+    ol = OnebitLamb(lr=2e-2, freeze_step=30)
+    state = ol.init(params)
+
+    def loss(p):
+        return jnp.sum((p["w"] - target) ** 2)
+
+    l0 = float(loss(params))
+    for _ in range(60):  # crosses into the compressed stage at step 31
+        grads = jax.grad(loss)(params)
+        params, state = ol.update(grads, state, params)
+    # sign-compressed updates converge to a noise ball (no lr decay here):
+    # require a large decrease and a stable (non-diverging) frozen stage
+    assert float(loss(params)) < 0.3 * l0, float(loss(params))
+    assert np.isfinite(np.asarray(params["w"])).all()
+
+
+def test_onebit_lamb_through_engine():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+
+    model = GPT(gpt2_config("nano", vocab_size=128, max_seq_len=32))
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
+        "train_batch_size": 8,
+        "optimizer": {"type": "OneBitLamb",
+                      "params": {"lr": 1e-3, "freeze_step": 2}},
+        "mesh": {"data": 8}})
+    tok = jax.random.randint(jax.random.PRNGKey(0), (8, 17), 0, 128)
+    batch = (tok[:, :-1], tok[:, 1:])
+    for _ in range(4):
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+    assert engine.global_steps == 4
+
+
+# ---------------------------------------------------------------------------
+# compressed comm backends (reference runtime/comm/nccl.py, compressed_ar.py)
+# ---------------------------------------------------------------------------
+
+def test_compressed_backend_approximates_mean():
+    from deepspeed_tpu.runtime.comm import CompressedBackend
+
+    comm.make_mesh(data=8)
+    rng = np.random.RandomState(7)
+    x = rng.randn(8, 64).astype(np.float32)
+    backend = CompressedBackend(axis="data")
+    dense = x.mean(axis=0)
+    # a single 1-bit output is coarse; error feedback guarantees the
+    # TIME-AVERAGED output converges to the true mean (the carried error
+    # re-injects what compression dropped)
+    outs = []
+    for _ in range(40):
+        outs.append(np.asarray(
+            backend.compressed_allreduce(jnp.asarray(x), name="g"))[0])
+    avg = np.mean(outs, axis=0)
+    cos = float(np.dot(avg, dense) /
+                (np.linalg.norm(avg) * np.linalg.norm(dense) + 1e-9))
+    assert cos > 0.9, cos
+
+
+def test_compressed_ar_bf16_split_matches_sum():
+    from deepspeed_tpu.runtime.comm import (compressed_all_reduce, decompose,
+                                            reconstruct)
+
+    # frexp/ldexp roundtrip is exact
+    t = jnp.asarray(np.random.RandomState(0).randn(32), jnp.bfloat16)
+    m, e = decompose(t)
+    np.testing.assert_array_equal(
+        np.asarray(reconstruct(m, e).astype(jnp.float32)),
+        np.asarray(t.astype(jnp.float32)))
+
+    comm.make_mesh(data=8)
+    x = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    out = np.asarray(compressed_all_reduce(
+        jnp.asarray(x, jnp.bfloat16), axis="data").astype(jnp.float32))
+    want = x.sum(axis=0)
+    # every shard row holds the sum
+    np.testing.assert_allclose(out[0], want, rtol=0.05, atol=0.05)
+    np.testing.assert_allclose(out[7], out[0], rtol=1e-6)
