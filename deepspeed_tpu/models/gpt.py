@@ -53,11 +53,30 @@ class GPTConfig:
     pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
     pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
     sequence_parallel: bool = False  # ring attention over the `seq` axis
+    # Mixture-of-Experts (beyond-parity; reference has no MoE, SURVEY §2.2)
+    num_experts: int = 1             # >1: MoE FFN every moe_layer_freq layers
+    moe_top_k: int = 1
+    moe_layer_freq: int = 2          # MoE on layers with idx % freq == 1
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_weight: float = 1e-2
 
     def __post_init__(self):
         if self.d_ff is None:
             self.d_ff = 4 * self.d_model
         assert self.d_model % self.num_heads == 0
+        if self.num_experts > 1 and self.pipeline_stages > 1:
+            raise ValueError("MoE and pipeline mode are mutually exclusive "
+                             "for now (stacked stage params must be uniform)")
+
+    def is_moe_layer(self, idx: int) -> bool:
+        return self.num_experts > 1 and idx % self.moe_layer_freq == 1
+
+    def moe_config(self):
+        from ..moe.layer import MoEConfig
+
+        return MoEConfig(d_model=self.d_model, d_ff=self.d_ff,
+                         num_experts=self.num_experts, top_k=self.moe_top_k,
+                         capacity_factor=self.moe_capacity_factor)
 
     @property
     def head_dim(self):
@@ -85,8 +104,8 @@ def gpt2_config(size: str = "small", **overrides) -> GPTConfig:
 # init
 # ---------------------------------------------------------------------------
 
-def _init_block(rng, cfg: GPTConfig):
-    k = jax.random.split(rng, 4)
+def _init_block(rng, cfg: GPTConfig, layer_idx: int = 0):
+    k = jax.random.split(rng, 5)
     d, f = cfg.d_model, cfg.d_ff
     std = 0.02
     proj_std = std / math.sqrt(2 * cfg.num_layers)  # GPT-2 residual scaling
@@ -100,18 +119,39 @@ def _init_block(rng, cfg: GPTConfig):
                      "b": jnp.zeros((d,), dt)},
         },
         "ln2": {"scale": jnp.ones((d,), dt), "bias": jnp.zeros((d,), dt)},
-        "mlp": {
+    } | (
+        {"moe": _moe(cfg).init(k[4], param_dtype=dt)}
+        if cfg.is_moe_layer(layer_idx) else
+        {"mlp": {
             "fc1": {"w": (jax.random.normal(k[2], (d, f)) * std).astype(dt),
                     "b": jnp.zeros((f,), dt)},
             "fc2": {"w": (jax.random.normal(k[3], (f, d)) * proj_std).astype(dt),
                     "b": jnp.zeros((d,), dt)},
-        },
-    }
+        }})
 
 
-def _block_specs(cfg: GPTConfig):
+def _moe(cfg: GPTConfig):
+    from ..moe.layer import MoE
+
+    return MoE(cfg.moe_config())
+
+
+def _block_specs(cfg: GPTConfig, layer_idx: int = 0):
     """Megatron TP layout: column-parallel qkv/fc1 (shard output dim over
-    `model`), row-parallel proj/fc2 (shard input dim)."""
+    `model`), row-parallel proj/fc2 (shard input dim). MoE layers swap the
+    MLP specs for expert-parallel ones (expert dim over `data`)."""
+    if cfg.is_moe_layer(layer_idx):
+        from ..moe.layer import MoE
+
+        return {
+            "ln1": {"scale": P(), "bias": P()},
+            "attn": {
+                "qkv": {"w": P(None, MODEL_AXIS), "b": P(MODEL_AXIS)},
+                "proj": {"w": P(MODEL_AXIS, None), "b": P()},
+            },
+            "ln2": {"scale": P(), "bias": P()},
+            "moe": MoE.param_specs(),
+        }
     return {
         "ln1": {"scale": P(), "bias": P()},
         "attn": {
@@ -188,14 +228,21 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
     x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
 
     h = layer_norm(x, p["ln2"], cfg.layer_norm_eps)
-    h = h @ p["mlp"]["fc1"]["w"].astype(h.dtype) + \
-        p["mlp"]["fc1"]["b"].astype(h.dtype)
-    h = jax.nn.gelu(h, approximate=True)
-    h = _constrain(h, cfg, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
-    h = h @ p["mlp"]["fc2"]["w"].astype(h.dtype) + \
-        p["mlp"]["fc2"]["b"].astype(h.dtype)
+    aux = jnp.zeros((), jnp.float32)
+    if "moe" in p:
+        r_moe = None
+        if r3 is not None:
+            r_moe, r3 = jax.random.split(r3)
+        h, aux = _moe(cfg)(p["moe"], h, rng=r_moe, train=train)
+    else:
+        h = h @ p["mlp"]["fc1"]["w"].astype(h.dtype) + \
+            p["mlp"]["fc1"]["b"].astype(h.dtype)
+        h = jax.nn.gelu(h, approximate=True)
+        h = _constrain(h, cfg, P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS))
+        h = h @ p["mlp"]["fc2"]["w"].astype(h.dtype) + \
+            p["mlp"]["fc2"]["b"].astype(h.dtype)
     x = x + _dropout(h, cfg.dropout, r3, train)
-    return _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
+    return _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None)), aux
 
 
 class GPT(TrainModule):
@@ -225,7 +272,7 @@ class GPT(TrainModule):
         return params
 
     def _init_blocks(self, keys, cfg):
-        blocks = [_init_block(k, cfg) for k in keys]
+        blocks = [_init_block(k, cfg, i) for i, k in enumerate(keys)]
         if cfg.pipeline_stages > 1:
             from ..parallel.pipeline import stack_stage_params
 
@@ -240,7 +287,7 @@ class GPT(TrainModule):
                 lambda s: P(PIPE_AXIS, *s), _block_specs(cfg),
                 is_leaf=lambda x: isinstance(x, P))
         else:
-            blocks = [_block_specs(cfg) for _ in range(cfg.num_layers)]
+            blocks = [_block_specs(cfg, i) for i in range(cfg.num_layers)]
         specs = {
             "wte": P(MODEL_AXIS, None),   # vocab-parallel embedding
             "wpe": P(),
@@ -252,9 +299,12 @@ class GPT(TrainModule):
         return specs
 
     # -- forward -------------------------------------------------------
-    def apply(self, params, tokens, rng=None, train=False, pld_mask=None):
-        """tokens [B, S] int32 -> logits [B, S, V]."""
+    def apply(self, params, tokens, rng=None, train=False, pld_mask=None,
+              with_aux=False):
+        """tokens [B, S] int32 -> logits [B, S, V] (with_aux: also the
+        summed MoE load-balancing loss)."""
         cfg = self.config
+        aux_total = jnp.zeros((), jnp.float32)
         B, S = tokens.shape
         x = params["wte"][tokens] + params["wpe"][:S][None, :, :]
         if rng is not None:
@@ -267,7 +317,7 @@ class GPT(TrainModule):
             from ..parallel.pipeline import spmd_pipeline
 
             x = spmd_pipeline(
-                lambda p, h: gpt_block(h, p, cfg, None, train),
+                lambda p, h: gpt_block(h, p, cfg, None, train)[0],
                 params["blocks"], x, get_current_mesh(),
                 num_micro=cfg.pipeline_micro_batches, remat=cfg.remat)
         else:
@@ -281,7 +331,11 @@ class GPT(TrainModule):
                 sub = None
                 if rng is not None:
                     rng, sub = jax.random.split(rng)
-                out = block_fn(x, bp, cfg, sub, train)
+                out, aux = block_fn(x, bp, cfg, sub, train)
+                if pld_mask is not None:
+                    # a dropped layer contributes neither output nor aux
+                    aux = jnp.where(pld_mask[i], aux, 0.0)
+                aux_total = aux_total + aux
                 if pld_mask is not None:
                     # progressive layer drop: keep probability theta per layer
                     # (reference progressive_layer_drop.py; engine.py:972-973)
@@ -293,6 +347,8 @@ class GPT(TrainModule):
             logits = x @ params["wte"].T.astype(x.dtype)
         else:
             logits = x @ params["lm_head"].astype(x.dtype)
+        if with_aux:
+            return logits, aux_total
         return logits
 
     def loss(self, params, batch, rng=None, train=True,
@@ -316,8 +372,8 @@ class GPT(TrainModule):
             pld_mask = jax.random.bernoulli(
                 sub, pld_theta, (self.config.num_layers,))
 
-        logits = self.apply(params, tokens, rng=rng, train=train,
-                            pld_mask=pld_mask)
+        logits, moe_aux = self.apply(params, tokens, rng=rng, train=train,
+                                     pld_mask=pld_mask, with_aux=True)
         logits = logits.astype(jnp.float32)
         valid = (labels >= 0)
         safe_labels = jnp.where(valid, labels, 0)
@@ -325,7 +381,12 @@ class GPT(TrainModule):
         nll = -jnp.take_along_axis(logp, safe_labels[..., None],
                                    axis=-1)[..., 0]
         nll = jnp.where(valid, nll, 0.0)
-        return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+        ce = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+        if self.config.num_experts > 1 and train:
+            # aux applies to the training objective only — eval loss stays
+            # pure CE so perplexity comparisons are unbiased
+            ce = ce + self.config.moe_aux_loss_weight * moe_aux
+        return ce
 
     # -- convenience ---------------------------------------------------
     def num_params(self, params=None) -> int:

@@ -1,0 +1,3 @@
+from .layer import MoE, MoEConfig, top_k_gating
+
+__all__ = ["MoE", "MoEConfig", "top_k_gating"]

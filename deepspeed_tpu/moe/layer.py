@@ -1,0 +1,162 @@
+"""Mixture-of-Experts layer with expert parallelism.
+
+BEYOND-PARITY: the reference (v0.3.15) has no MoE (SURVEY.md §2.2 "EP:
+absent"); upstream DeepSpeed grew deepspeed.moe later. Built TPU-first:
+
+* experts are STACKED on a leading dim [E, ...] and sharded over the
+  `data` mesh axis (DeepSpeed-style expert parallelism: EP group == DP
+  group). Tokens are sharded over `data` too, so the dispatch einsum's
+  contraction makes XLA insert the all_to_all that MPI/NCCL MoE stacks
+  hand-write.
+* GShard/Switch dense dispatch: top-k gating with capacity, one-hot
+  dispatch/combine tensors, einsum expert compute — static shapes, MXU
+  batched matmuls, no data-dependent control flow.
+* load-balancing aux loss (Switch Transformer eq. 4) returned alongside
+  the output for the model to add to its objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import DATA_AXIS
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    d_model: int
+    d_ff: int
+    num_experts: int
+    top_k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_std: float = 1e-2   # jitter on gate logits during training
+
+    def __post_init__(self):
+        if self.top_k > self.num_experts:
+            raise ValueError(
+                f"top_k ({self.top_k}) cannot exceed num_experts "
+                f"({self.num_experts}): after masking every expert once, "
+                f"further rounds would re-route to expert 0")
+
+
+def top_k_gating(logits, k: int, capacity: int, rng=None,
+                 noise_std: float = 0.0):
+    """GShard top-k gating with capacity.
+
+    logits: [N, E] -> (combine [N, E, C] fp32, dispatch [N, E, C] bool,
+    aux_loss scalar). Tokens beyond an expert's capacity are dropped
+    (their combine weights are zero -> residual passthrough upstream).
+    """
+    N, E = logits.shape
+    if rng is not None and noise_std > 0.0:
+        logits = logits + noise_std * jax.random.normal(rng, logits.shape)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    combine = jnp.zeros((N, E, capacity), jnp.float32)
+    dispatch = jnp.zeros((N, E, capacity), bool)
+    masked = probs
+    # fill per-expert slots k rounds in priority order; counts carry over
+    base_counts = jnp.zeros((E,), jnp.int32)
+    aux_frac = jnp.zeros((), jnp.float32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)                     # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [N, E]
+        # position of each token within its chosen expert's queue
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot  # [N, E]
+        pos = (pos_in_e.sum(-1) + base_counts[idx]).astype(jnp.int32)  # [N]
+        keep = pos < capacity
+        gate = jnp.take_along_axis(probs, idx[:, None], 1)[:, 0] * keep
+        slot = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity + 1,
+                              dtype=jnp.float32)[:, :capacity]  # [N, C]
+        contrib = onehot[:, :, None] * slot[:, None, :]
+        combine = combine + gate[:, None, None] * contrib
+        dispatch = jnp.logical_or(dispatch, contrib > 0)
+        base_counts = base_counts + onehot.sum(0).astype(jnp.int32)
+        aux_frac = aux_frac + jnp.mean(onehot, axis=0).dot(
+            jnp.mean(probs, axis=0)) * E
+        masked = masked * (1.0 - onehot)  # next round picks a new expert
+    aux_loss = aux_frac / k
+    return combine, dispatch, aux_loss
+
+
+class MoE:
+    """Functional MoE FFN: __call__(params, x, rng, train) -> (y, aux)."""
+
+    def __init__(self, config: MoEConfig):
+        self.config = config
+
+    def init(self, rng, param_dtype=jnp.float32):
+        cfg = self.config
+        k1, k2, k3 = jax.random.split(rng, 3)
+        d, f, E = cfg.d_model, cfg.d_ff, cfg.num_experts
+        n = lambda k, s, sd: (sd * jax.random.normal(k, s)).astype(param_dtype)
+        return {
+            "gate": {"w": n(k1, (d, E), 0.02)},
+            "experts": {
+                "w1": n(k2, (E, d, f), d ** -0.5),
+                "b1": jnp.zeros((E, f), param_dtype),
+                "w2": n(k3, (E, f, d), f ** -0.5),
+                "b2": jnp.zeros((E, d), param_dtype),
+            },
+        }
+
+    @staticmethod
+    def param_specs():
+        """Expert-parallel: the expert dim rides the data axis."""
+        return {
+            "gate": {"w": P()},
+            "experts": {"w1": P(DATA_AXIS, None, None),
+                        "b1": P(DATA_AXIS, None),
+                        "w2": P(DATA_AXIS, None, None),
+                        "b2": P(DATA_AXIS, None)},
+        }
+
+    def capacity(self, tokens_per_group: int, train: bool) -> int:
+        cfg = self.config
+        factor = cfg.capacity_factor if train else cfg.eval_capacity_factor
+        cap = int(factor * tokens_per_group * cfg.top_k /
+                  max(cfg.num_experts, 1))
+        return max(cap, cfg.min_capacity)
+
+    def __call__(self, params, x, rng=None, train=True
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        """Grouped (GShard-style) dispatch: gating runs per batch row, so
+        dispatch/combine are [B, S, E, C] with C ~ S/E — memory linear in
+        tokens (a single global group would make them quadratic)."""
+        cfg = self.config
+        B, S, D = x.shape
+        logits = jnp.einsum("bsd,de->bse", x,
+                            params["gate"]["w"].astype(x.dtype))
+        cap = self.capacity(S, train)
+        noise = cfg.noisy_gate_std if (train and rng is not None) else 0.0
+        keys = (jax.random.split(rng, B) if noise > 0.0
+                else jnp.zeros((B, 2), jnp.uint32))
+        combine, dispatch, aux = jax.vmap(
+            lambda lg, k: top_k_gating(lg, cfg.top_k, cap,
+                                       rng=k if noise > 0.0 else None,
+                                       noise_std=noise))(logits, keys)
+        aux = jnp.mean(aux)
+
+        w1 = params["experts"]["w1"].astype(x.dtype)
+        b1 = params["experts"]["b1"].astype(x.dtype)
+        w2 = params["experts"]["w2"].astype(x.dtype)
+        b2 = params["experts"]["b2"].astype(x.dtype)
+        # dispatch: [B,S,E,C] x [B,S,D] -> [E,B,C,D] (all_to_all under
+        # sharding: tokens sharded over data, experts sharded over data)
+        expert_in = jnp.einsum("bsec,bsd->ebcd",
+                               dispatch.astype(x.dtype), x)
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, w1) + \
+            b1[:, None, None, :]
+        h = jax.nn.gelu(h, approximate=True)
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, w2) + \
+            b2[:, None, None, :]
+        # combine: [B,S,E,C] x [E,B,C,D] -> [B,S,D]
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
+        return y, aux.astype(jnp.float32)

@@ -47,6 +47,10 @@ def add_data_axis(spec: Optional[PartitionSpec], shape, dp_size: int,
     dims = _spec_to_list(spec, len(shape))
     if dp_size <= 1 or int(np.prod(shape or (1,))) < min_size_to_shard:
         return PartitionSpec(*dims)
+    flat = [a for d in dims if d is not None
+            for a in (d if isinstance(d, tuple) else (d,))]
+    if DATA_AXIS in flat:  # already data-sharded (e.g. expert-parallel)
+        return PartitionSpec(*dims)
     best, best_len = None, 0
     for i, d in enumerate(shape):
         if dims[i] is None and d % dp_size == 0 and d > best_len:
@@ -78,6 +82,15 @@ class ZeroShardingPlan:
         if param_specs is None:
             param_specs = jax.tree_util.tree_map(lambda _: PartitionSpec(),
                                                  params)
+        else:
+            # drop spec axes the current mesh can't honor (dim not
+            # divisible by the axis size) — keeps model-supplied TP/EP
+            # layouts elastic across mesh widths (e.g. 4 experts resumed
+            # on an 8-wide data axis fall back to replication)
+            param_specs = jax.tree_util.tree_map(
+                lambda s, l: self._sanitize(s, getattr(l, "shape", ())),
+                param_specs, params,
+                is_leaf=lambda x: isinstance(x, PartitionSpec) or x is None)
 
         def with_dp(spec, leaf):
             return add_data_axis(spec, leaf.shape, dp, min_size_to_shard)
@@ -106,6 +119,25 @@ class ZeroShardingPlan:
                                                    params, is_leaf=is_spec)
         else:
             self.opt_spec = self.param_spec
+
+    def _sanitize(self, spec: Optional[PartitionSpec], shape):
+        if spec is None:
+            return PartitionSpec()
+        dims = _spec_to_list(spec, len(shape))
+        out = []
+        for i, d in enumerate(dims):
+            if d is None:
+                out.append(None)
+                continue
+            axes = d if isinstance(d, tuple) else (d,)
+            size = 1
+            for a in axes:
+                size *= self.mesh_info.axis_size(a)
+            if size > 1 and (i >= len(shape) or shape[i] % size != 0):
+                out.append(None)  # mesh can't honor this axis here
+            else:
+                out.append(d)
+        return PartitionSpec(*out)
 
     # -- NamedSharding views ------------------------------------------
 
