@@ -44,6 +44,7 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     initializer_range: float = 0.02
     pre_layer_norm: bool = True      # reference ships both (modelingpreln.py)
+    sparsity_config: Any = None      # block-sparse attention (SparseAttentionUtils)
     remat: bool = False
     attn_impl: str = "auto"
     param_dtype: Any = jnp.float32
@@ -66,6 +67,7 @@ class BertConfig:
             layer_norm_eps=self.layer_norm_eps,
             pre_layer_norm=self.pre_layer_norm,
             attn_impl=self.attn_impl,
+            sparsity_config=self.sparsity_config,
             dtype=self.compute_dtype)
 
 

@@ -69,7 +69,9 @@ class GPTConfig:
                              "for now (stacked stage params must be uniform)")
 
     def is_moe_layer(self, idx: int) -> bool:
-        return self.num_experts > 1 and idx % self.moe_layer_freq == 1
+        # freq f -> layers f-1, 2f-1, ... (f=1: every layer; f=2: odd layers)
+        return (self.num_experts > 1 and
+                idx % self.moe_layer_freq == self.moe_layer_freq - 1)
 
     def moe_config(self):
         from ..moe.layer import MoEConfig
@@ -333,13 +335,11 @@ class GPT(TrainModule):
                     rng, sub = jax.random.split(rng)
                 out, aux = block_fn(x, bp, cfg, sub, train)
                 if pld_mask is not None:
+                    # progressive layer drop (reference engine.py:972-973):
                     # a dropped layer contributes neither output nor aux
                     aux = jnp.where(pld_mask[i], aux, 0.0)
-                aux_total = aux_total + aux
-                if pld_mask is not None:
-                    # progressive layer drop: keep probability theta per layer
-                    # (reference progressive_layer_drop.py; engine.py:972-973)
                     out = jnp.where(pld_mask[i], out, x)
+                aux_total = aux_total + aux
                 x = out
 
         x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)

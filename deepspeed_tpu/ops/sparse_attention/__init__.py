@@ -4,9 +4,12 @@ from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
                               VariableSparsityConfig)
 from .sparse_attention import (SparseSelfAttention, block_sparse_attention,
                                layout_to_gather)
+from .sparse_attention_utils import (BertSparseSelfAttention,
+                                     SparseAttentionUtils)
 
 __all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
            "VariableSparsityConfig", "BigBirdSparsityConfig",
            "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
            "SparseSelfAttention", "block_sparse_attention",
-           "layout_to_gather"]
+           "layout_to_gather", "BertSparseSelfAttention",
+           "SparseAttentionUtils"]

@@ -46,7 +46,8 @@ def layout_to_gather(layout: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
 def block_sparse_attention(q, k, v, layout, block: int,
                            causal_token_mask: bool = False,
                            scale=None, key_padding_bias=None,
-                           attn_bias=None):
+                           attn_bias=None, dropout_rate: float = 0.0,
+                           dropout_rng=None):
     """Sparse attention over [B, S, H, D] inputs.
 
     layout: [H, nb, nb] numpy array (static — from SparsityConfig).
@@ -110,6 +111,10 @@ def block_sparse_attention(q, k, v, layout, block: int,
     flat = scores.reshape(B, H, nb, block, W * block)
     probs = jax.nn.softmax(flat, axis=-1).reshape(scores.shape)
     probs = jnp.where(mask, probs, 0.0)  # fully-masked rows -> zero output
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        dmask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(dmask, probs / keep, 0.0)
 
     out = jnp.einsum("bhiqwk,bhiwkd->bhiqd", probs,
                      vg.astype(jnp.float32),
@@ -139,7 +144,8 @@ class SparseSelfAttention:
         return self._layouts[seq_len]
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
-                 attn_mask=None):
+                 attn_mask=None, dropout_rate: float = 0.0,
+                 dropout_rng=None):
         """reference sparse_self_attention.py forward(query, key, value,
         rpe, key_padding_mask, attn_mask). Masks follow the configured
         modes: "add" = already-additive float bias, "mul" = 0/1 keep
@@ -168,4 +174,5 @@ class SparseSelfAttention:
         return block_sparse_attention(
             query, key, value, layout, self.sparsity_config.block,
             causal_token_mask=causal, key_padding_bias=key_padding_bias,
-            attn_bias=attn_bias)
+            attn_bias=attn_bias, dropout_rate=dropout_rate,
+            dropout_rng=dropout_rng)

@@ -1,0 +1,152 @@
+"""Sparse-attention model adaptation helpers.
+
+Reference: deepspeed/ops/sparse_attention/sparse_attention_utils.py (225
+LoC) — pad/unpad sequences to the block size, extend position embeddings
+for longer contexts, swap a BERT model's dense self-attention for
+block-sparse. Functional equivalents here operate on params pytrees and
+configs instead of mutating torch modules.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .sparse_attention import SparseSelfAttention
+from .sparsity_config import SparsityConfig
+
+
+class BertSparseSelfAttention:
+    """BERT-style self-attention over block-sparse scores (reference
+    bert_sparse_self_attention.py): q/k/v projections + SparseSelfAttention.
+
+    params: {"query": {"kernel","bias"}, "key": {...}, "value": {...}}
+    with [hidden, hidden] kernels.
+    """
+
+    def __init__(self, num_attention_heads: int, hidden_size: int,
+                 sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "mul"):
+        if hidden_size % num_attention_heads:
+            raise ValueError(
+                f"hidden size {hidden_size} not a multiple of heads "
+                f"{num_attention_heads}")
+        self.num_attention_heads = num_attention_heads
+        self.hidden_size = hidden_size
+        self.head_dim = hidden_size // num_attention_heads
+        # default "mul": attention_mask here is the BERT 0/1 keep mask
+        # (converted to large-negative bias); pass "add" for pre-built
+        # additive biases
+        self.sparse_self_attention = SparseSelfAttention(
+            sparsity_config or SparsityConfig(num_heads=num_attention_heads),
+            key_padding_mask_mode=key_padding_mask_mode)
+
+    def init(self, rng, param_dtype=jnp.float32):
+        ks = jax.random.split(rng, 3)
+        h = self.hidden_size
+        mk = lambda k: {"kernel": (0.02 * jax.random.normal(k, (h, h)))
+                        .astype(param_dtype),
+                        "bias": jnp.zeros((h,), param_dtype)}
+        return {"query": mk(ks[0]), "key": mk(ks[1]), "value": mk(ks[2])}
+
+    def __call__(self, params, hidden_states, attention_mask=None):
+        B, S, H = hidden_states.shape
+        heads, hd = self.num_attention_heads, self.head_dim
+
+        def proj(p):
+            y = hidden_states @ p["kernel"].astype(hidden_states.dtype) + \
+                p["bias"].astype(hidden_states.dtype)
+            return y.reshape(B, S, heads, hd)
+
+        q, k, v = proj(params["query"]), proj(params["key"]), \
+            proj(params["value"])
+        ctx = self.sparse_self_attention(
+            q, k, v, key_padding_mask=attention_mask)
+        return ctx.reshape(B, S, H)
+
+
+class SparseAttentionUtils:
+    """reference sparse_attention_utils.py — all @staticmethod surface."""
+
+    @staticmethod
+    def extend_position_embedding(position_embeddings,
+                                  max_position: int):
+        """Tile an existing [old_max, d] position table to `max_position`
+        (reference :38-73 repeats the learned table). Accepts the raw
+        array; returns the extended array."""
+        pe = jnp.asarray(position_embeddings)
+        old_max = pe.shape[0]
+        if max_position <= old_max:
+            return pe[:max_position]
+        reps = int(np.ceil(max_position / old_max))
+        return jnp.tile(pe, (reps, 1))[:max_position]
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position: int):
+        """reference :75-88."""
+        tokenizer.model_max_length = max_position
+        if hasattr(tokenizer, "init_kwargs"):
+            tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            config, sparsity_config: SparsityConfig):
+        """reference :90-128 swaps nn.Module attention layers in place; the
+        functional analog flips the model/layer CONFIG so its attention
+        dispatch routes through SparseSelfAttention (see
+        DeepSpeedTransformerConfig.sparsity_config /
+        BertConfig.sparsity_config). Returns the updated config."""
+        config.sparsity_config = sparsity_config
+        return config
+
+    @staticmethod
+    def pad_to_block_size(block_size: int, input_ids, attention_mask=None,
+                          token_type_ids=None, position_ids=None,
+                          inputs_embeds=None, pad_token_id: int = 0,
+                          model_embeddings=None):
+        """reference :130-200: right-pad sequence tensors to a multiple of
+        the sparsity block size. Returns (pad_len, padded tensors...)."""
+        seq_len = (input_ids.shape[1] if input_ids is not None
+                   else inputs_embeds.shape[1])
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len == 0:
+            return (0, input_ids, attention_mask, token_type_ids,
+                    position_ids, inputs_embeds)
+
+        def pad(x, value=0):
+            if x is None:
+                return None
+            widths = [(0, 0), (0, pad_len)] + \
+                [(0, 0)] * (x.ndim - 2)
+            return jnp.pad(x, widths, constant_values=value)
+
+        input_ids = pad(input_ids, pad_token_id)
+        attention_mask = pad(attention_mask, 0)
+        token_type_ids = pad(token_type_ids, 0)
+        position_ids = pad(position_ids, 0)
+        if inputs_embeds is not None:
+            if model_embeddings is not None:
+                # pad with the pad token's embedding (reference :180-189),
+                # not zeros; model_embeddings is the [vocab, d] table
+                pad_vec = jnp.asarray(model_embeddings)[pad_token_id]
+                tail = jnp.broadcast_to(
+                    pad_vec, (inputs_embeds.shape[0], pad_len,
+                              inputs_embeds.shape[2]))
+                inputs_embeds = jnp.concatenate([inputs_embeds, tail], axis=1)
+            else:
+                widths = [(0, 0), (0, pad_len), (0, 0)]
+                inputs_embeds = jnp.pad(inputs_embeds, widths)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int, sequence_output):
+        """reference :202-214."""
+        if pad_len > 0:
+            return sequence_output[:, :-pad_len]
+        return sequence_output

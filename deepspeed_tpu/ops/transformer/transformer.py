@@ -73,6 +73,9 @@ class DeepSpeedTransformerConfig:
     dtype: Any = None                 # compute dtype; None -> bf16 if fp16 else fp32
     attn_impl: str = "auto"           # auto|pallas|xla (ops/transformer)
     layer_id: int = -1
+    # block-sparse attention (SparseAttentionUtils.replace_model_self_
+    # attention_with_sparse_self_attention sets this)
+    sparsity_config: Any = None
 
     def __post_init__(self):
         if self.intermediate_size in (-1, None) and self.hidden_size > 0:
@@ -179,11 +182,29 @@ def transformer_layer_forward(params: Dict[str, jnp.ndarray],
             params["attn_qkvb"].astype(dtype)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (B, S, heads, hd)
-        ctx = multihead_attention(
-            q.reshape(shape), k.reshape(shape), v.reshape(shape),
-            causal=False, impl=cfg.attn_impl, bias=attention_mask,
-            dropout_rate=float(max(cfg.attn_dropout_ratio, 0.0)),
-            dropout_rng=r_attn, train=train)
+        if cfg.sparsity_config is not None:
+            from ..sparse_attention import SparseSelfAttention
+
+            # the BERT additive mask [B,1,1,S] is a per-key bias: feed it
+            # to the sparse kernel as an (already-additive) padding bias
+            sparse = SparseSelfAttention(cfg.sparsity_config,
+                                         key_padding_mask_mode="add")
+            kpm = None
+            if attention_mask is not None:
+                kpm = jnp.broadcast_to(
+                    jnp.asarray(attention_mask, jnp.float32),
+                    (B, 1, 1, S))[:, 0, 0, :]
+            ctx = sparse(q.reshape(shape), k.reshape(shape),
+                         v.reshape(shape), key_padding_mask=kpm,
+                         dropout_rate=(float(max(cfg.attn_dropout_ratio, 0.0))
+                                       if train else 0.0),
+                         dropout_rng=r_attn)
+        else:
+            ctx = multihead_attention(
+                q.reshape(shape), k.reshape(shape), v.reshape(shape),
+                causal=False, impl=cfg.attn_impl, bias=attention_mask,
+                dropout_rate=float(max(cfg.attn_dropout_ratio, 0.0)),
+                dropout_rng=r_attn, train=train)
         ctx = ctx.reshape(B, S, H)
         out = ctx @ params["attn_ow"].astype(dtype) + \
             params["attn_ob"].astype(dtype)

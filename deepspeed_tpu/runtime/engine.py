@@ -69,7 +69,7 @@ class DeepSpeedEngine:
         self.global_steps = 0
         self.global_samples = 0
         self.micro_steps = 0
-        self.skipped_steps = 0
+        self._skipped_steps = 0
         self.loaded_checkpoint_tag = None
 
         if dist_init_required is None or dist_init_required:
@@ -160,6 +160,7 @@ class DeepSpeedEngine:
                 self._config.tensorboard_job_name)
         self._flops_profiled = False
         self._last_loss = None
+        self._pending_overflow = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -428,6 +429,7 @@ class DeepSpeedEngine:
             return self._offload_step()
         if self.wall_clock_breakdown:
             self.timers("step").start()
+        self._resolve_pending_overflow()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         (self._params, self._opt_state, self._scaler_state, self._grad_acc,
@@ -435,13 +437,15 @@ class DeepSpeedEngine:
             self._params, self._opt_state, self._scaler_state,
             self._grad_acc, lr)
         self.global_steps += 1
-        if bool(overflow):
-            self.skipped_steps += 1
-            log_dist(f"overflow: skipping step, new loss scale "
-                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
-        else:
-            if self.lr_scheduler is not None:
-                self.lr_scheduler.step()
+        # DEFERRED overflow handling: bool(overflow) here would sync every
+        # step, serializing Python dispatch against device compute (the
+        # weight update itself is already branchless-correct in-device).
+        # Step the scheduler optimistically; _resolve_pending_overflow
+        # rolls it back on the rare overflow step, reading the flag next
+        # boundary when the device has long finished.
+        self._pending_overflow = overflow
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self.wall_clock_breakdown:
@@ -458,6 +462,24 @@ class DeepSpeedEngine:
                 f"loss_scale={float(self._scaler_state['cur_scale'])}, "
                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
                 ranks=[0])
+
+    def _resolve_pending_overflow(self):
+        """Apply the host-side bookkeeping for the PREVIOUS step's overflow
+        flag (deferred to avoid a per-step device sync). The in-device
+        update already skipped the weights and halved the loss scale; here
+        we fix the counters and roll the optimistic scheduler step back."""
+        pending = getattr(self, "_pending_overflow", None)
+        if pending is None:
+            return
+        self._pending_overflow = None
+        if bool(pending):
+            self._skipped_steps += 1
+            if self.lr_scheduler is not None:
+                it = getattr(self.lr_scheduler, "last_batch_iteration", None)
+                if it is not None:  # step(-1) is valid (init state)
+                    self.lr_scheduler.step(it - 1)  # undo optimistic step
+            log_dist(f"overflow: skipped step, new loss scale "
+                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
 
     def _log_timers(self):
         """Windowed wall-clock breakdown (reference engine.py:1239-1284):
@@ -500,7 +522,7 @@ class DeepSpeedEngine:
             self._scaler_state, jnp.asarray(overflow))
         self.global_steps += 1
         if overflow:
-            self.skipped_steps += 1
+            self._skipped_steps += 1
             log_dist(f"offload step overflow: skipping, new loss scale "
                      f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
         else:
@@ -580,6 +602,14 @@ class DeepSpeedEngine:
         return self._config.precision
 
     @property
+    def skipped_steps(self):
+        """Resolves the deferred overflow flag first, so callers see
+        settled counters (the deferral is a dispatch optimization, not an
+        API change)."""
+        self._resolve_pending_overflow()
+        return self._skipped_steps
+
+    @property
     def loss_scale(self):
         return float(self._scaler_state["cur_scale"])
 
@@ -619,6 +649,7 @@ class DeepSpeedEngine:
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
+        self._resolve_pending_overflow()  # counters must be settled
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
@@ -715,7 +746,7 @@ class DeepSpeedEngine:
             self._rng_key = jnp.asarray(model_state["rng_key"])
         self.global_steps = int(model_state.get("global_steps", 0))
         self.global_samples = int(model_state.get("global_samples", 0))
-        self.skipped_steps = int(model_state.get("skipped_steps", 0))
+        self._skipped_steps = int(model_state.get("skipped_steps", 0))
         self.micro_steps = int(model_state.get("micro_steps", 0))
         self._grad_acc = None
         self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)

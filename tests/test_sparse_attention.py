@@ -167,3 +167,65 @@ def test_rpe_and_attn_mask_change_scores():
     m[:32, 32:] = -1e30
     masked = np.asarray(attn(q, k, v, attn_mask=m))
     assert np.isfinite(masked).all()
+
+
+def test_bert_sparse_self_attention_module():
+    from deepspeed_tpu.ops.sparse_attention import BertSparseSelfAttention
+
+    attn = BertSparseSelfAttention(
+        num_attention_heads=4, hidden_size=64,
+        sparsity_config=FixedSparsityConfig(num_heads=4, block=16,
+                                            num_local_blocks=2))
+    params = attn.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64))
+    keep = np.ones((2, 64), np.float32)
+    keep[:, 48:] = 0
+    out = attn(params, x, attention_mask=keep)
+    assert out.shape == (2, 64, 64)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sparse_attention_utils_pad_unpad():
+    from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+    ids = jnp.ones((2, 50), jnp.int32)
+    mask = jnp.ones((2, 50), jnp.int32)
+    pad_len, pids, pmask, ptt, ppos, pemb = \
+        SparseAttentionUtils.pad_to_block_size(
+            16, ids, attention_mask=mask, pad_token_id=9)
+    assert pad_len == 14 and pids.shape == (2, 64)
+    assert int(pids[0, -1]) == 9 and int(pmask[0, -1]) == 0
+    out = SparseAttentionUtils.unpad_sequence_output(
+        pad_len, jnp.zeros((2, 64, 8)))
+    assert out.shape == (2, 50, 8)
+    # already aligned: no-op
+    pad_len2, *_ = SparseAttentionUtils.pad_to_block_size(16, jnp.ones((2, 64)))
+    assert pad_len2 == 0
+
+
+def test_sparse_attention_utils_extend_positions():
+    from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+    pe = jnp.arange(512 * 4, dtype=jnp.float32).reshape(512, 4)
+    ext = SparseAttentionUtils.extend_position_embedding(pe, 1024)
+    assert ext.shape == (1024, 4)
+    np.testing.assert_array_equal(np.asarray(ext[512:1024]), np.asarray(pe))
+
+
+def test_fused_layer_sparse_attention_path():
+    from deepspeed_tpu.models import Bert, bert_config
+    from deepspeed_tpu.ops.sparse_attention import SparseAttentionUtils
+
+    cfg = bert_config("bert-base", num_layers=2, num_heads=4, d_model=64,
+                      vocab_size=512, max_seq_len=64,
+                      compute_dtype=jnp.float32, attn_dropout=0.0,
+                      hidden_dropout=0.0)
+    SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+        cfg, FixedSparsityConfig(num_heads=4, block=16, num_local_blocks=2))
+    model = Bert(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    batch = {"input_ids": jnp.ones((2, 64), jnp.int32),
+             "attention_mask": jnp.ones((2, 64), jnp.int32),
+             "mlm_labels": jnp.full((2, 64), -100).at[:, 3].set(5)}
+    loss = model.loss(params, batch, train=False)
+    assert np.isfinite(float(loss))
