@@ -185,14 +185,24 @@ def _is_local_host(host: str) -> bool:
         return False
 
 
-def build_local_cmd(args, world_info_b64: str) -> List[str]:
+def build_local_cmd(args, world_info_b64: str,
+                    node_rank: int = 0) -> List[str]:
     cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
            f"--world_info={world_info_b64}",
            f"--master_addr={args.master_addr or '127.0.0.1'}",
            f"--master_port={args.master_port}",
-           "--node_rank=0",
+           f"--node_rank={node_rank}",
            args.user_script] + args.user_args
     return cmd
+
+
+def _local_node_rank(active_resources) -> int:
+    """This host's position in the active host list (for --launcher local
+    run per-host against a multinode hostfile); 0 if not found."""
+    for i, host in enumerate(active_resources):
+        if _is_local_host(host):
+            return i
+    return 0
 
 
 def build_pdsh_cmd(args, active_resources, world_info_b64: str):
@@ -269,11 +279,18 @@ def main(args=None):
     # this machine (a lone remote host must still be reached via ssh)
     multi = (args.force_multi or len(active) > 1
              or not _is_local_host(next(iter(active))))
-    if not multi:
-        cmd = build_local_cmd(args, world_info_b64)
+    if not multi or args.launcher == "local":
+        # --launcher local against a multinode hostfile is run once per
+        # host; each host derives its own node rank from its hostfile slot
+        cmd = build_local_cmd(args, world_info_b64,
+                              node_rank=_local_node_rank(active))
     elif args.launcher == "pdsh" and shutil.which("pdsh"):
         cmd = build_pdsh_cmd(args, active, world_info_b64)
-    elif args.launcher == "openmpi" or shutil.which("mpirun"):
+    elif args.launcher == "openmpi" and shutil.which("mpirun"):
+        cmd = build_mpi_cmd(args, active, world_info_b64)
+    elif args.launcher == "pdsh" and shutil.which("mpirun"):
+        # pdsh requested but absent; mpirun present — usable fallback
+        logger.warning("pdsh not found; falling back to mpirun")
         cmd = build_mpi_cmd(args, active, world_info_b64)
     else:
         raise RuntimeError(

@@ -99,10 +99,19 @@ def _walk_jaxpr(jaxpr, scope: Tuple[str, ...], by_scope, by_prim,
             continue
         branches = eqn.params.get("branches")
         if branches:
-            # cond: one branch executes; count the max as the estimate
+            # cond: one branch executes; count only the largest branch
+            best_scope, best_prim, best_total = {}, {}, -1
             for br in branches:
-                _walk_jaxpr(getattr(br, "jaxpr", br), sub_scope, by_scope,
-                            by_prim, mult)
+                bs: Dict[str, int] = {}
+                bp: Dict[str, int] = {}
+                _walk_jaxpr(getattr(br, "jaxpr", br), sub_scope, bs, bp, mult)
+                total = sum(bp.values())
+                if total > best_total:
+                    best_scope, best_prim, best_total = bs, bp, total
+            for k, v in best_scope.items():
+                by_scope[k] = by_scope.get(k, 0) + v
+            for k, v in best_prim.items():
+                by_prim[k] = by_prim.get(k, 0) + v
             continue
         f = _prim_flops(eqn)
         if f:

@@ -451,6 +451,13 @@ class DeepSpeedEngine:
         if self.wall_clock_breakdown:
             self.timers("step").stop(sync=grad_norm)
             self._log_timers()
+        if self.monitor is not None:
+            # Monitoring already syncs (float(loss)), so settle the deferred
+            # overflow first — else the emitted lr scalar is one scheduler
+            # step ahead on an overflowed step. Without a monitor the
+            # deferral stands; direct scheduler reads between steps may be
+            # one iteration ahead until the next step()/skipped_steps access.
+            self._resolve_pending_overflow()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
         if self.steps_per_print() and \
