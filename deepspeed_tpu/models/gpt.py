@@ -46,9 +46,12 @@ class GPTConfig:
     embed_dropout: float = 0.0
     layer_norm_eps: float = 1e-5
     tie_embeddings: bool = True
+    loss_chunks: int = 0             # CE chunking: 0 auto, 1 off, n chunks
     remat: bool = False              # per-block rematerialisation
     shard_activations: bool = True   # seq/data sharding constraints
     attn_impl: str = "auto"          # auto|pallas|xla (ops/transformer)
+    flash_block_q: int = 0           # 0 -> kernel default
+    flash_block_k: int = 0
     param_dtype: Any = jnp.float32
     pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
     pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
@@ -222,7 +225,9 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
                                    split_heads(v), causal=True,
                                    impl=cfg.attn_impl,
                                    dropout_rate=cfg.dropout,
-                                   dropout_rng=r1, train=train)
+                                   dropout_rng=r1, train=train,
+                                   block_q=cfg.flash_block_q or None,
+                                   block_k=cfg.flash_block_k or None)
     attn = attn.reshape(B, S, D)
     attn = attn @ p["attn"]["proj"]["w"].astype(h.dtype) + \
         p["attn"]["proj"]["b"].astype(h.dtype)
@@ -245,6 +250,62 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
             p["mlp"]["fc2"]["b"].astype(h.dtype)
     x = x + _dropout(h, cfg.dropout, r3, train)
     return _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None)), aux
+
+
+def _ce_rows(logits32, labels, valid):
+    """Sum of masked next-token NLL over rows, from fp32 logits.
+
+    `logsumexp - label_logit` instead of materialising the [N, V] fp32
+    log-softmax the previous implementation wrote to HBM — backward is the
+    standard softmax-minus-onehot XLA derives from this form."""
+    lse = jax.nn.logsumexp(logits32, axis=-1)
+    ll = jnp.take_along_axis(logits32, labels[:, None], axis=-1)[:, 0]
+    return jnp.sum(jnp.where(valid, lse - ll, 0.0))
+
+
+def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0):
+    """Fused projection + cross entropy: hidden states [N, D] and the [D, V]
+    head weight go straight to summed NLL without a [N, V] activation
+    surviving the loss.
+
+    The projection runs with fp32 MXU accumulation (preferred_element_type)
+    so no separate bf16-logits buffer + fp32 cast is materialised — the
+    single biggest HBM cost of the naive CE at GPT-2 vocab (N·V·4 bytes,
+    ~1.6 GB at micro 8 / seq 1024). With n_chunks > 1 the rows are processed
+    by a rematerialised lax.scan, so peak memory holds one [N/c, V] chunk;
+    backward recomputes each chunk's logits (flash-attention-style
+    recompute, applied to the LM head).
+
+    n_chunks: 0 = auto (chunks of ~2048 rows for large-vocab models),
+    1 = single fused matmul, n = explicit chunk count (must divide N).
+    """
+    N, D = x.shape
+    V = w.shape[-1]
+
+    def project(rows):
+        return jax.lax.dot_general(rows, w, (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+
+    if n_chunks == 0:  # auto: only chunk when the logits buffer is large
+        if V >= 4096 and N >= 4096:
+            n_chunks = max(1, N // 2048)
+        else:
+            n_chunks = 1
+    while n_chunks > 1 and N % n_chunks:
+        n_chunks -= 1
+    if n_chunks <= 1:
+        return _ce_rows(project(x), labels, valid)
+
+    def body(carry, inp):
+        rows, lc, vc = inp
+        return carry + _ce_rows(project(rows), lc, vc), None
+
+    total, _ = jax.lax.scan(
+        jax.checkpoint(body),
+        jnp.zeros((), jnp.float32),
+        (x.reshape(n_chunks, N // n_chunks, D),
+         labels.reshape(n_chunks, -1), valid.reshape(n_chunks, -1)))
+    return total
 
 
 class GPT(TrainModule):
@@ -301,10 +362,9 @@ class GPT(TrainModule):
         return specs
 
     # -- forward -------------------------------------------------------
-    def apply(self, params, tokens, rng=None, train=False, pld_mask=None,
-              with_aux=False):
-        """tokens [B, S] int32 -> logits [B, S, V] (with_aux: also the
-        summed MoE load-balancing loss)."""
+    def _trunk(self, params, tokens, rng=None, train=False, pld_mask=None):
+        """Everything up to (and including) the final layer norm.
+        tokens [B, S] int32 -> ([B, S, D] hidden states, MoE aux loss)."""
         cfg = self.config
         aux_total = jnp.zeros((), jnp.float32)
         B, S = tokens.shape
@@ -342,11 +402,21 @@ class GPT(TrainModule):
                 aux_total = aux_total + aux
                 x = out
 
-        x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
-        if cfg.tie_embeddings:
-            logits = x @ params["wte"].T.astype(x.dtype)
-        else:
-            logits = x @ params["lm_head"].astype(x.dtype)
+        return layer_norm(x, params["ln_f"], cfg.layer_norm_eps), aux_total
+
+    def _proj_weight(self, params):
+        """[D, V] projection weight in the trunk's compute dtype."""
+        if self.config.tie_embeddings:
+            return params["wte"].T
+        return params["lm_head"]
+
+    def apply(self, params, tokens, rng=None, train=False, pld_mask=None,
+              with_aux=False):
+        """tokens [B, S] int32 -> logits [B, S, V] (with_aux: also the
+        summed MoE load-balancing loss)."""
+        x, aux_total = self._trunk(params, tokens, rng=rng, train=train,
+                                   pld_mask=pld_mask)
+        logits = x @ self._proj_weight(params).astype(x.dtype)
         if with_aux:
             return logits, aux_total
         return logits
@@ -372,16 +442,16 @@ class GPT(TrainModule):
             pld_mask = jax.random.bernoulli(
                 sub, pld_theta, (self.config.num_layers,))
 
-        logits, moe_aux = self.apply(params, tokens, rng=rng, train=train,
-                                     pld_mask=pld_mask, with_aux=True)
-        logits = logits.astype(jnp.float32)
+        x, moe_aux = self._trunk(params, tokens, rng=rng, train=train,
+                                 pld_mask=pld_mask)
         valid = (labels >= 0)
         safe_labels = jnp.where(valid, labels, 0)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, safe_labels[..., None],
-                                   axis=-1)[..., 0]
-        nll = jnp.where(valid, nll, 0.0)
-        ce = jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+        B, S, D = x.shape
+        nll_sum = _softmax_xent_from_hidden(
+            x.reshape(B * S, D), self._proj_weight(params),
+            safe_labels.reshape(-1), valid.reshape(-1),
+            self.config.loss_chunks)
+        ce = nll_sum / jnp.maximum(jnp.sum(valid), 1)
         if self.config.num_experts > 1 and train:
             # aux applies to the training objective only — eval loss stays
             # pure CE so perplexity comparisons are unbiased

@@ -60,7 +60,9 @@ def xla_attention(q, k, v, causal=True, bias=None, dropout_rate=0.0,
 def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
                         bias=None, dropout_rate: float = 0.0,
                         dropout_rng=None, train: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        block_q: Optional[int] = None,
+                        block_k: Optional[int] = None):
     """Dispatching attention entry point used by the GPT family and the
     DeepSpeedTransformerLayer.
 
@@ -81,9 +83,22 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
                       and S >= _FLASH_MIN_SEQ and S % 128 == 0
                       and k.shape[1] % 128 == 0 and D in (64, 128, 256))
     if use_pallas:
-        from .flash_attention import flash_attention
+        from .flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
+                                      flash_attention)
 
-        return flash_attention(q, k, v, causal=causal, scale=scale)
+        bq = block_q or DEFAULT_BLOCK_Q
+        bk = block_k or DEFAULT_BLOCK_K
+        if S % bq == 0 and k.shape[1] % bk == 0:
+            return flash_attention(q, k, v, causal=causal, scale=scale,
+                                   block_q=bq, block_k=bk)
+        if block_q or block_k:
+            # explicit tuning request that cannot tile: say so instead of
+            # silently paying the O(S^2) XLA path
+            from ...utils.logging import logger
+
+            logger.warning(
+                f"flash blocks ({bq},{bk}) do not divide seq lens "
+                f"({S},{k.shape[1]}); falling back to XLA attention")
     return xla_attention(q, k, v, causal=causal, bias=bias,
                          dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                          train=train, scale=scale)

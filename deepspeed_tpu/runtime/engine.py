@@ -161,6 +161,7 @@ class DeepSpeedEngine:
         self._flops_profiled = False
         self._last_loss = None
         self._pending_overflow = None
+        self._pending_full = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -307,11 +308,60 @@ class DeepSpeedEngine:
             zero_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
             return new_params, new_opt, new_scaler, zero_acc, overflow, grad_norm
 
+        def full_step(params, opt_state, scaler_state, batch, rng, lr,
+                      pld_theta):
+            """Whole training step (fwd+bwd+optimizer+scaler) as ONE
+            program — the gas==1 fast path. The split micro/apply pair
+            writes the fp32 gradient tree to HBM at the end of one program
+            and reads it back at the start of the next (plus a second
+            host dispatch per step — expensive over a tunneled runtime);
+            here the gradients never outlive the fused program and XLA can
+            overlap the optimizer with the tail of the backward."""
+            loss_scale = scaler_state["cur_scale"]
+            cparams = cast(params, compute_dtype)
+
+            def scaled_loss_fn(p):
+                kwargs = {}
+                if pld_enabled:
+                    kwargs = {"progressive_layer_drop": True,
+                              "pld_theta": pld_theta}
+                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                scale_factor = loss_scale / (predivide if prescale else 1.0)
+                return loss.astype(jnp.float32) * scale_factor, loss
+
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(cparams)
+            grads = cast(grads, jnp.float32)
+            grads = plan.constrain_grads(grads)
+            overflow = has_overflow(grads)
+            denom = loss_scale
+            if prescale:
+                denom = denom / predivide
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            grad_norm = jnp.asarray(0.0, jnp.float32)
+            if clip > 0.0:
+                grads, grad_norm = clip_grad_norm(grads, clip)
+            new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, opt_state)
+            new_params = plan.constrain_params(new_params)
+            new_opt = plan.constrain_opt_state(new_opt)
+            new_scaler = scaler.jit_update(scaler_state, overflow)
+            return new_params, new_opt, new_scaler, loss, overflow, grad_norm
+
         donate_micro = jax.jit(micro_step, donate_argnums=(1,))
         # lr=None (optimizer-default) is a static arg value: jit treats None
         # as an empty pytree, giving that case its own (single) trace
         donate_apply = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
-        return {"micro": donate_micro, "apply": donate_apply}
+        fns = {"micro": donate_micro, "apply": donate_apply}
+        if gas == 1 and self._offload is None:
+            # scaler state (arg 2) is NOT donated: it stays readable between
+            # the fused forward and step(), so engine.loss_scale keeps
+            # reference pre-update semantics until the boundary's step()
+            fns["full"] = jax.jit(full_step, donate_argnums=(0, 1))
+        return fns
 
     def _zero_grad_acc(self):
         zeros = jax.tree_util.tree_map(
@@ -335,7 +385,11 @@ class DeepSpeedEngine:
                         f"batch dim 0 ({x.shape[0]}) not divisible by data "
                         f"shards ({self.dp_world_size}); replicating batch "
                         f"over the data axis")
-            return jax.device_put(x, NamedSharding(mesh, PartitionSpec(*spec)))
+            target = NamedSharding(mesh, PartitionSpec(*spec))
+            if isinstance(x, jax.Array) and \
+                    x.sharding.is_equivalent_to(target, x.ndim):
+                return x  # already placed — skip a per-step dispatch
+            return jax.device_put(x, target)
 
         return jax.tree_util.tree_map(put, batch)
 
@@ -359,7 +413,12 @@ class DeepSpeedEngine:
     def forward(self, batch, rng=None):
         """Compute loss AND gradients for a micro batch (fused fwd+bwd —
         separate passes would recompute the forward under autodiff).
-        Returns the (unscaled) loss; gradients are cached for backward()."""
+        Returns the (unscaled) loss; gradients are cached for backward().
+
+        gas==1 fast path: the whole step (fwd+bwd+optimizer+scaler) runs as
+        one fused program here; step() then only does host bookkeeping."""
+        if "full" in self._step_fns:
+            return self._fused_forward(batch, rng)
         if self._grad_acc is None:
             self._grad_acc = self._zero_grad_acc()
         if self.is_gradient_accumulation_boundary():
@@ -389,7 +448,45 @@ class DeepSpeedEngine:
         self._last_loss = loss
         return loss
 
-    def _maybe_profile_flops(self, batch, rng, theta):
+    def _fused_forward(self, batch, rng):
+        """gas==1: run the single fused step program and commit the new
+        state immediately (the update is branchless-correct in-device, so
+        committing at the boundary's forward is semantically the same step
+        the split path applies in step()); step() finishes the host-side
+        bookkeeping. The previous step's deferred overflow flag is settled
+        FIRST so the scheduler lr read below is the rolled-back one."""
+        self._resolve_pending_overflow()
+        self.tput_timer.start()
+        batch = self._shard_batch(batch)
+        rng = rng if rng is not None else self._next_rng()
+        theta = jnp.asarray(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop else 1.0, jnp.float32)
+        cur_lr = self._current_lr()
+        lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        profiling = self._maybe_profile_flops(batch, rng, theta, lr=lr)
+        if self.wall_clock_breakdown:
+            self.timers("forward").start()
+        (self._params, self._opt_state, new_scaler, loss,
+         overflow, grad_norm) = self._step_fns["full"](
+            self._params, self._opt_state, self._scaler_state, batch, rng,
+            lr, theta)
+        if self.wall_clock_breakdown:
+            # the fused program IS forward+backward+step
+            self.timers("forward").stop(sync=loss)
+        if profiling is not None:
+            profiling.stop_profile(params=self._params, sync=loss)
+            profiling.stats.update(self._flops_stats)
+            profiling.print_model_profile(
+                profile_step=self.global_steps,
+                top_modules=self._config.flops_profiler_config.top_modules,
+                detailed=self._config.flops_profiler_config.detailed)
+        self._pending_full = (new_scaler, overflow, grad_norm)
+        self._cached = loss
+        self._last_loss = loss
+        return loss
+
+    def _maybe_profile_flops(self, batch, rng, theta, lr=None):
         """FLOPS profiler hook (reference engine.py:966-1019): at
         profile_step, statically analyze the jitted micro-step and time
         this invocation."""
@@ -400,9 +497,14 @@ class DeepSpeedEngine:
         from ..profiling.flops_profiler.profiler import (FlopsProfiler,
                                                          analyze_fn)
         self._flops_profiled = True
-        self._flops_stats = analyze_fn(
-            self._step_fns["micro"], self._params, self._grad_acc, batch,
-            rng, self._scaler_state["cur_scale"], theta)
+        if "full" in self._step_fns:
+            self._flops_stats = analyze_fn(
+                self._step_fns["full"], self._params, self._opt_state,
+                self._scaler_state, batch, rng, lr, theta)
+        else:
+            self._flops_stats = analyze_fn(
+                self._step_fns["micro"], self._params, self._grad_acc, batch,
+                rng, self._scaler_state["cur_scale"], theta)
         prof = FlopsProfiler()
         prof.start_profile()
         return prof
@@ -427,6 +529,8 @@ class DeepSpeedEngine:
             return
         if self._offload is not None:
             return self._offload_step()
+        if getattr(self, "_pending_full", None) is not None:
+            return self._fused_step_bookkeeping()
         if self.wall_clock_breakdown:
             self.timers("step").start()
         self._resolve_pending_overflow()
@@ -457,6 +561,35 @@ class DeepSpeedEngine:
             # step ahead on an overflowed step. Without a monitor the
             # deferral stands; direct scheduler reads between steps may be
             # one iteration ahead until the next step()/skipped_steps access.
+            self._resolve_pending_overflow()
+        self._emit_monitor_scalars()
+        self.tput_timer.stop(report_speed=False)
+        if self.steps_per_print() and \
+                self.global_steps % self.steps_per_print() == 0:
+            cur = self._current_lr()
+            lr_str = f"{cur:.3e}" if cur is not None else "optimizer-default"
+            log_dist(
+                f"step={self.global_steps}, lr={lr_str}, "
+                f"loss_scale={float(self._scaler_state['cur_scale'])}, "
+                f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
+                ranks=[0])
+
+    def _fused_step_bookkeeping(self):
+        """Host-side tail of the fused (gas==1) step: the device update was
+        already committed in _fused_forward; advance counters, scheduler,
+        PLD and monitoring exactly as the split path does."""
+        new_scaler, overflow, _grad_norm = self._pending_full
+        self._pending_full = None
+        self._scaler_state = new_scaler
+        self.global_steps += 1
+        self._pending_overflow = overflow
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()  # optimistic; rolled back on overflow
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.wall_clock_breakdown:
+            self._log_timers()
+        if self.monitor is not None:
             self._resolve_pending_overflow()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
@@ -667,7 +800,9 @@ class DeepSpeedEngine:
                 [m.reshape(s) for m, s in zip(self._offload.masters,
                                               self._offload.shapes)])
         else:
-            module_np = jax.tree_util.tree_map(np.asarray, self._params)
+            # device tree passes through as-is: the checkpoint writer
+            # serializes sharded leaves per-shard (no host gather)
+            module_np = self._params
         model_state = {
             "module": module_np,
             "lr_scheduler": (self.lr_scheduler.state_dict()
@@ -680,7 +815,7 @@ class DeepSpeedEngine:
         optim_state = {
             "optimizer_state": (
                 self._offload.state_dict() if self._offload is not None
-                else jax.tree_util.tree_map(np.asarray, self._opt_state)),
+                else self._opt_state),
             "offload": self._offload is not None,
             # json round-trip: msgpack rejects tuples (betas); lists restore fine
             "optimizer_hparams": (json.loads(json.dumps(
@@ -688,8 +823,10 @@ class DeepSpeedEngine:
                 if hasattr(self.optimizer, "state_dict") else None),
             "zero_stage": self.zero_optimization_stage(),
         }
-        ckpt_io.save_checkpoint_state(save_dir, tag, model_state, optim_state,
-                                      save_latest=save_latest)
+        ckpt_io.save_checkpoint_state(
+            save_dir, tag, model_state, optim_state, save_latest=save_latest,
+            async_save=bool(getattr(self._config, "checkpoint_async_save",
+                                    False)))
         return True
 
     def _checkpoint_tag_validation(self, tag):
