@@ -1,4 +1,4 @@
-"""Checkpoint save/load with reference-compatible layout.
+"""Checkpoint save/load with reference-compatible layout + sharded I/O.
 
 Reference: deepspeed/runtime/engine.py:1462-1890. Layout kept:
 
@@ -6,20 +6,28 @@ Reference: deepspeed/runtime/engine.py:1462-1890. Layout kept:
     <save_dir>/<tag>/zero_pp_rank_<dp>_mp_rank_00_optim_states.msgpack
     <save_dir>/latest                     (text file holding the tag)
 
-Redesign notes: arrays are gathered to host and serialized with flax's
-msgpack (framework-neutral, no pickle). Because the on-disk format is the
-FULL (unsharded) pytree, checkpoints are elastic by construction — loading
-at a different world size just re-shards via device_put, which subsumes the
-reference's ZeRO-1 elastic re-partition logic (zero/stage1.py:924-1155).
-Multi-host jobs save from process 0 (params are addressable-replicated or
-gathered); a tensorstore-sharded writer is the planned upgrade for >HBM
-models.
+Sharded design (reference engine.py:1462-1489 per-rank shard files):
+device-sharded leaves are NOT gathered to one host. Each distinct shard of
+a sharded jax.Array is written as a piece (with its index) into the
+zero_pp_rank_<r> file of its shard rank; the model/optim skeleton files
+keep a marker per sharded leaf. In multi-host jobs each process writes
+only the pieces it can address — no cross-host gather, every host writes
+in parallel (the reference's per-rank writer behaviour). Rank files are
+written by a background thread pool; save returns after the writes land
+(pass async_save=True to overlap with training and flush_pending() later).
+
+On load the pieces are reassembled into full host arrays, so checkpoints
+stay elastic by construction — loading at a different world size just
+re-shards via device_put (subsumes the reference's ZeRO-1 elastic
+re-partition logic, zero/stage1.py:924-1155). Unsharded (round-1/2 format)
+checkpoints load unchanged.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -27,6 +35,18 @@ import jax
 from flax import serialization
 
 from ..utils.logging import logger
+
+_SHARD_MARKER = "__dstpu_sharded_leaf__"
+_writer = ThreadPoolExecutor(max_workers=4)
+_pending: List[Any] = []
+
+
+def flush_pending():
+    """Block until all async checkpoint writes have landed."""
+    global _pending
+    for f in _pending:
+        f.result()
+    _pending = []
 
 
 def _to_host(tree):
@@ -36,6 +56,108 @@ def _to_host(tree):
         return np.asarray(x)
 
     return jax.tree_util.tree_map(conv, tree)
+
+
+def _is_sharded(x) -> bool:
+    try:
+        return isinstance(x, jax.Array) and not x.is_fully_replicated
+    except Exception:
+        return False
+
+
+def _normalize_index(index, shape):
+    return tuple(
+        (0 if sl.start is None else int(sl.start),
+         int(shape[d]) if sl.stop is None else int(sl.stop))
+        for d, sl in enumerate(index))
+
+
+def _split_sharded(tree, rank_pieces: Dict[int, Dict[str, Any]],
+                   prefix: str):
+    """Replace device-sharded leaves with markers; deposit each distinct
+    shard (piece + index) into its shard-rank's payload. Replicated / host
+    leaves come back as host arrays.
+
+    Multi-host: a piece is written by the process owning the
+    lowest-device-id replica of that shard, so every piece is written
+    exactly once and no process gathers remote data."""
+
+    proc = jax.process_index()
+
+    def visit(path, leaf):
+        if not _is_sharded(leaf):
+            if isinstance(leaf, (str, bytes, bool, int, float)) or \
+                    leaf is None:
+                return leaf
+            return np.asarray(leaf)
+        key = prefix + jax.tree_util.keystr(path)
+        imap = leaf.sharding.devices_indices_map(leaf.shape)
+        owner = {}
+        for dev, index in imap.items():
+            idx = _normalize_index(index, leaf.shape)
+            if idx not in owner or dev.id < owner[idx].id:
+                owner[idx] = dev
+        local = {}
+        for sh in leaf.addressable_shards:
+            idx = _normalize_index(sh.index, leaf.shape)
+            if owner[idx].process_index == proc and idx not in local:
+                local[idx] = sh.data
+        for idx, data in local.items():
+            # file index = owner DEVICE id: globally unique, so exactly one
+            # process ever writes a given rank file (piece ranks per leaf
+            # would collide across processes on mixed 2D shardings — the
+            # loader merges pieces by key across all files, so file
+            # assignment only needs to be collision-free, not dense)
+            rank_pieces.setdefault(owner[idx].id, {})[key] = {
+                "index": [list(p) for p in idx],
+                "piece": np.asarray(data),
+            }
+        return {_SHARD_MARKER: True, "key": key,
+                "shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                "num_pieces": len(owner)}
+
+    return jax.tree_util.tree_map_with_path(visit, tree)
+
+
+def _is_marker(x) -> bool:
+    return isinstance(x, dict) and x.get(_SHARD_MARKER, False)
+
+
+def _reassemble(tree, pieces_by_key: Dict[str, list]):
+    """Inverse of _split_sharded: markers -> full host arrays."""
+
+    def visit(leaf):
+        if not _is_marker(leaf):
+            return leaf
+        key = leaf["key"]
+        got = pieces_by_key.get(key, [])
+        if len(got) != int(leaf["num_pieces"]):
+            raise FileNotFoundError(
+                f"sharded checkpoint leaf {key}: found {len(got)} of "
+                f"{leaf['num_pieces']} pieces (missing rank files?)")
+        full = np.empty([int(s) for s in leaf["shape"]],
+                        dtype=np.dtype(leaf["dtype"]))
+        for entry in got:
+            sl = tuple(slice(int(a), int(b)) for a, b in entry["index"])
+            full[sl] = entry["piece"]
+        return full
+
+    return jax.tree_util.tree_map(visit, tree, is_leaf=_is_marker)
+
+
+def _load_rank_pieces(ckpt_dir: str, mp_rank: int) -> Dict[str, list]:
+    import glob as _glob
+
+    pieces: Dict[str, list] = {}
+    pattern = os.path.join(
+        ckpt_dir, f"zero_pp_rank_*_mp_rank_{mp_rank:02d}_optim_states"
+        f".msgpack")
+    for path in sorted(_glob.glob(pattern)):
+        with open(path, "rb") as f:
+            payload = serialization.msgpack_restore(f.read())
+        for key, entry in (payload.get("pieces") or {}).items():
+            pieces.setdefault(key, []).append(entry)
+    return pieces
 
 
 def model_ckpt_name(ckpt_dir: str, mp_rank: int = 0) -> str:
@@ -48,30 +170,81 @@ def optim_ckpt_name(ckpt_dir: str, dp_rank: int = 0, mp_rank: int = 0) -> str:
         f"zero_pp_rank_{dp_rank}_mp_rank_{mp_rank:02d}_optim_states.msgpack")
 
 
+def layer_ckpt_name(ckpt_dir: str, layer_idx: int, mp_rank: int = 0) -> str:
+    """Per-layer pipeline checkpoint file (reference pipe/module.py:520-578
+    `layer_{idx:02d}-model_{mp:02d}-model_states.pt`)."""
+    return os.path.join(
+        ckpt_dir, f"layer_{layer_idx:02d}-model_{mp_rank:02d}-model_states"
+        f".msgpack")
+
+
 def save_checkpoint_state(save_dir: str, tag: str, model_state: Dict[str, Any],
                           optim_state: Optional[Dict[str, Any]] = None,
                           save_latest: bool = True, mp_rank: int = 0,
-                          dp_rank: int = 0) -> str:
+                          dp_rank: int = 0, layer_states=None,
+                          tied_states=None, async_save: bool = False) -> str:
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
 
-    # full-pytree format: exactly one writer per file — process 0 (shards
-    # are gathered to host there); other processes only participate in the
-    # implicit gather
-    if jax.process_index() == 0:
-        path = model_ckpt_name(ckpt_dir, mp_rank)
-        with open(path, "wb") as f:
-            f.write(serialization.msgpack_serialize(_to_host(model_state)))
+    # sharded leaves are split into per-rank piece files; nothing is
+    # gathered across hosts — each process serializes only what it owns
+    rank_pieces: Dict[int, Dict[str, Any]] = {}
+    model_state = _split_sharded(model_state, rank_pieces, "model:")
+    optim_skeleton = None
+    if optim_state is not None:
+        optim_skeleton = _split_sharded(optim_state, rank_pieces, "optim:")
 
-        if optim_state is not None:
-            opath = optim_ckpt_name(ckpt_dir, dp_rank, mp_rank)
-            with open(opath, "wb") as f:
-                f.write(serialization.msgpack_serialize(_to_host(optim_state)))
+    def _write(path, payload):
+        with open(path, "wb") as f:
+            f.write(serialization.msgpack_serialize(payload))
+
+    jobs = []
+    if jax.process_index() == 0:
+        if layer_states is not None:
+            # pipeline layout: layer params go to per-layer files (reference
+            # pipe/module.py:520-578); the module file keeps placeholders
+            for idx, lp in sorted(layer_states.items()):
+                jobs.append((layer_ckpt_name(ckpt_dir, idx, mp_rank),
+                             _to_host(lp)))
+            model_state = dict(model_state)
+            model_state["module"] = {
+                "layers": [None] * len(model_state["module"]["layers"]),
+                "tied": _to_host(tied_states or {}),
+                "num_layers": len(model_state["module"]["layers"]),
+            }
+        jobs.append((model_ckpt_name(ckpt_dir, mp_rank),
+                     _to_host(model_state)))
+        if optim_skeleton is not None and 0 not in rank_pieces:
+            rank_pieces[0] = {}
+
+    for rank, pieces in rank_pieces.items():
+        payload: Dict[str, Any] = {"__dstpu_ckpt_v2__": True,
+                                   "pieces": pieces}
+        if rank == 0 and optim_skeleton is not None:
+            payload["state"] = _to_host(optim_skeleton)
+        jobs.append((optim_ckpt_name(ckpt_dir, rank, mp_rank), payload))
+
+    futures = [_writer.submit(_write, path, payload)
+               for path, payload in jobs]
+    if async_save:
+        _pending.extend(futures)
+    else:
+        for f in futures:
+            f.result()
 
     if save_latest and jax.process_index() == 0:
-        with open(os.path.join(save_dir, "latest"), "w") as f:
-            f.write(str(tag))
-    logger.info(f"saved checkpoint {tag} to {ckpt_dir}")
+        def _latest():
+            for fut in futures:  # latest must not point at a partial write
+                fut.result()
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+
+        if async_save:
+            _pending.append(_writer.submit(_latest))
+        else:
+            _latest()
+    logger.info(f"saved checkpoint {tag} to {ckpt_dir}"
+                + (" (async)" if async_save else ""))
     return ckpt_dir
 
 
@@ -98,9 +271,31 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
     with open(path, "rb") as f:
         model_state = serialization.msgpack_restore(f.read())
 
+    # pipeline layout: reassemble per-layer files if present
+    module = model_state.get("module")
+    if isinstance(module, dict) and "num_layers" in module:
+        layers = []
+        for i in range(int(module["num_layers"])):
+            lpath = layer_ckpt_name(ckpt_dir, i, mp_rank)
+            if os.path.isfile(lpath):
+                with open(lpath, "rb") as f:
+                    layers.append(serialization.msgpack_restore(f.read()))
+            else:
+                layers.append(None)
+        model_state["module"] = {"layers": layers,
+                                 "tied": module.get("tied", {})}
+
+    pieces = _load_rank_pieces(ckpt_dir, mp_rank)
+    if pieces:
+        model_state = _reassemble(model_state, pieces)
+
     optim_state = None
     opath = optim_ckpt_name(ckpt_dir, dp_rank, mp_rank)
     if os.path.isfile(opath):
         with open(opath, "rb") as f:
             optim_state = serialization.msgpack_restore(f.read())
+        if isinstance(optim_state, dict) and \
+                optim_state.get("__dstpu_ckpt_v2__"):
+            # v2 sharded layout: the skeleton lives in rank 0's file
+            optim_state = _reassemble(optim_state.get("state"), pieces)
     return ckpt_dir, model_state, optim_state

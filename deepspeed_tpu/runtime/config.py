@@ -238,6 +238,10 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
             self.checkpoint_tag_validation_mode != "ignore"
         self.checkpoint_tag_validation_fail = \
             self.checkpoint_tag_validation_mode == "fail"
+        # TPU addition: overlap checkpoint serialization with training
+        # (writes land on background threads; 'latest' updates last)
+        self.checkpoint_async_save = bool(get_scalar_param(
+            ckpt, "async_save", False))
 
         self.sparse_attention = pd.get(c.SPARSE_ATTENTION, None)
         self.vocabulary_size = get_scalar_param(pd, c.VOCABULARY_SIZE,

@@ -1,33 +1,675 @@
-"""PipelineEngine — scheduled pipeline-parallel training.
+"""PipelineEngine — scheduled 1F1B pipeline-parallel training.
 
 Reference: deepspeed/runtime/pipe/engine.py:52 (train_batch :264,
-eval_batch :351, instruction dispatch :1280-1306).
+eval_batch :351, the instruction dispatch table :1280-1306 executing
+pipe/schedule.py's TrainSchedule). This engine executes the same ISA
+(runtime/pipe/schedule.py) over heterogeneous LayerSpec stacks:
 
-Current state: executes the PipelineModule end-to-end through the base
-engine (correct for pipe=1 meshes); the instruction-schedule executor over
-the `pipe` mesh axis (1F1B via ppermute handoffs) builds on
-pipe/schedule.py and lands with the pipeline milestone.
+* each pipeline stage owns a contiguous slice of the PipelineModule's
+  layers, placed on its own device group (a slice of `jax.devices()`),
+  with the micro batch data-sharded inside the group;
+* the TrainSchedule instruction streams of ALL stages are executed from
+  the single controller in dependency order (a Recv is runnable once the
+  matching Send has been issued). Dispatch is asynchronous, so stage
+  programs overlap on-device exactly as the eager NCCL interpreter's do —
+  the 1F1B warmup/steady/cooldown order and per-stage buffer counts
+  (TrainSchedule.num_pipe_buffers) are preserved;
+* BackwardPass recomputes the stage forward under jax.vjp from the saved
+  buffer input (per-stage activation checkpointing — only the buffer
+  inputs are held, the reference's activation_checkpoint_interval
+  behaviour with interval = stage length);
+* TiedLayerSpec params (reference pipe/module.py:415-428) are owned by
+  their first stage; ReduceTiedGrads ships the other stages' tied grads
+  to the owner and OptimizerStep re-broadcasts the updated copy;
+* SendActivation/SendGrad are `jax.device_put` reshards onto the next
+  stage's device group (the single-controller analogue of p2p.py:31-75);
+  on real multi-chip topologies XLA rides ICI for these transfers.
+
+The SPMD GPipe executor (parallel/pipeline.py) remains the
+compile-everything alternative for homogeneous stacked blocks; this engine
+is the general one: heterogeneous layers, tied weights, 1F1B buffering.
 """
 
 from __future__ import annotations
 
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec, Mesh
+
+from ...utils.logging import log_dist, logger
+from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
+from ..utils import has_overflow
+from .module import PipelineModule, TiedLayerSpec
+from .schedule import (BackwardPass, ForwardPass, LoadMicroBatch,
+                       OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
+                       ReduceTiedGrads, SendActivation, SendGrad,
+                       TrainSchedule)
+
+
+class _StageRuntime:
+    """Per-stage state: params, device placement, jitted programs, buffers."""
+
+    def __init__(self, stage_id: int, layers, specs, devices,
+                 is_last: bool, loss_fn, compute_dtype):
+        self.stage_id = stage_id
+        self.layers = layers
+        self.specs = specs
+        self.devices = devices
+        self.is_last = is_last
+        self.loss_fn = loss_fn
+        self.compute_dtype = compute_dtype
+        self.mesh = Mesh(np.asarray(devices), ("data",))
+        self.replicated = NamedSharding(self.mesh, PartitionSpec())
+        self.batch_sharding = NamedSharding(self.mesh, PartitionSpec("data"))
+
+        # owned params: {"layers": [...], "tied": {key: ...}} — set by engine
+        self.own: Any = None
+        self.ro_tied: Dict[str, Any] = {}   # read-only copies of tied params
+        self.opt_state: Any = None
+        self.acc: Any = None                # fp32 grad acc, same struct as own
+        self.acc_ro: Dict[str, Any] = {}    # grads for non-owned tied params
+
+        # pipeline buffers
+        self.x_in: Dict[int, Any] = {}      # buffer -> stage input
+        self.rng_in: Dict[int, Any] = {}    # buffer -> rng key used in fwd
+        self.y_out: Dict[int, Any] = {}     # buffer -> stage output
+        self.dx_out: Dict[int, Any] = {}    # buffer -> grad wrt stage input
+        self.labels: Dict[int, Any] = {}    # micro-batch id -> labels (last)
+        self.losses: List[Any] = []
+        self.fwd_count = 0
+        self.bwd_count = 0
+
+        self._build_programs()
+
+    # -- pure stage functions ------------------------------------------
+
+    def _forward_fn(self, own, ro_tied, x, rng, train):
+        dtype = self.compute_dtype
+        cast = lambda t: jax.tree_util.tree_map(
+            lambda a: a.astype(dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+        own = cast(own)
+        ro_tied = cast(ro_tied)
+        tied = dict(own["tied"])
+        tied.update(ro_tied)
+        for layer, spec, p in zip(self.layers, self.specs, own["layers"]):
+            sub = None
+            if rng is not None:
+                rng, sub = jax.random.split(rng)
+            if isinstance(spec, TiedLayerSpec):
+                p = tied[spec.key]
+                if spec.forward_fn is not None:
+                    x = spec.forward_fn(layer, p, x)
+                    continue
+            x = layer.apply(p, x, rng=sub, train=train)
+        return x
+
+    def _build_programs(self):
+        fwd = self._forward_fn
+
+        def fwd_train(own, ro, x, rng):
+            return fwd(own, ro, x, rng, True)
+
+        def fwd_eval(own, ro, x, rng):
+            return fwd(own, ro, x, rng, False)
+
+        self.fwd_j = jax.jit(fwd_train)
+        self.fwd_eval_j = jax.jit(fwd_eval)
+
+        if self.is_last:
+            loss_fn = self.loss_fn
+
+            def loss_of(own, ro, x, labels, rng):
+                out = fwd(own, ro, x, rng, True)
+                return loss_fn(out, labels)
+
+            def loss_j(own, ro, x, labels, rng):
+                return loss_of(own, ro, x, labels, rng)
+
+            def bwd_last(own, ro, x, labels, rng, scale, acc, acc_ro):
+                def scaled(o, r, xx):
+                    return loss_of(o, r, xx, labels, rng) * scale
+
+                _, pull = jax.vjp(scaled, own, ro, x)
+                d_own, d_ro, dx = pull(jnp.ones((), jnp.float32))
+                f32 = lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), t)
+                new_acc = jax.tree_util.tree_map(jnp.add, acc, f32(d_own))
+                new_ro = jax.tree_util.tree_map(jnp.add, acc_ro, f32(d_ro))
+                return dx, new_acc, new_ro
+
+            self.loss_j = jax.jit(loss_j)
+            self.bwd_j = jax.jit(bwd_last, donate_argnums=(6, 7))
+
+            def eval_loss(own, ro, x, labels, rng):
+                out = fwd(own, ro, x, rng, False)
+                return loss_fn(out, labels)
+
+            self.eval_loss_j = jax.jit(eval_loss)
+        else:
+            def bwd_mid(own, ro, x, rng, dy, acc, acc_ro):
+                def f(o, r, xx):
+                    return fwd(o, r, xx, rng, True)
+
+                _, pull = jax.vjp(f, own, ro, x)
+                d_own, d_ro, dx = pull(dy)
+                f32 = lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(jnp.float32), t)
+                new_acc = jax.tree_util.tree_map(jnp.add, acc, f32(d_own))
+                new_ro = jax.tree_util.tree_map(jnp.add, acc_ro, f32(d_ro))
+                return dx, new_acc, new_ro
+
+            self.bwd_j = jax.jit(bwd_mid, donate_argnums=(5, 6))
+
+    def build_apply(self, optimizer, clip):
+        def sq_norm(acc, denom):
+            return sum(jnp.sum(jnp.square(g / denom))
+                       for g in jax.tree_util.tree_leaves(acc))
+
+        self.sq_norm_j = jax.jit(sq_norm)
+
+        def apply_step(own, opt_state, acc, lr, denom, clip_coef):
+            # clip_coef carries the GLOBAL-norm clipping factor (computed
+            # across all stages by the engine) — per-stage local clipping
+            # would change the update direction vs the non-pipelined run
+            grads = jax.tree_util.tree_map(
+                lambda g: g * (clip_coef / denom), acc)
+            overflow = has_overflow(grads)
+            new_own, new_opt = optimizer.update(grads, opt_state, own, lr=lr)
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_own = sel(new_own, own)
+            new_opt = sel(new_opt, opt_state)
+            zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
+            return new_own, new_opt, zero, overflow
+
+        self.apply_j = jax.jit(apply_step, donate_argnums=(0, 1, 2))
+
+    # -- placement helpers ---------------------------------------------
+
+    def place_replicated(self, tree):
+        return jax.device_put(tree, self.replicated)
+
+    def place_batch(self, x):
+        x = jnp.asarray(x)
+        if x.ndim and x.shape[0] % len(self.devices) == 0:
+            return jax.device_put(x, self.batch_sharding)
+        return jax.device_put(x, self.replicated)
+
+    def zero_acc(self):
+        f32z = lambda t: jax.tree_util.tree_map(
+            lambda a: jnp.zeros(a.shape, jnp.float32), t)
+        self.acc = self.place_replicated(f32z(self.own))
+        self.acc_ro = self.place_replicated(f32z(self.ro_tied))
 
 
 class PipelineEngine(DeepSpeedEngine):
+    """Executes the TrainSchedule ISA over a staged PipelineModule.
+
+    Public API matches the reference PipelineEngine: train_batch pulls
+    gradient_accumulation_steps micro batches from the iterator and runs
+    the full 1F1B schedule + optimizer step; eval_batch runs the
+    InferenceSchedule.
+    """
+
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
         self.micro_batches = self.gradient_accumulation_steps()
+        module = self.module
+        self._staged = (isinstance(module, PipelineModule)
+                        and module.num_stages > 1
+                        and len(jax.devices()) >= module.num_stages)
+        if isinstance(module, PipelineModule) and module.num_stages > 1 \
+                and not self._staged:
+            logger.warning(
+                f"PipelineModule wants {module.num_stages} stages but only "
+                f"{len(jax.devices())} devices are visible; running "
+                f"single-stage through the base engine")
+        if self._staged:
+            self._build_stages()
+
+    # ------------------------------------------------------------------
+    # staged construction
+    # ------------------------------------------------------------------
+
+    def _build_stages(self):
+        module: PipelineModule = self.module
+        P = module.num_stages
+        devices = jax.devices()
+        G = len(devices) // P
+        clip = float(self._config.gradient_clipping or 0.0)
+
+        # tied ownership: first stage containing each tied key
+        def stage_of_layer(i):
+            for s in range(P):
+                if module.parts[s] <= i < module.parts[s + 1]:
+                    return s
+            return P - 1
+
+        tied_owner: Dict[str, int] = {}
+        tied_users: Dict[str, set] = {}
+        for i, spec in enumerate(module.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                s = stage_of_layer(i)
+                tied_owner.setdefault(spec.key, s)
+                tied_users.setdefault(spec.key, set()).add(s)
+        self._tied_owner = tied_owner
+        self._tied_users = tied_users
+
+        # whole-model params were built by the base engine; redistribute
+        full = jax.tree_util.tree_map(np.asarray, self._params)
+        self.stages: List[_StageRuntime] = []
+        for s in range(P):
+            lo, hi = module.parts[s], module.parts[s + 1]
+            rt = _StageRuntime(
+                stage_id=s,
+                layers=module._layers[lo:hi],
+                specs=module.layer_specs[lo:hi],
+                devices=devices[s * G:(s + 1) * G],
+                is_last=(s == P - 1),
+                loss_fn=module.loss_fn,
+                compute_dtype=self.compute_dtype)
+            own_tied = {k: full["tied"][k] for k, o in tied_owner.items()
+                        if o == s}
+            ro_tied = {k: full["tied"][k] for k, users in tied_users.items()
+                       if s in users and tied_owner[k] != s}
+            rt.own = rt.place_replicated(
+                {"layers": full["layers"][lo:hi], "tied": own_tied})
+            rt.ro_tied = rt.place_replicated(ro_tied)
+            rt.opt_state = rt.place_replicated(
+                self.optimizer.init(rt.own))
+            rt.build_apply(self.optimizer, clip)
+            rt.zero_acc()
+            self.stages.append(rt)
+
+        # the base engine's whole-tree placements are no longer the source
+        # of truth; drop them so device memory holds one copy of the model
+        self._params = None
+        self._opt_state = None
+        self._grad_acc = None
+        log_dist(
+            f"pipeline: {P} stages x {G} device(s)/stage, partitions "
+            f"{module.parts}, tied={ {k: sorted(v) for k, v in tied_users.items()} }",
+            ranks=[0])
+
+    # ------------------------------------------------------------------
+    # schedule execution
+    # ------------------------------------------------------------------
+
+    def _deps_ready(self, s: int, tick) -> bool:
+        # mailboxes are keyed by (stage, micro_batch): buffer ids are
+        # stage-LOCAL (num_pipe_buffers differs per stage), while sends and
+        # recvs both occur in micro-batch order — the counters recover the
+        # mb each pending Recv is waiting for
+        for cmd in tick:
+            if isinstance(cmd, RecvActivation) and \
+                    (s, self._recv_act_cnt[s]) not in self._mail_act:
+                return False
+            if isinstance(cmd, RecvGrad) and \
+                    (s, self._recv_grad_cnt[s]) not in self._mail_grad:
+                return False
+        return True
+
+    def _run_schedule(self, streams, dispatch):
+        P = len(streams)
+        pos = [0] * P
+        while True:
+            progressed = False
+            done = True
+            for s in range(P):
+                while pos[s] < len(streams[s]):
+                    tick = streams[s][pos[s]]
+                    if not self._deps_ready(s, tick):
+                        break
+                    for cmd in tick:
+                        dispatch(s, cmd)
+                    pos[s] += 1
+                    progressed = True
+                if pos[s] < len(streams[s]):
+                    done = False
+            if done:
+                return
+            if not progressed:
+                raise RuntimeError(
+                    f"pipeline schedule deadlock at positions {pos}")
 
     def train_batch(self, data_iter=None):
-        return super().train_batch(data_iter)
+        if not self._staged:
+            return super().train_batch(data_iter)
+        if data_iter is None:
+            if self.training_dataloader is None:
+                raise ValueError("train_batch needs data_iter or training_data")
+            if not hasattr(self, "_train_iter"):
+                from ..dataloader import RepeatingLoader
+                self._train_iter = iter(RepeatingLoader(self.training_dataloader))
+            data_iter = self._train_iter
+
+        self.tput_timer.start()
+        M = self.micro_batches
+        P = len(self.stages)
+        self._mail_act: Dict[Any, Any] = {}
+        self._mail_grad: Dict[Any, Any] = {}
+        self._data_iter = data_iter
+        self._batch_key = self._next_rng()
+        self._step_applied = False
+        self._recv_act_cnt = [0] * P
+        self._recv_grad_cnt = [0] * P
+        self._sent_act_cnt = [0] * P
+        self._sent_grad_cnt = [0] * P
+        for rt in self.stages:
+            rt.losses = []
+            rt.fwd_count = 0
+            rt.bwd_count = 0
+
+        streams = [list(TrainSchedule(M, P, s).steps()) for s in range(P)]
+        self._run_schedule(streams, self._dispatch_train)
+
+        last = self.stages[-1]
+        loss = jnp.mean(jnp.stack(last.losses)) if last.losses else None
+        self.micro_steps += M
+        self.global_samples += self.train_batch_size()
+        self._last_loss = loss
+        self.tput_timer.stop(report_speed=False)
+        if self.steps_per_print() and \
+                self.global_steps % self.steps_per_print() == 0:
+            log_dist(f"pipe step={self.global_steps} "
+                     f"loss={float(loss):.4f}", ranks=[0])
+        return loss
+
+    # -- instruction handlers ------------------------------------------
+
+    def _dispatch_train(self, s: int, cmd):
+        rt = self.stages[s]
+        b = getattr(cmd, "buffer_id", None)
+        if isinstance(cmd, LoadMicroBatch):
+            inputs, labels = self._next_micro_batch()
+            mb = rt.fwd_count
+            rt.x_in[b] = rt.place_batch(inputs)
+            self.stages[-1].labels[mb] = labels
+        elif isinstance(cmd, RecvActivation):
+            mb = self._recv_act_cnt[s]
+            self._recv_act_cnt[s] += 1
+            rt.x_in[b] = self._mail_act.pop((s, mb))
+        elif isinstance(cmd, ForwardPass):
+            mb = rt.fwd_count
+            rt.fwd_count += 1
+            rng = jax.random.fold_in(self._batch_key, mb * len(self.stages) + s)
+            rt.rng_in[b] = rng
+            if rt.is_last:
+                labels = rt.place_batch(rt.labels[mb])
+                rt.labels[mb] = labels
+                rt.y_out[b] = None
+                rt.losses.append(rt.loss_j(rt.own, rt.ro_tied, rt.x_in[b],
+                                           labels, rng))
+            else:
+                rt.y_out[b] = rt.fwd_j(rt.own, rt.ro_tied, rt.x_in[b], rng)
+        elif isinstance(cmd, SendActivation):
+            nxt = self.stages[s + 1]
+            mb = self._sent_act_cnt[s]
+            self._sent_act_cnt[s] += 1
+            y = rt.y_out.pop(b)
+            self._mail_act[(s + 1, mb)] = jax.device_put(
+                y, nxt.batch_sharding
+                if y.shape[0] % len(nxt.devices) == 0 else nxt.replicated)
+        elif isinstance(cmd, RecvGrad):
+            mb = self._recv_grad_cnt[s]
+            self._recv_grad_cnt[s] += 1
+            rt.dy_in = getattr(rt, "dy_in", {})
+            rt.dy_in[b] = self._mail_grad.pop((s, mb))
+        elif isinstance(cmd, BackwardPass):
+            mb = rt.bwd_count
+            rt.bwd_count += 1
+            x = rt.x_in.pop(b)
+            rng = rt.rng_in.pop(b)
+            if rt.is_last:
+                scale = self._scaler_state["cur_scale"]
+                labels = rt.labels.pop(mb)
+                dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                    rt.own, rt.ro_tied, x, labels, rng, scale,
+                    rt.acc, rt.acc_ro)
+            else:
+                dy = rt.dy_in.pop(b)
+                dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                    rt.own, rt.ro_tied, x, rng, dy, rt.acc, rt.acc_ro)
+            rt.dx_out[b] = dx
+        elif isinstance(cmd, SendGrad):
+            prev = self.stages[s - 1]
+            mb = self._sent_grad_cnt[s]
+            self._sent_grad_cnt[s] += 1
+            dx = rt.dx_out.pop(b)
+            self._mail_grad[(s - 1, mb)] = jax.device_put(
+                dx, prev.batch_sharding
+                if dx.shape[0] % len(prev.devices) == 0 else prev.replicated)
+        elif isinstance(cmd, ReduceTiedGrads):
+            self._reduce_tied_grads()
+        elif isinstance(cmd, ReduceGrads):
+            pass  # within-stage dp reduction is implicit in the jitted loss
+        elif isinstance(cmd, OptimizerStep):
+            self._pipe_optimizer_step()
+        else:
+            raise NotImplementedError(f"instruction {cmd!r}")
+
+    def _next_micro_batch(self):
+        batch = next(self._data_iter)
+        if isinstance(batch, dict):
+            return batch["input_ids"], batch.get("labels")
+        return batch[0], batch[1]
+
+    def _reduce_tied_grads(self):
+        """Ship non-owner tied grads to the owner stage and sum (the
+        single-controller form of reference pipe/engine.py's
+        _all_reduce_tied_weight_gradients)."""
+        if getattr(self, "_tied_reduced", False):
+            return
+        self._tied_reduced = True
+        for key, users in self._tied_users.items():
+            owner = self.stages[self._tied_owner[key]]
+            total = owner.acc["tied"][key]
+            for s in sorted(users):
+                rt = self.stages[s]
+                if rt.stage_id == owner.stage_id:
+                    continue
+                g = jax.device_put(rt.acc_ro[key], owner.replicated)
+                total = jax.tree_util.tree_map(jnp.add, total, g)
+            owner.acc["tied"][key] = total
+
+    def _pipe_optimizer_step(self):
+        if self._step_applied:
+            return
+        self._step_applied = True
+        self._tied_reduced = False
+        denom = jnp.asarray(
+            self._scaler_state["cur_scale"] * self.micro_batches,
+            jnp.float32)
+        cur_lr = self._current_lr()
+        lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        clip = float(self._config.gradient_clipping or 0.0)
+        clip_coef = 1.0
+        if clip > 0.0:
+            # global grad norm across ALL stages (reference pipe engine
+            # all-reduces the norm over pipeline ranks before clipping)
+            total_sq = sum(float(rt.sq_norm_j(rt.acc, denom))
+                           for rt in self.stages)
+            norm = float(np.sqrt(total_sq))
+            if np.isfinite(norm) and norm > clip:
+                clip_coef = clip / (norm + 1e-6)
+        flags = []
+        for rt in self.stages:
+            rt.own, rt.opt_state, rt.acc, ov = rt.apply_j(
+                rt.own, rt.opt_state, rt.acc,
+                lr, denom, jnp.asarray(clip_coef, jnp.float32))
+            rt.acc_ro = jax.tree_util.tree_map(
+                jnp.zeros_like, rt.acc_ro)
+            flags.append(ov)
+        overflow = bool(np.any([np.asarray(f) for f in flags]))
+        self._scaler_state = self.loss_scaler.jit_update(
+            self._scaler_state, jnp.asarray(overflow))
+        self.global_steps += 1
+        if overflow:
+            # all stages selected their old params in-jit; undo bookkeeping
+            self._skipped_steps += 1
+            log_dist(f"pipeline overflow: skipped step, new loss scale "
+                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
+        else:
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self._refresh_tied_copies()
+        self._emit_monitor_scalars()
+
+    def _refresh_tied_copies(self):
+        for key, users in self._tied_users.items():
+            owner = self.stages[self._tied_owner[key]]
+            for s in sorted(users):
+                rt = self.stages[s]
+                if rt.stage_id == owner.stage_id:
+                    continue
+                rt.ro_tied[key] = jax.device_put(
+                    owner.own["tied"][key], rt.replicated)
+
+    # ------------------------------------------------------------------
+    # eval / inference
+    # ------------------------------------------------------------------
 
     def eval_batch(self, data_iter):
-        batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
-        return super().eval_batch(batch)
+        if not self._staged:
+            batch = next(data_iter) if hasattr(data_iter, "__next__") \
+                else data_iter
+            return super().eval_batch(batch)
+        if not hasattr(data_iter, "__next__"):
+            data_iter = iter([data_iter])
+        self._mail_act = {}
+        self._mail_grad = {}
+        self._data_iter = data_iter
+        self._batch_key = self._next_rng()
+        M = self.micro_batches
+        P = len(self.stages)
+        for rt in self.stages:
+            rt.losses = []
+            rt.fwd_count = 0
+        # forward-only streams; consume as many micro batches as available
+        losses = []
+        for mb in range(M):
+            try:
+                inputs, labels = self._next_micro_batch()
+            except StopIteration:
+                break
+            x = self.stages[0].place_batch(inputs)
+            for rt in self.stages[:-1]:
+                x = rt.fwd_eval_j(rt.own, rt.ro_tied, x, None)
+                x = jax.device_put(
+                    x, self.stages[rt.stage_id + 1].batch_sharding
+                    if x.shape[0] % len(self.stages[rt.stage_id + 1].devices) == 0
+                    else self.stages[rt.stage_id + 1].replicated)
+            last = self.stages[-1]
+            losses.append(last.eval_loss_j(
+                last.own, last.ro_tied, x, last.place_batch(labels), None))
+        return jnp.mean(jnp.stack(losses)) if losses else None
 
     def inference_batch(self, data_iter):
         """EleutherAI addition (reference pipe/engine.py:422)."""
         batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
         inputs = batch[0] if isinstance(batch, (tuple, list)) else batch
-        return self.module.apply(self._params, inputs, train=False)
+        if not self._staged:
+            return self.module.apply(self._params, inputs, train=False)
+        x = self.stages[0].place_batch(inputs)
+        for rt in self.stages:
+            x = rt.fwd_eval_j(rt.own, rt.ro_tied, rt.place_batch(x), None)
+        return x
+
+    # ------------------------------------------------------------------
+    # checkpointing: per-layer files (reference pipe/module.py:520-578)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        if not self._staged:
+            return super().save_checkpoint(save_dir, tag, client_state,
+                                           save_latest)
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        module: PipelineModule = self.module
+        layer_states = {}
+        tied_states = {}
+        for s, rt in enumerate(self.stages):
+            lo = module.parts[s]
+            own_np = jax.tree_util.tree_map(np.asarray, rt.own)
+            for j, lp in enumerate(own_np["layers"]):
+                layer_states[lo + j] = lp
+            tied_states.update(own_np["tied"])
+        model_state = {
+            "module": {"layers": [layer_states.get(i)
+                                  for i in range(module.num_layers())],
+                       "tied": tied_states},
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None else None),
+            "loss_scaler": {k: np.asarray(v)
+                            for k, v in self._scaler_state.items()},
+            "rng_key": np.asarray(self._rng_key),
+            **self._client_state(client_state),
+        }
+        optim_state = {
+            "optimizer_state": [jax.tree_util.tree_map(np.asarray,
+                                                       rt.opt_state)
+                                for rt in self.stages],
+            "pipeline_parts": list(module.parts),
+            "zero_stage": self.zero_optimization_stage(),
+            "offload": False,
+        }
+        ckpt_io.save_checkpoint_state(
+            save_dir, tag, model_state, optim_state, save_latest=save_latest,
+            layer_states=layer_states, tied_states=tied_states)
+        return True
+
+    def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        if not self._staged:
+            return super().load_checkpoint(load_dir, tag, load_module_strict,
+                                           load_optimizer_states,
+                                           load_lr_scheduler_states)
+        try:
+            ckpt_dir, model_state, optim_state = \
+                ckpt_io.load_checkpoint_state(load_dir, tag)
+        except FileNotFoundError as e:
+            logger.warning(f"load_checkpoint: {e}")
+            return None, {}
+        module: PipelineModule = self.module
+        layers = model_state["module"]["layers"]
+        tied = model_state["module"]["tied"]
+        for s, rt in enumerate(self.stages):
+            lo, hi = module.parts[s], module.parts[s + 1]
+            own_tied = {k: tied[k] for k, o in self._tied_owner.items()
+                        if o == s}
+            rt.own = rt.place_replicated(
+                {"layers": [jax.tree_util.tree_map(jnp.asarray, l)
+                            for l in layers[lo:hi]],
+                 "tied": own_tied})
+            if load_optimizer_states and optim_state is not None and \
+                    optim_state.get("pipeline_parts") == list(module.parts):
+                rt.opt_state = rt.place_replicated(
+                    jax.tree_util.tree_map(
+                        jnp.asarray, optim_state["optimizer_state"][s]))
+            rt.zero_acc()
+        self._refresh_tied_copies()
+        if model_state.get("loss_scaler") is not None:
+            self._scaler_state = {
+                k: jnp.asarray(v)
+                for k, v in model_state["loss_scaler"].items()}
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                model_state.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+        if model_state.get("rng_key") is not None:
+            self._rng_key = jnp.asarray(model_state["rng_key"])
+        self.global_steps = int(model_state.get("global_steps", 0))
+        self.global_samples = int(model_state.get("global_samples", 0))
+        self.micro_steps = int(model_state.get("micro_steps", 0))
+        self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)
+        client_state = {k: v for k, v in model_state.items()
+                        if k not in ("module", "lr_scheduler", "loss_scaler")}
+        return ckpt_dir, client_state

@@ -98,6 +98,21 @@ class PipelineModule:
             weights = self._count_layer_params()
             parts = partition_balanced([float(w) for w in weights],
                                        self.num_stages)
+        elif method.startswith("type:"):
+            # balance the count of layers whose class name matches the
+            # regex (reference pipe/module.py:102,378-385)
+            import re
+
+            pattern = self.partition_method[len("type:"):]
+            weights = [1.0 if re.search(pattern, type(l).__name__,
+                                        re.IGNORECASE) else 0.0
+                       for l in self._layers]
+            if not any(weights):
+                raise ValueError(
+                    f"partition_method {self.partition_method!r} matched no "
+                    f"layers (classes: "
+                    f"{sorted({type(l).__name__ for l in self._layers})})")
+            parts = partition_balanced(weights, self.num_stages)
         else:
             raise NotImplementedError(
                 f"partition_method {self.partition_method!r}")
@@ -121,7 +136,9 @@ class PipelineModule:
             if isinstance(spec, TiedLayerSpec):
                 if spec.key not in tied:
                     tied[spec.key] = layer.init(sub)
-                layer_params.append(None)
+                # {} (empty subtree) not None: None breaks strict pytree
+                # zips against spec/sharding trees in the engine
+                layer_params.append({})
             else:
                 layer_params.append(layer.init(sub))
         return {"layers": layer_params, "tied": tied}

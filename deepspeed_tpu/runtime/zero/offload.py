@@ -14,10 +14,21 @@ engine). TPU-native equivalent:
   holds only one leaf's moments at a time — the ZeRO-Infinity pattern
   (reference swap_tensor/optimizer_utils.py) without its hook machinery.
 
-The step is host-blocking by design; that is the offload trade: HBM
-capacity for step latency. Grad transfer for leaf i+1 overlaps the Adam
-compute of leaf i via async dispatch (device_get is issued for all leaves
-up front; jax overlaps the D2H DMAs).
+The step overlaps three phases (reference overlap analogue:
+stage2.py:680-745 grad D2H tiling + cpu_adam.h:23 param-copy overlap):
+
+1. D2H: `copy_to_host_async` is issued for EVERY grad leaf up front, so
+   all transfers are in flight before the first host read blocks;
+2. compute: the native Adam (csrc/adam/cpu_adam.cpp) updates leaf i while
+   leaf i+1's transfer completes;
+3. H2D: updated weights are emitted directly in the bf16 wire format
+   (`ds_adam_step_bf16` round-to-nearest-even) and `jax.device_put` is
+   dispatched asynchronously — the upload of leaf i rides alongside the
+   Adam compute of leaf i+1, at half the fp32 wire size.
+
+The overflow check requires all grads host-side before the first update
+(a later-leaf inf must skip the WHOLE step, reference loss-scaler
+semantics), so phase 1 is a barrier — but a concurrent one.
 """
 
 from __future__ import annotations
@@ -101,8 +112,15 @@ class CPUOffloadRuntime:
              clip: float = 0.0):
         """grad_leaves: device fp32 grad accumulators (unscaled by denom
         here on host). Returns (new device param leaves, overflow, norm)."""
-        # start all D2H copies; jax overlaps the DMAs
-        host_grads = [np.asarray(g).ravel() for g in grad_leaves]
+        # issue ALL D2H copies before the first blocking read — transfers
+        # run concurrently, np.asarray then only waits for its own leaf
+        for g in grad_leaves:
+            try:
+                g.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass  # non-jax input (e.g. tests passing numpy)
+        host_grads = [np.asarray(g, np.float32).ravel()
+                      for g in grad_leaves]
         inv = 1.0 / denom
         overflow = not all(np.isfinite(g).all() for g in host_grads)
         if overflow:
@@ -114,19 +132,36 @@ class CPUOffloadRuntime:
         if clip > 0.0 and norm > clip:
             scale = inv * (clip / (norm + 1e-6))
 
+        import ml_dtypes
+        emit_bf16 = self.param_dtype == jnp.bfloat16
         self.adam.begin_step()
         new_leaves = []
         for i, (master, g) in enumerate(zip(self.masters, host_grads)):
-            g32 = (g * scale).astype(np.float32)
+            # jax host views are read-only; one writable scaled copy
+            g = np.multiply(g, np.float32(scale), dtype=np.float32)
+            g = np.ascontiguousarray(g)
             if self.nvme is not None:
                 self.adam._state[i] = self.nvme.load(i, master.size)
-            self.adam.update_flat(i, master, g32, lr=lr)
+            if emit_bf16:
+                # native kernel emits the bf16 wire directly — half the
+                # upload bytes, no separate fp32->bf16 host pass
+                wire = np.empty(master.size, np.uint16)
+                self.adam.update_flat(i, master, g, lr=lr, out_bf16=wire)
+                host_out = wire.view(ml_dtypes.bfloat16).reshape(
+                    self.shapes[i])
+            else:
+                self.adam.update_flat(i, master, g, lr=lr)
+                host_out = master.reshape(self.shapes[i])
+                target = np.dtype(self.param_dtype)
+                if host_out.dtype != target:  # e.g. fp16 working weights
+                    host_out = host_out.astype(target)
             if self.nvme is not None:
                 self.nvme.store(i, self.adam._state.pop(i))
-            dev = jnp.asarray(master.reshape(self.shapes[i]),
-                              dtype=self.param_dtype)
+            # async dispatch: leaf i uploads while leaf i+1 computes
             if self.param_shardings is not None:
-                dev = jax.device_put(dev, self.param_shardings[i])
+                dev = jax.device_put(host_out, self.param_shardings[i])
+            else:
+                dev = jnp.asarray(host_out, dtype=self.param_dtype)
             new_leaves.append(dev)
         params = jax.tree_util.tree_unflatten(self.treedef, new_leaves)
         return params, False, norm

@@ -1,0 +1,77 @@
+"""Shared fixed-seed training curve for the convergence regression harness.
+
+One canonical run: GPT-2 nano, deterministic synthetic modular-addition
+data (learnable, so the curve actually falls), fixed seeds, ZeRO-2 on the
+8-device CPU mesh. The pinned curve lives in
+tests/convergence/gpt2_nano_loss.json (written by
+tools/record_convergence.py); test_convergence.py asserts every recorded
+step stays within tolerance — a silent optimizer/model/numerics regression
+fails CI (reference methodology: tests/model/Megatron_GPT2/run_func_test.py).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "convergence",
+                             "gpt2_nano_loss.json")
+
+CONFIG = {
+    "steps": 40,
+    "micro": 8,
+    "seq": 32,
+    "lr": 1e-3,
+    "seed": 1234,
+    "vocab": 64,
+}
+
+
+def synthetic_batches(steps, micro, seq, vocab, seed):
+    """Deterministic learnable stream: next token = (a + b) % vocab over
+    the two previous tokens — enough structure for the loss to fall."""
+    rng = np.random.RandomState(seed)
+    for _ in range(steps):
+        toks = np.zeros((micro, seq + 1), np.int32)
+        toks[:, 0] = rng.randint(0, vocab, micro)
+        toks[:, 1] = rng.randint(0, vocab, micro)
+        for t in range(2, seq + 1):
+            toks[:, t] = (toks[:, t - 1] + toks[:, t - 2]) % vocab
+        yield toks[:, :-1], toks[:, 1:]
+
+
+def run_curve(config=CONFIG):
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+
+    os.environ["DSTPU_SEED"] = str(config["seed"])
+    n_dev = jax.device_count()
+    cfg = gpt2_config("nano", max_seq_len=config["seq"],
+                      vocab_size=config["vocab"],
+                      shard_activations=False)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT(cfg),
+        config_params={
+            "train_batch_size": config["micro"] * n_dev,
+            "train_micro_batch_size_per_gpu": config["micro"],
+            "optimizer": {"type": "Adam", "params": {"lr": config["lr"]}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": n_dev},
+            "steps_per_print": 0,
+        })
+    losses = []
+    rng = jax.random.PRNGKey(config["seed"])
+    import jax.numpy as jnp  # noqa: F401
+
+    for i, (x, y) in enumerate(synthetic_batches(
+            config["steps"], config["micro"] * n_dev, config["seq"],
+            config["vocab"], config["seed"])):
+        rng, sub = jax.random.split(rng)
+        loss = engine.forward((x, y), rng=sub)
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    return losses

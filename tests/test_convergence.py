@@ -1,0 +1,29 @@
+"""Pinned-baseline convergence regression (reference methodology:
+tests/model/Megatron_GPT2/run_func_test.py:20-36 — fixed config + seed,
+metric asserted within tolerance). Regenerate the baseline ONLY for an
+intentional numerics change: python tools/record_convergence.py."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from convergence_common import BASELINE_PATH, CONFIG, run_curve
+
+
+@pytest.mark.slow
+def test_gpt2_nano_pinned_loss_curve():
+    assert os.path.isfile(BASELINE_PATH), \
+        "missing pinned baseline; run tools/record_convergence.py"
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    assert baseline["config"] == CONFIG, \
+        "convergence config drifted from the pinned baseline; re-record"
+    losses = run_curve()
+    ref = baseline["losses"]
+    assert len(losses) == len(ref)
+    # point-wise: catches late-curve divergence a final-loss check misses
+    np.testing.assert_allclose(losses, ref, rtol=0.05, atol=0.02)
+    # and the curve must actually converge
+    assert losses[-1] < 0.5 * losses[0]
