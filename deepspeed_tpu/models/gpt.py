@@ -458,6 +458,90 @@ class GPT(TrainModule):
             ce = ce + self.config.moe_aux_loss_weight * moe_aux
         return ce
 
+    # -- ZeRO-Infinity streaming protocol ------------------------------
+    # (runtime/zero/infinity.py trains larger-than-HBM models by holding
+    # only one block's params in device memory at a time; these methods
+    # expose the model as embed -> blocks -> head pure stages plus
+    # group-wise host init. Reference capability: zero/stage3.py param
+    # paging + swap_tensor/partitioned_param_swapper.py.)
+
+    def stream_supported(self) -> bool:
+        cfg = self.config
+        return (cfg.num_experts == 1 and cfg.pipeline_stages == 1
+                and cfg.dropout == 0.0 and cfg.embed_dropout == 0.0)
+
+    def stream_init(self, rng):
+        """Yield (group_name, host_numpy_subtree) with only ONE group ever
+        materialized on device — init for models that don't fit in HBM."""
+        import numpy as _np
+
+        cfg = self.config
+        keys = jax.random.split(rng, cfg.num_layers + 3)
+        to_host = lambda t: jax.tree_util.tree_map(
+            lambda a: _np.asarray(a), t)
+
+        def embed_init(k0, k1):
+            return {"wte": (jax.random.normal(k0, (cfg.vocab_size,
+                                                   cfg.d_model)) * 0.02
+                            ).astype(cfg.param_dtype),
+                    "wpe": (jax.random.normal(k1, (cfg.max_seq_len,
+                                                   cfg.d_model)) * 0.01
+                            ).astype(cfg.param_dtype)}
+
+        yield "embed", to_host(jax.jit(embed_init)(keys[0], keys[1]))
+        for i in range(cfg.num_layers):
+            yield f"block:{i}", to_host(
+                jax.jit(lambda k, i=i: _init_block(k, cfg, i))(keys[2 + i]))
+        head = {"ln_f": {"scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
+                         "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype)}}
+        if not cfg.tie_embeddings:
+            head["lm_head"] = jax.jit(
+                lambda k: (jax.random.normal(k, (cfg.d_model,
+                                                 cfg.vocab_size)) * 0.02
+                           ).astype(cfg.param_dtype))(keys[-1])
+        yield "head", to_host(head)
+
+    def stream_groups(self, params):
+        """Disjoint group cover of a full params tree (inverse of
+        assemble_groups)."""
+        groups = [("embed", {"wte": params["wte"], "wpe": params["wpe"]})]
+        for i, bp in enumerate(params["blocks"]):
+            groups.append((f"block:{i}", bp))
+        head = {"ln_f": params["ln_f"]}
+        if not self.config.tie_embeddings:
+            head["lm_head"] = params["lm_head"]
+        groups.append(("head", head))
+        return groups
+
+    def assemble_groups(self, groups: Dict[str, Any]):
+        params = {"wte": groups["embed"]["wte"],
+                  "wpe": groups["embed"]["wpe"],
+                  "blocks": [groups[f"block:{i}"]
+                             for i in range(self.config.num_layers)],
+                  "ln_f": groups["head"]["ln_f"]}
+        if not self.config.tie_embeddings:
+            params["lm_head"] = groups["head"]["lm_head"]
+        return params
+
+    def stream_embed(self, embed_p, tokens):
+        S = tokens.shape[1]
+        return embed_p["wte"][tokens] + embed_p["wpe"][:S][None, :, :]
+
+    def stream_block(self, block_p, x):
+        return gpt_block(x, block_p, self.config, None, True)[0]
+
+    def stream_head_loss(self, head_p, wte_or_lm_head, x, labels, valid):
+        """ln_f + fused projection CE. `wte_or_lm_head`: the tied wte
+        ([V, D]) or lm_head ([D, V]) — tied grads flow to the caller."""
+        cfg = self.config
+        x = layer_norm(x, head_p["ln_f"], cfg.layer_norm_eps)
+        w = (wte_or_lm_head.T if cfg.tie_embeddings else wte_or_lm_head)
+        B, S, D = x.shape
+        nll = _softmax_xent_from_hidden(
+            x.reshape(B * S, D), w, labels.reshape(-1), valid.reshape(-1),
+            cfg.loss_chunks)
+        return nll / jnp.maximum(jnp.sum(valid), 1)
+
     # -- convenience ---------------------------------------------------
     def num_params(self, params=None) -> int:
         if params is None:

@@ -92,9 +92,32 @@ class DeepSpeedEngine:
         self.compute_dtype = DTYPES[self._config.precision]
         self.loss_scaler = create_loss_scaler(self._config)
 
+        if self._config.sparse_gradients_enabled:
+            # documented divergence from reference engine.py:1397-1449
+            # (CSR allreduce of embedding grads): in-jit DP reduction is a
+            # fused XLA psum riding ICI, where a row-sparse wire format
+            # (dynamic row counts -> retrace/padding) costs more than the
+            # dense collective it replaces. The config key is accepted for
+            # parity; CSRTensor serves host-side/out-of-jit exchange.
+            log_dist("sparse_gradients: accepted for API parity; in-jit "
+                     "DP reduction stays dense (XLA psum over ICI)",
+                     ranks=[0])
+
         # parameters: user-supplied pytree wins, else model.init
         key = jax.random.PRNGKey(int(os.environ.get("DSTPU_SEED", 42)))
         self._rng_key, init_key = jax.random.split(key)
+
+        # ZeRO-Infinity: stage 3 + offload_param streams params from host
+        # — the full tree is NEVER materialized on device (larger-than-HBM
+        # models; reference zero/stage3.py + swap_tensor paging)
+        self._infinity = self._configure_infinity(init_key)
+        if self._infinity is not None:
+            if model_parameters is not None:
+                # user-supplied weights become the host masters
+                self._infinity.load_masters_tree(model_parameters)
+            self._finish_infinity_init(lr_scheduler, training_data)
+            return
+
         if model_parameters is not None:
             params = model_parameters
         else:
@@ -166,6 +189,60 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # construction helpers
     # ------------------------------------------------------------------
+
+    def _configure_infinity(self, init_key):
+        zc = self._config.zero_config
+        if not (self._config.zero_optimization_stage >= 3
+                and zc.offload_param is not None
+                and hasattr(self.module, "stream_init")):
+            return None
+        if self.gradient_accumulation_steps() != 1:
+            raise ValueError("ZeRO-Infinity streaming requires "
+                             "gradient_accumulation_steps == 1")
+        if jax.process_count() > 1:
+            # the streamed step has no cross-host gradient reduction yet;
+            # silent replica divergence is worse than refusing
+            raise NotImplementedError(
+                "ZeRO-Infinity streaming is single-host for now "
+                "(no cross-process grad reduction in the streamed step)")
+        from .zero.infinity import InfinityRuntime
+
+        hparams = dict(self._config.optimizer_params or {})
+        adam_w = bool(hparams.pop(const.ADAM_W_MODE, True))
+        nvme = (zc.offload_param.nvme_path
+                if zc.offload_param.device == "nvme" else None)
+        return InfinityRuntime(self.module, init_key, hparams,
+                               adam_w_mode=adam_w,
+                               compute_dtype=self.compute_dtype,
+                               nvme_path=nvme)
+
+    def _finish_infinity_init(self, lr_scheduler, training_data=None):
+        """Minimal engine state for the streamed path (no device param
+        tree, no jitted step fns, no zero plan)."""
+        self._params = None
+        self._opt_state = None
+        self._offload = None
+        self.zero_plan = None
+        self._grad_acc = None
+        self._cached = None
+        self.optimizer = self._configure_optimizer()  # lr container only
+        self._scaler_state = self.loss_scaler.jit_state()
+        self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
+        self.progressive_layer_drop = None
+        self.training_dataloader = (self.deepspeed_io(training_data)
+                                    if training_data is not None else None)
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.train_batch_size(),
+            steps_per_output=self.steps_per_print() or 50)
+        self._step_fns = {}
+        self._last_lr = self._current_lr()
+        self.timers = SynchronizedWallClockTimer()
+        self.wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
+        self.monitor = None
+        self._flops_profiled = True
+        self._last_loss = None
+        self._pending_overflow = None
+        self._pending_full = None
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
         mesh_dict = {}
@@ -289,10 +366,11 @@ class DeepSpeedEngine:
             grad_norm = jnp.asarray(0.0, jnp.float32)
             if clip > 0.0:
                 grads, grad_norm = clip_grad_norm(grads, clip)
-            # NOTE: with the jit+sharded-batch model, DP grad averaging
-            # already happened (XLA psum at the loss-mean boundary), so
-            # OnebitAdam runs with comm_axis=None here; its shard_map
-            # integration (true compressed comm) is exercised separately.
+            # grads here are already DP-averaged (XLA psum at the loss-mean
+            # boundary), so a 1-bit optimizer on this path runs dense
+            # (comm_axis=None). The compressed hot path is
+            # _build_onebit_step: a shard_map fused step with LOCAL grads
+            # where the optimizer owns the wire.
             new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
 
             # branchless skip-step on overflow (reference: step skipped,
@@ -355,13 +433,162 @@ class DeepSpeedEngine:
         # lr=None (optimizer-default) is a static arg value: jit treats None
         # as an empty pytree, giving that case its own (single) trace
         donate_apply = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
+        def scan_batch_step(params, opt_state, scaler_state, batches, rngs,
+                            lr, pld_theta):
+            """Whole GLOBAL batch (gas micro steps + update) as ONE
+            program: micro batches arrive stacked on a leading [gas] dim
+            and a lax.scan accumulates grads — one host dispatch per
+            global batch instead of gas+1 (train_batch uses this when the
+            iterator is stackable)."""
+            loss_scale = scaler_state["cur_scale"]
+            cparams = cast(params, compute_dtype)
+
+            def scaled_loss_fn(p, batch, rng):
+                kwargs = {}
+                if pld_enabled:
+                    kwargs = {"progressive_layer_drop": True,
+                              "pld_theta": pld_theta}
+                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                scale_factor = loss_scale / (predivide if prescale else 1.0)
+                return loss.astype(jnp.float32) * scale_factor, loss
+
+            def body(acc, inp):
+                batch_i, rng_i = inp
+                grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(
+                    cparams, batch_i, rng_i)
+                acc = jax.tree_util.tree_map(
+                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                return plan.constrain_grads(acc), loss
+
+            acc0 = jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+            acc0 = plan.constrain_grads(acc0)
+            acc, losses = jax.lax.scan(body, acc0, (batches, rngs))
+            (new_params, new_opt, new_scaler, zero_acc, overflow,
+             grad_norm) = apply_step(params, opt_state, scaler_state, acc,
+                                     lr)
+            return (new_params, new_opt, new_scaler, jnp.mean(losses),
+                    overflow, grad_norm)
+
         fns = {"micro": donate_micro, "apply": donate_apply}
-        if gas == 1 and self._offload is None:
+        if self._use_onebit_comm():
+            fns["full"] = self._build_onebit_step(cast)
+        elif gas == 1 and self._offload is None:
             # scaler state (arg 2) is NOT donated: it stays readable between
             # the fused forward and step(), so engine.loss_scale keeps
             # reference pre-update semantics until the boundary's step()
             fns["full"] = jax.jit(full_step, donate_argnums=(0, 1))
+        elif gas > 1 and self._offload is None:
+            fns["full_scan"] = jax.jit(scan_batch_step,
+                                       donate_argnums=(0, 1))
         return fns
+
+    def _use_onebit_comm(self) -> bool:
+        """True when the optimizer's own (compressed) DP reduction runs in
+        the training hot path. Mirrors the reference constraint set: 1-bit
+        optimizers are incompatible with ZeRO stages and grad accumulation
+        fans through the dense accumulator, so the compressed wire path
+        needs gas==1, stage 0, no offload, dp > 1."""
+        opt = self.optimizer
+        if not getattr(opt, "handles_dp_reduction", False):
+            return False
+        ok = (self.gradient_accumulation_steps() == 1
+              and self._offload is None
+              and self._config.zero_optimization_stage == 0
+              and self.mesh_info.axis_size(DATA_AXIS) > 1)
+        if not ok:
+            log_dist(
+                "1-bit optimizer falling back to dense DP reduction "
+                "(compressed comm needs gas==1, ZeRO stage 0, no offload, "
+                "dp>1 — reference onebit/adam.py has the same constraints)",
+                ranks=[0])
+        return ok
+
+    def _build_onebit_step(self, cast):
+        """Fused step with the optimizer-owned compressed reduction over
+        the `data` axis INSIDE shard_map: gradients stay local per shard,
+        only the optimizer's (sign-compressed after freeze_step) momentum
+        crosses the wire — the reference NcclBackend wire pattern
+        (comm/nccl.py:47-186) on XLA collectives."""
+        model = self.module
+        compute_dtype = self.compute_dtype
+        opt = self.optimizer
+        scaler = self.loss_scaler
+        pld_enabled = self.progressive_layer_drop is not None
+        mesh = self.mesh_info.mesh
+        dp = self.mesh_info.axis_size(DATA_AXIS)
+        if float(self._config.gradient_clipping or 0.0) > 0.0:
+            logger.warning("gradient clipping is not applied on the 1-bit "
+                           "compressed path (local grads are never "
+                           "globally reduced; reference parity)")
+
+        # per-rank error-feedback buffers: [dp, *param] sharded over data
+        self._opt_state = dict(self._opt_state)
+        for key in ("worker_error", "server_error"):
+            expanded = jax.tree_util.tree_map(
+                lambda e: jnp.zeros((dp,) + tuple(e.shape), jnp.float32),
+                self._opt_state[key])
+            self._opt_state[key] = jax.device_put(
+                expanded, jax.tree_util.tree_map(
+                    lambda _: NamedSharding(
+                        mesh, PartitionSpec(DATA_AXIS)), expanded))
+
+        self._onebit_hot = True
+        err_spec = PartitionSpec(DATA_AXIS)
+        state_specs = {k: (err_spec if k in ("worker_error", "server_error")
+                           else PartitionSpec())
+                       for k in self._opt_state}
+
+        def run(params, opt_state, scaler_state, batch, rng, lr, pld_theta):
+            loss_scale = scaler_state["cur_scale"]
+            cparams = cast(params, compute_dtype)
+
+            def scaled_loss_fn(p):
+                kwargs = {}
+                if pld_enabled:
+                    kwargs = {"progressive_layer_drop": True,
+                              "pld_theta": pld_theta}
+                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
+                loss = out[0] if isinstance(out, tuple) else out
+                return loss.astype(jnp.float32) * loss_scale, loss
+
+            # LOCAL gradients: the loss is the mean over this shard's rows
+            # only — no implicit psum; the optimizer does the reduction
+            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(cparams)
+            grads = cast(grads, jnp.float32)
+            overflow = jax.lax.pmax(
+                has_overflow(grads).astype(jnp.int32), DATA_AXIS) > 0
+            grads = jax.tree_util.tree_map(lambda g: g / loss_scale, grads)
+
+            local_state = dict(opt_state)
+            for key in ("worker_error", "server_error"):
+                local_state[key] = jax.tree_util.tree_map(
+                    lambda e: e[0], opt_state[key])
+            new_params, new_opt = opt.update(grads, local_state, params,
+                                            lr=lr, comm_axis=DATA_AXIS)
+            sel = lambda new, old: jax.tree_util.tree_map(
+                lambda n, o: jnp.where(overflow, o, n), new, old)
+            new_params = sel(new_params, params)
+            new_opt = sel(new_opt, local_state)
+            new_opt = dict(new_opt)
+            for key in ("worker_error", "server_error"):
+                new_opt[key] = jax.tree_util.tree_map(
+                    lambda e: e[None], new_opt[key])
+            new_scaler = scaler.jit_update(scaler_state, overflow)
+            loss_mean = jax.lax.pmean(loss, DATA_AXIS)
+            return (new_params, new_opt, new_scaler, loss_mean, overflow,
+                    jnp.zeros((), jnp.float32))
+
+        smapped = jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(PartitionSpec(), state_specs, PartitionSpec(),
+                      PartitionSpec(DATA_AXIS), PartitionSpec(),
+                      PartitionSpec(), PartitionSpec()),
+            out_specs=(PartitionSpec(), state_specs, PartitionSpec(),
+                       PartitionSpec(), PartitionSpec(), PartitionSpec()),
+            axis_names={DATA_AXIS}, check_vma=False)
+        return jax.jit(smapped, donate_argnums=(0, 1))
 
     def _zero_grad_acc(self):
         zeros = jax.tree_util.tree_map(
@@ -417,6 +644,8 @@ class DeepSpeedEngine:
 
         gas==1 fast path: the whole step (fwd+bwd+optimizer+scaler) runs as
         one fused program here; step() then only does host bookkeeping."""
+        if self._infinity is not None:
+            return self._infinity_forward(batch)
         if "full" in self._step_fns:
             return self._fused_forward(batch, rng)
         if self._grad_acc is None:
@@ -444,6 +673,19 @@ class DeepSpeedEngine:
                 profile_step=self.global_steps,
                 top_modules=self._config.flops_profiler_config.top_modules,
                 detailed=self._config.flops_profiler_config.detailed)
+        self._cached = loss
+        self._last_loss = loss
+        return loss
+
+    def _infinity_forward(self, batch):
+        """Streamed whole-step (fwd+bwd+host update); step() bookkeeps."""
+        self._resolve_pending_overflow()  # settle the PREVIOUS step first
+        self.tput_timer.start()
+        loss, overflow = self._infinity.train_step(
+            batch, lr=self._current_lr(),
+            clip=float(self._config.gradient_clipping or 0.0))
+        self._pending_full = (self._scaler_state, bool(overflow),
+                              jnp.zeros((), jnp.float32))
         self._cached = loss
         self._last_loss = loss
         return loss
@@ -679,13 +921,20 @@ class DeepSpeedEngine:
     def train_batch(self, data_iter=None):
         """Convenience: run a full global batch (gas micro steps + update).
         Returns the mean loss (reference PipelineEngine.train_batch parity
-        at the engine level)."""
+        at the engine level).
+
+        With gas > 1 on the standard device path this compiles the WHOLE
+        global batch (scan over micro steps + optimizer) into one program
+        — a single host dispatch per global batch."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
             data_iter = self._train_iter if hasattr(self, "_train_iter") else \
                 iter(RepeatingLoader(self.training_dataloader))
             self._train_iter = data_iter
+        if "full_scan" in self._step_fns and self.micro_steps % \
+                self.gradient_accumulation_steps() == 0:
+            return self._scan_train_batch(data_iter)
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = next(data_iter)
@@ -694,8 +943,63 @@ class DeepSpeedEngine:
         self.step()
         return jnp.mean(jnp.stack(losses))
 
+    def _scan_train_batch(self, data_iter):
+        gas = self.gradient_accumulation_steps()
+        micro_batches = [next(data_iter) for _ in range(gas)]
+        try:
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(
+                    [jnp.asarray(l) for l in leaves]), *micro_batches)
+        except (ValueError, TypeError):
+            # heterogeneous micro batches can't stack: fall back
+            for batch in micro_batches:
+                self.forward(batch)
+                self.backward()
+            self.step()
+            return self._last_loss
+        self._resolve_pending_overflow()
+        self.tput_timer.start()
+        stacked = self._shard_batch_stacked(stacked)
+        rngs = jnp.stack([self._next_rng() for _ in range(gas)])
+        theta = jnp.asarray(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop else 1.0, jnp.float32)
+        cur_lr = self._current_lr()
+        lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        (self._params, self._opt_state, new_scaler, loss, overflow,
+         grad_norm) = self._step_fns["full_scan"](
+            self._params, self._opt_state, self._scaler_state, stacked,
+            rngs, lr, theta)
+        self.micro_steps += gas
+        self.global_samples += self.train_micro_batch_size_per_gpu() * \
+            self.dp_world_size * gas
+        self._pending_full = (new_scaler, overflow, grad_norm)
+        self._last_loss = loss
+        self._cached = None
+        self.step()  # host bookkeeping via _fused_step_bookkeeping
+        return loss
+
+    def _shard_batch_stacked(self, stacked):
+        """Place a [gas, B, ...] stacked batch: data axis on dim 1."""
+        mesh = self.mesh_info.mesh
+
+        def put(x):
+            x = jnp.asarray(x)
+            spec = [None] * x.ndim
+            if x.ndim > 1 and x.shape[1] % max(1, self.dp_world_size) == 0:
+                spec[1] = DATA_AXIS
+            target = NamedSharding(mesh, PartitionSpec(*spec))
+            if isinstance(x, jax.Array) and \
+                    x.sharding.is_equivalent_to(target, x.ndim):
+                return x
+            return jax.device_put(x, target)
+
+        return jax.tree_util.tree_map(put, stacked)
+
     def eval_batch(self, batch, rng=None):
         """Loss without gradient/bookkeeping side effects (jitted + cached)."""
+        if self._infinity is not None:
+            return self._infinity.eval_loss(batch)
         if not hasattr(self, "_eval_fn"):
             model = self.module
             dtype = self.compute_dtype
@@ -718,6 +1022,8 @@ class DeepSpeedEngine:
 
     @property
     def params(self):
+        if self._infinity is not None:
+            return self._infinity.masters_tree()  # host fp32 masters
         return self._params
 
     def train_batch_size(self):
@@ -793,7 +1099,9 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
-        if self._offload is not None:
+        if self._infinity is not None:
+            module_np = self._infinity.masters_tree()
+        elif self._offload is not None:
             # host fp32 masters are the source of truth under offload
             module_np = jax.tree_util.tree_unflatten(
                 self._offload.treedef,
@@ -812,11 +1120,20 @@ class DeepSpeedEngine:
             "rng_key": np.asarray(self._rng_key),
             **self._client_state(client_state),
         }
+        opt_to_save = self._opt_state
+        if getattr(self, "_onebit_hot", False) and opt_to_save is not None:
+            # per-rank error-feedback buffers ([dp, *param] fp32 x2) are
+            # re-zeroed on load anyway — don't write 2x dp x model-size of
+            # dead weight into every checkpoint
+            opt_to_save = {k: v for k, v in opt_to_save.items()
+                           if k not in ("worker_error", "server_error")}
         optim_state = {
             "optimizer_state": (
-                self._offload.state_dict() if self._offload is not None
-                else self._opt_state),
-            "offload": self._offload is not None,
+                self._infinity.state_dict() if self._infinity is not None
+                else self._offload.state_dict() if self._offload is not None
+                else opt_to_save),
+            "offload": (self._offload is not None
+                        or self._infinity is not None),
             # json round-trip: msgpack rejects tuples (betas); lists restore fine
             "optimizer_hparams": (json.loads(json.dumps(
                 self.optimizer.state_dict()))
@@ -849,6 +1166,30 @@ class DeepSpeedEngine:
             logger.warning(f"load_checkpoint: {e}")
             return None, {}
 
+        if self._infinity is not None:
+            self._infinity.load_masters_tree(model_state["module"])
+            if load_optimizer_states and optim_state is not None and \
+                    optim_state.get("offload"):
+                self._infinity.load_state_dict(optim_state["optimizer_state"])
+            if model_state.get("loss_scaler") is not None:
+                self._scaler_state = {
+                    k: jnp.asarray(v)
+                    for k, v in model_state["loss_scaler"].items()}
+            if load_lr_scheduler_states and self.lr_scheduler is not None \
+                    and model_state.get("lr_scheduler") is not None:
+                self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+            if model_state.get("rng_key") is not None:
+                self._rng_key = jnp.asarray(model_state["rng_key"])
+            self.global_steps = int(model_state.get("global_steps", 0))
+            self.global_samples = int(model_state.get("global_samples", 0))
+            self._skipped_steps = int(model_state.get("skipped_steps", 0))
+            self.micro_steps = int(model_state.get("micro_steps", 0))
+            self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)
+            client_state = {k: v for k, v in model_state.items()
+                            if k not in ("module", "lr_scheduler",
+                                         "loss_scaler")}
+            return ckpt_dir, client_state
+
         params = jax.tree_util.tree_map(jnp.asarray, model_state["module"])
         if self._offload is not None:
             self._offload.masters = [
@@ -863,10 +1204,26 @@ class DeepSpeedEngine:
             self._offload.load_state_dict(optim_state["optimizer_state"])
         elif load_optimizer_states and optim_state is not None and \
                 self._offload is None:
-            opt = jax.tree_util.tree_map(jnp.asarray,
-                                         optim_state["optimizer_state"])
-            self._opt_state = jax.device_put(
-                opt, self.zero_plan.opt_state_shardings(opt))
+            restored = optim_state["optimizer_state"]
+            if getattr(self, "_onebit_hot", False):
+                # per-rank error-feedback buffers are world-size-shaped;
+                # on any resume they restart at zero for the CURRENT dp
+                # (reference re-inits them on topology change too) — a
+                # transient, convergence-benign reset
+                restored = {k: v for k, v in restored.items()
+                            if k not in ("worker_error", "server_error")}
+                keep = {k: self._opt_state[k]
+                        for k in ("worker_error", "server_error")}
+                zeroed = jax.tree_util.tree_map(jnp.zeros_like, keep)
+                opt = jax.tree_util.tree_map(jnp.asarray, restored)
+                self._opt_state = {
+                    **jax.device_put(
+                        opt, self.zero_plan.opt_state_shardings(opt)),
+                    **zeroed}
+            else:
+                opt = jax.tree_util.tree_map(jnp.asarray, restored)
+                self._opt_state = jax.device_put(
+                    opt, self.zero_plan.opt_state_shardings(opt))
             hparams = optim_state.get("optimizer_hparams")
             if hparams is not None and hasattr(self.optimizer,
                                                "load_state_dict"):

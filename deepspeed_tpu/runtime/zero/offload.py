@@ -169,9 +169,22 @@ class CPUOffloadRuntime:
     # checkpoint parity ------------------------------------------------
     def state_dict(self):
         sd = self.adam.state_dict()
+        if self.nvme is not None:
+            # moments live on SSD between steps — page them back for
+            # serialization (step() pops each leaf into the NvmeStateStore)
+            sd["state"] = {
+                str(i): {k: v.copy()
+                         for k, v in self.nvme.load(i, m.size).items()}
+                for i, m in enumerate(self.masters)}
         sd["masters"] = [m.copy() for m in self.masters]
         return sd
 
     def load_state_dict(self, sd):
         self.adam.load_state_dict({k: sd[k] for k in ("step", "state")})
         self.masters = [np.asarray(m, np.float32) for m in sd["masters"]]
+        if self.nvme is not None:
+            # write through to the fresh (pid-scoped) store so step()'s
+            # nvme.load sees the restored moments, not zeros
+            for key, st in list(self.adam._state.items()):
+                self.nvme.store(int(key), st)
+            self.adam._state = {}

@@ -208,3 +208,26 @@ def test_unknown_optimizer_raises():
     cfg = base_config(optimizer={"type": "sgdmagic", "params": {}})
     with pytest.raises(ValueError):
         ds.initialize(model=SimpleModel(), config=cfg)
+
+
+def test_scan_fused_train_batch_matches_manual_accumulation():
+    """gas>1 train_batch (one-program lax.scan path) must produce the
+    same updates as gas micro-dispatches through forward/backward/step."""
+    cfg = base_config(train_batch_size=32, gradient_accumulation_steps=4)
+    scan_engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    manual_engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    assert "full_scan" in scan_engine._step_fns
+
+    for step in range(3):
+        batches = list(random_batches(4, batch_size=8, seed=step))
+        loss_scan = scan_engine.train_batch(iter(batches))
+        for b in batches:
+            manual_engine.forward(b)
+            manual_engine.backward()
+        manual_engine.step()
+        assert np.isfinite(float(loss_scan))
+    assert scan_engine.global_steps == manual_engine.global_steps == 3
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
+        scan_engine.params, manual_engine.params)
