@@ -4,6 +4,7 @@ from .sparsity_config import (BigBirdSparsityConfig, BSLongformerSparsityConfig,
                               VariableSparsityConfig)
 from .sparse_attention import (SparseSelfAttention, block_sparse_attention,
                                layout_to_gather)
+from .flash_sparse import flash_sparse_attention
 from .sparse_attention_utils import (BertSparseSelfAttention,
                                      SparseAttentionUtils)
 
@@ -11,5 +12,5 @@ __all__ = ["SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
            "VariableSparsityConfig", "BigBirdSparsityConfig",
            "BSLongformerSparsityConfig", "LocalSlidingWindowSparsityConfig",
            "SparseSelfAttention", "block_sparse_attention",
-           "layout_to_gather", "BertSparseSelfAttention",
+           "layout_to_gather", "flash_sparse_attention", "BertSparseSelfAttention",
            "SparseAttentionUtils"]

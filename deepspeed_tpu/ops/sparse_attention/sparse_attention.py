@@ -132,10 +132,11 @@ class SparseSelfAttention:
 
     def __init__(self, sparsity_config: SparsityConfig = None,
                  key_padding_mask_mode: str = "add",
-                 attn_mask_mode: str = "mul"):
+                 attn_mask_mode: str = "mul", impl: str = "auto"):
         self.sparsity_config = sparsity_config or SparsityConfig(num_heads=4)
         self.key_padding_mask_mode = key_padding_mask_mode
         self.attn_mask_mode = attn_mask_mode
+        self.impl = impl  # auto|pallas|xla
         self._layouts = {}
 
     def get_layout(self, seq_len: int) -> np.ndarray:
@@ -171,6 +172,23 @@ class SparseSelfAttention:
         if rpe is not None:
             rpe = jnp.asarray(rpe, jnp.float32)
             attn_bias = rpe if attn_bias is None else attn_bias + rpe
+
+        # Pallas flash-sparse kernel: streams only active layout blocks
+        # through VMEM (no [.., W, blk, blk] score tiles in HBM). The
+        # kernel carries no bias/dropout — those route to the XLA path.
+        plain = (key_padding_bias is None and attn_bias is None
+                 and dropout_rate == 0.0)
+        want_pallas = self.impl == "pallas" or (
+            self.impl == "auto" and plain
+            and jax.default_backend() == "tpu"
+            and self.sparsity_config.block % 128 == 0
+            and D in (64, 128, 256))
+        if want_pallas and plain:
+            from .flash_sparse import flash_sparse_attention
+
+            return flash_sparse_attention(
+                query, key, value, layout, self.sparsity_config.block,
+                causal=causal)
         return block_sparse_attention(
             query, key, value, layout, self.sparsity_config.block,
             causal_token_mask=causal, key_padding_bias=key_padding_bias,

@@ -224,6 +224,12 @@ def save_checkpoint_state(save_dir: str, tag: str, model_state: Dict[str, Any],
             payload["state"] = _to_host(optim_skeleton)
         jobs.append((optim_ckpt_name(ckpt_dir, rank, mp_rank), payload))
 
+    if async_save:
+        # snapshot host arrays NOW: offload/infinity masters mutate in
+        # place, and the background write must not see later steps
+        jobs = [(path, jax.tree_util.tree_map(
+            lambda x: x.copy() if isinstance(x, np.ndarray) else x, payload))
+            for path, payload in jobs]
     futures = [_writer.submit(_write, path, payload)
                for path, payload in jobs]
     if async_save:

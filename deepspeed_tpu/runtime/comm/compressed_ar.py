@@ -33,13 +33,22 @@ def reconstruct(mantissa, exponent, original_dtype=jnp.bfloat16):
                      exponent.astype(jnp.int32)).astype(original_dtype)
 
 
-def compressed_all_reduce(tensor, axis: Optional[str] = "data"):
-    """Sum `tensor`'s per-device dim-0 shards over the mesh axis with an
-    fp32 accumulator (what the reference's mantissa/exponent split BUYS —
-    bf16-safe summation — achieved directly: XLA collectives sum any
-    dtype, so no wire-format workaround is needed; decompose/reconstruct
-    above remain as the host-transport codec). Single-axis meshes degrade
-    to a local identity (sum of one shard)."""
+def compressed_all_reduce(tensor, axis: Optional[str] = "data",
+                          wire_parity: bool = False):
+    """Sum `tensor`'s per-device dim-0 shards over the mesh axis.
+
+    Default mode: fp32-accumulate psum — what the reference's
+    mantissa/exponent split BUYS (bf16-safe summation), achieved directly
+    because XLA collectives sum in any dtype; strictly more accurate than
+    the reference's wire format.
+
+    wire_parity=True: the reference's EXACT wire behaviour
+    (compressed_ar.py:33-38) — allreduce the fp16 mantissas and int8
+    exponents SEPARATELY, then ldexp-recombine. Note this is a lossy
+    approximation (frexp is not linear); it exists for behavioural parity
+    and A/B testing against the accurate mode.
+
+    Single-axis meshes degrade to a local identity (sum of one shard)."""
     original_dtype = tensor.dtype
     info = peek_mesh()
     if info is None or axis is None or axis not in info.mesh.shape or \
@@ -51,6 +60,12 @@ def compressed_all_reduce(tensor, axis: Optional[str] = "data"):
     @partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
              out_specs=P(axis), check_vma=False)
     def run(x):
+        if wire_parity:
+            m, e = decompose(x)
+            m_sum = jax.lax.psum(m.astype(jnp.float32), axis)
+            e_sum = jax.lax.psum(e.astype(jnp.int32), axis)
+            return reconstruct(m_sum.astype(jnp.float16), e_sum,
+                               original_dtype)
         total = jax.lax.psum(x.astype(jnp.float32), axis)
         return total.astype(original_dtype)
 

@@ -22,6 +22,12 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# NOTE: a persistent XLA compilation cache was tried here and reverted:
+# XLA:CPU AOT reload warns about mismatched machine features on this host
+# ("could lead to execution errors such as SIGILL") and produced small
+# cross-test numerical drift. Re-evaluate on a host where the AOT loader
+# accepts the feature set.
+
 import pytest  # noqa: E402
 
 

@@ -1,0 +1,74 @@
+"""Async-IO throughput sweep for the native aio engine.
+
+Mirrors the reference's perf harnesses
+(/root/reference/csrc/aio/py_test/run_read_sweep.sh, run_write_sweep.sh):
+sweep thread count x transfer size, print MB/s per cell for reads and
+writes. Drives csrc/aio/ds_aio.cpp through ops.aio.AsyncIOHandle — the
+same engine ZeRO-Infinity/Offload use for NVMe paging.
+
+Usage: python tools/aio_sweep.py [--dir /path/on/ssd] [--mb 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+
+def sweep(workdir: str, total_mb: int):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+    os.makedirs(workdir, exist_ok=True)
+    sizes_mb = [1, 4, 16, max(16, total_mb)]
+    threads = [1, 2, 4, 8]
+    print(f"{'op':>6} {'size':>7} " +
+          " ".join(f"t={t:<2}" .rjust(9) for t in threads))
+    for size_mb in sizes_mb:
+        n = size_mb * 1024 * 1024 // 4
+        buf = np.random.RandomState(0).rand(n).astype(np.float32)
+        path = os.path.join(workdir, f"aio_sweep_{size_mb}mb.bin")
+        reps = max(1, total_mb // size_mb)
+
+        row_w, row_r = [], []
+        for t in threads:
+            h = AsyncIOHandle(n_threads=t)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                h.async_pwrite(buf, path)
+                h.wait()
+            dt = time.perf_counter() - t0
+            row_w.append(reps * size_mb / dt)
+
+            out = np.empty_like(buf)
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                h.async_pread(out, path)
+                h.wait()
+            dt = time.perf_counter() - t0
+            row_r.append(reps * size_mb / dt)
+            assert np.array_equal(out, buf), "aio read corruption"
+        print(f"{'write':>6} {size_mb:>5}MB " +
+              " ".join(f"{v:8.0f}M" for v in row_w))
+        print(f"{'read':>6} {size_mb:>5}MB " +
+              " ".join(f"{v:8.0f}M" for v in row_r))
+        os.remove(path)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default="/tmp/dstpu_aio_sweep")
+    ap.add_argument("--mb", type=int, default=64,
+                    help="total MB moved per cell")
+    args = ap.parse_args()
+    sweep(args.dir, args.mb)
+
+
+if __name__ == "__main__":
+    main()
