@@ -74,6 +74,8 @@ class BertConfig:
 # bert-large @ seq 128/512 is the reference's headline benchmark config
 # (docs/_tutorials/bert-pretraining.md:387)
 BERT_SIZES = {
+    "bert-tiny": dict(num_layers=2, num_heads=2, d_model=64,
+                      vocab_size=512, max_seq_len=128),
     "bert-base": dict(num_layers=12, num_heads=12, d_model=768),
     "bert-large": dict(num_layers=24, num_heads=16, d_model=1024),
 }

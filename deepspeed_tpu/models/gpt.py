@@ -55,7 +55,8 @@ class GPTConfig:
     param_dtype: Any = jnp.float32
     pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
     pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
-    sequence_parallel: bool = False  # ring attention over the `seq` axis
+    sequence_parallel: bool = False  # SP attention over the `seq` axis
+    sequence_parallel_impl: str = "ring"  # ring | ulysses (all-to-all)
     # Mixture-of-Experts (beyond-parity; reference has no MoE, SURVEY §2.2)
     num_experts: int = 1             # >1: MoE FFN every moe_layer_freq layers
     moe_top_k: int = 1
@@ -215,7 +216,22 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         p["attn"]["qkv"]["b"].astype(h.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
     split_heads = lambda t: t.reshape(B, S, H, D // H)
-    if cfg.sequence_parallel:
+    if cfg.sequence_parallel and cfg.sequence_parallel_impl == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        # every device holds the full sequence for its heads, so
+        # probability dropout works exactly as on the dense path
+        attn = ulysses_attention(
+            split_heads(q), split_heads(kk), split_heads(v),
+            multihead_attention, causal=True, impl=cfg.attn_impl,
+            dropout_rate=cfg.dropout, dropout_rng=r1, train=train,
+            block_q=cfg.flash_block_q or None,
+            block_k=cfg.flash_block_k or None)
+    elif cfg.sequence_parallel:
+        if cfg.sequence_parallel_impl != "ring":
+            raise ValueError(
+                f"unknown sequence_parallel_impl "
+                f"{cfg.sequence_parallel_impl!r}; use 'ring' or 'ulysses'")
         from ..parallel.ring_attention import ring_attention
 
         attn = ring_attention(split_heads(q), split_heads(kk),

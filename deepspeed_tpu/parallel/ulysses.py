@@ -1,0 +1,58 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+Complement to ring attention (parallel/ring_attention.py) for long
+sequences: instead of rotating K/V blocks around the ring, the activation
+sharding is MOVED from the sequence dim to the head dim for the attention
+op and back afterwards. Under GSPMD this is two sharding constraints —
+XLA inserts the all_to_all pair over the `seq` mesh axis (the DeepSpeed-
+Ulysses wire pattern, arXiv:2309.14509, built on XLA collectives instead
+of explicit NCCL all_to_all).
+
+Within the attention op every device holds the FULL sequence for H/P of
+the heads, so the existing dense/flash kernels run unchanged — causal
+masking, unlike the ring formulation, needs no cross-block bookkeeping.
+Requires num_heads divisible by the seq-axis size; the projections before
+and after stay sequence-sharded, so MLP/LayerNorm memory remains O(S/P).
+
+Note on kernels: GSPMD partitions XLA ops across the head dim freely; a
+Pallas custom call is partitioned only when its operands' shardings map
+whole blocks per device (heads here), which holds for the flash kernel's
+[B*H, S, D] layout. If a mesh/layout combination ever fails to
+partition, set attn_impl="xla" for the SP blocks — the einsum path
+partitions unconditionally.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..comm.mesh import DATA_AXIS, SEQ_AXIS
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # no mesh in scope (single-device tests)
+
+
+def ulysses_attention(q, k, v, attention_fn, causal: bool = True,
+                      seq_axis: str = SEQ_AXIS, **attn_kwargs):
+    """All-to-all sequence-parallel attention over [B, S, H, D] inputs.
+
+    attention_fn(q, k, v, causal=..., **kwargs) -> [B, S, H, D] — any
+    dense attention (ops.transformer.attention.multihead_attention).
+    Inputs arrive sequence-sharded; outputs return sequence-sharded.
+    """
+    head_spec = P(DATA_AXIS, None, seq_axis, None)
+    seq_spec = P(DATA_AXIS, seq_axis, None, None)
+    # seq-shard -> head-shard: XLA lowers the resharding to an all_to_all
+    q = _constrain(q, head_spec)
+    k = _constrain(k, head_spec)
+    v = _constrain(v, head_spec)
+    out = attention_fn(q, k, v, causal=causal, **attn_kwargs)
+    # head-shard -> seq-shard for the rest of the block
+    return _constrain(out, seq_spec)

@@ -65,6 +65,7 @@ class CompressedBackend:
     def __init__(self, axis: str = "data", mpu=None):
         self.axis = axis
         self._errors = {}
+        self._fns = {}  # per-mesh compiled reduction (avoid re-tracing)
 
     def _get_errors(self, name, shaped_like):
         if name not in self._errors:
@@ -87,13 +88,18 @@ class CompressedBackend:
         mesh = info.mesh
         we, se = self._get_errors(name, tensor)
 
-        @partial(jax.shard_map, mesh=mesh,
-                 in_specs=(P(self.axis), P(self.axis), P(self.axis)),
-                 out_specs=(P(self.axis), P(self.axis), P(self.axis)),
-                 check_vma=False)
-        def run(x, we, se):
-            return compressed_allreduce(x, we, se, self.axis)
+        if mesh not in self._fns:
+            @partial(jax.shard_map, mesh=mesh,
+                     in_specs=(P(self.axis), P(self.axis), P(self.axis)),
+                     out_specs=(P(self.axis), P(self.axis), P(self.axis)),
+                     check_vma=False)
+            def run(x, we, se):
+                return compressed_allreduce(x, we, se, self.axis)
 
-        out, we, se = run(tensor, we, se)
+            # jit gives shape/dtype-keyed caching: repeated reductions of
+            # the same tensor compile once, not once per call
+            self._fns[mesh] = jax.jit(run)
+
+        out, we, se = self._fns[mesh](tensor, we, se)
         self._errors[name] = (we, se)
         return out

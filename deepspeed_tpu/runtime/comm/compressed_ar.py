@@ -12,7 +12,7 @@ numerically faithful (frexp/ldexp roundtrip is exact for bf16 inputs).
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional
 
 import jax
@@ -55,7 +55,13 @@ def compressed_all_reduce(tensor, axis: Optional[str] = "data",
             info.mesh.shape[axis] == 1:
         return tensor
 
-    mesh = info.mesh
+    return _compiled_ar(info.mesh, axis, wire_parity,
+                        str(original_dtype))(tensor)
+
+
+@lru_cache(maxsize=64)
+def _compiled_ar(mesh, axis, wire_parity, dtype_name):
+    dtype = jnp.dtype(dtype_name)
 
     @partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
              out_specs=P(axis), check_vma=False)
@@ -64,9 +70,8 @@ def compressed_all_reduce(tensor, axis: Optional[str] = "data",
             m, e = decompose(x)
             m_sum = jax.lax.psum(m.astype(jnp.float32), axis)
             e_sum = jax.lax.psum(e.astype(jnp.int32), axis)
-            return reconstruct(m_sum.astype(jnp.float16), e_sum,
-                               original_dtype)
+            return reconstruct(m_sum.astype(jnp.float16), e_sum, dtype)
         total = jax.lax.psum(x.astype(jnp.float32), axis)
-        return total.astype(original_dtype)
+        return total.astype(dtype)
 
-    return run(tensor)
+    return jax.jit(run)

@@ -1,0 +1,72 @@
+"""Ulysses all-to-all sequence parallelism: resharded attention matches
+the dense computation, end-to-end through the GPT engine."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.models import GPT, gpt2_config
+from deepspeed_tpu.ops.transformer.attention import multihead_attention
+from deepspeed_tpu.parallel.ulysses import ulysses_attention
+
+
+def test_ulysses_matches_dense_attention():
+    info = comm.make_mesh(data=2, seq=4)
+    B, S, H, D = 2, 32, 4, 8
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D)) for kk in ks)
+
+    want = multihead_attention(q, k, v, causal=True, impl="xla")
+
+    with info.mesh:
+        qs = jax.device_put(q, NamedSharding(info.mesh,
+                                             P("data", "seq", None, None)))
+        ks_ = jax.device_put(k, qs.sharding)
+        vs = jax.device_put(v, qs.sharding)
+
+        @jax.jit
+        def run(q, k, v):
+            return ulysses_attention(q, k, v, multihead_attention,
+                                     causal=True, impl="xla")
+
+        got = run(qs, ks_, vs)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ulysses_gpt_trains_and_matches_ring():
+    """GPT with ulysses SP trains on a dp x seq mesh; eval loss agrees
+    with the (already parity-tested) ring implementation."""
+    def build(impl):
+        cfg = gpt2_config("nano", max_seq_len=64, vocab_size=128,
+                          num_heads=4, sequence_parallel=True,
+                          sequence_parallel_impl=impl,
+                          shard_activations=True)
+        return deepspeed_tpu.initialize(model=GPT(cfg), config_params={
+            "train_batch_size": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "mesh": {"data": 2, "seq": 4},
+            "steps_per_print": 0,
+        })[0]
+
+    tok = jax.random.randint(jax.random.PRNGKey(0), (4, 65), 0, 128)
+    batch = (np.asarray(tok[:, :-1]), np.asarray(tok[:, 1:]))
+
+    uly = build("ulysses")
+    l_u = float(uly.eval_batch(batch))
+    ring = build("ring")
+    l_r = float(ring.eval_batch(batch))
+    np.testing.assert_allclose(l_u, l_r, rtol=1e-4)
+
+    losses = []
+    for i in range(6):
+        loss = uly.forward(batch)
+        uly.backward()
+        uly.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
