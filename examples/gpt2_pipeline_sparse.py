@@ -1,0 +1,144 @@
+"""GPT-2 medium-style pipeline parallelism + sparse attention — mirrors
+BASELINE.json config 5 (deepspeed.pipe + sparse_attention kernel). Two
+pipeline executors are exercised:
+
+* --executor spmd: stacked blocks compiled as a GPipe scan over the
+  `pipe` mesh axis (one jitted program);
+* --executor 1f1b: the TrainSchedule instruction-stream PipelineEngine
+  over heterogeneous LayerSpec stages (tied embeddings, per-stage device
+  groups).
+
+Sparse attention (Fixed layout) runs inside the SPMD variant's blocks.
+
+    python examples/gpt2_pipeline_sparse.py --executor spmd
+    python examples/gpt2_pipeline_sparse.py --executor 1f1b
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from common import print_curve, token_batches  # noqa: E402
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT, gpt2_config
+
+
+def run_spmd(args, n_dev):
+    cfg = gpt2_config("nano", num_layers=4, max_seq_len=args.seq,
+                      pipeline_stages=2, pipeline_micro_batches=2,
+                      shard_activations=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT(cfg),
+        config_params={
+            "train_batch_size": args.micro * (n_dev // 2),
+            "train_micro_batch_size_per_gpu": args.micro,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            "mesh": {"data": n_dev // 2, "pipe": 2},
+            "steps_per_print": 10,
+        })
+    losses = []
+    for batch in token_batches(args.steps, args.micro * (n_dev // 2),
+                               args.seq, cfg.vocab_size):
+        loss = engine.forward(batch)
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    return "pipeline spmd-gpipe", losses
+
+
+def run_1f1b(args, n_dev):
+    from deepspeed_tpu.ops.sparse_attention import (FixedSparsityConfig,
+                                                    SparseSelfAttention)
+    from deepspeed_tpu.runtime.pipe.module import (LayerSpec,
+                                                   PipelineModule,
+                                                   TiedLayerSpec)
+
+    V, Dm, Hh = 128, 32, 2
+    # unidirectional: this is a next-token LM — bidirectional layouts
+    # would let position t attend to its own label at t+1
+    ssa = SparseSelfAttention(FixedSparsityConfig(
+        num_heads=Hh, block=16, num_local_blocks=2, num_global_blocks=1,
+        attention="unidirectional"))
+
+    class Embed:
+        def init(self, rng):
+            return {"w": jax.random.normal(rng, (V, Dm)) * 0.05}
+
+        def apply(self, p, x, rng=None, train=True):
+            return p["w"][x]
+
+    class SparseBlock:
+        """Attention block whose scores follow the sparse layout."""
+
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {"qkv": jax.random.normal(k1, (Dm, 3 * Dm)) * 0.05,
+                    "proj": jax.random.normal(k2, (Dm, Dm)) * 0.05}
+
+        def apply(self, p, x, rng=None, train=True):
+            B, S, _ = x.shape
+            qkv = x @ p["qkv"]
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            split = lambda t: t.reshape(B, S, Hh, Dm // Hh)
+            a = ssa(split(q), split(k), split(v)).reshape(B, S, Dm)
+            return x + a @ p["proj"]
+
+    def head(layer, p, x):
+        return x @ p["w"].T
+
+    def ce(logits, labels):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        return -jnp.mean(jnp.take_along_axis(lp, labels[..., None], -1))
+
+    mod = PipelineModule(
+        [TiedLayerSpec("emb", Embed)]
+        + [LayerSpec(SparseBlock) for _ in range(3)]
+        + [TiedLayerSpec("emb", Embed, forward_fn=head)],
+        num_stages=2, loss_fn=ce)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mod,
+        config_params={
+            "train_batch_size": args.micro * 4,
+            "train_micro_batch_size_per_gpu": args.micro,
+            "gradient_accumulation_steps": 4,
+            "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+            "mesh": {"data": 1, "pipe": -1},
+            "steps_per_print": 10,
+        })
+    assert engine._staged
+    losses = []
+    for step in range(args.steps):
+        data = list(token_batches(4, args.micro, args.seq, V,
+                                  seed=step))
+        losses.append(float(engine.train_batch(iter(data))))
+    return "pipeline 1f1b + sparse-attn", losses
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--executor", choices=["spmd", "1f1b"], default="1f1b")
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--micro", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=25)
+    args = ap.parse_args()
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        raise SystemExit(
+            f"this example needs >= 4 devices (got {n_dev}); run with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            f"JAX_PLATFORMS=cpu for a virtual mesh")
+    name, losses = (run_spmd if args.executor == "spmd" else run_1f1b)(
+        args, n_dev)
+    print_curve(name, losses)
+    assert losses[-1] < losses[0]
+
+
+if __name__ == "__main__":
+    main()

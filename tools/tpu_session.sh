@@ -1,0 +1,10 @@
+#!/bin/bash
+# One-shot TPU measurement session — run when the axon tunnel is back.
+# Produces: /tmp/tpu_bench.json, /tmp/tpu_sweep_{ce,flash,batch}.txt
+set -x
+cd "$(dirname "$0")/.."
+timeout 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
+timeout 2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
+timeout 2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
+timeout 3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
+echo done
