@@ -1,0 +1,119 @@
+"""HuggingFace interop: load transformer checkpoints into the in-tree
+model families.
+
+The reference integrates with HF via module_inject (kernel injection into
+an existing torch module, deepspeed/module_inject/replace_module.py); the
+TPU-native equivalent converts the WEIGHTS into the pure-pytree GPT
+family, after which every engine feature (ZeRO, pipeline, offload,
+Infinity streaming) applies unchanged. GPT-2's layout maps 1:1: HF Conv1D
+stores [in, out] weights, which is exactly this GPT's `x @ w` convention.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .gpt import GPT, GPTConfig
+
+
+def gpt2_config_from_hf(hf_config, **overrides) -> GPTConfig:
+    """Map a transformers GPT2Config onto GPTConfig.
+
+    Raises on HF options this architecture cannot represent (silently
+    wrong logits are worse than a refusal)."""
+    act = getattr(hf_config, "activation_function", "gelu_new")
+    if act != "gelu_new":
+        raise ValueError(
+            f"activation_function={act!r} unsupported: gpt_block computes "
+            f"gelu_new (tanh-approximate gelu) only")
+    for flag in ("scale_attn_by_inverse_layer_idx",
+                 "reorder_and_upcast_attn"):
+        if getattr(hf_config, flag, False):
+            raise ValueError(f"GPT2Config.{flag} has no equivalent here")
+    attn_p = getattr(hf_config, "attn_pdrop", 0.0) or 0.0
+    resid_p = getattr(hf_config, "resid_pdrop", 0.0) or 0.0
+    if attn_p != resid_p:
+        from ..utils.logging import logger
+
+        logger.warning(
+            f"GPT2Config attn_pdrop={attn_p} != resid_pdrop={resid_p}: "
+            f"GPTConfig has one dropout knob (applied to attention probs "
+            f"and residual paths); using resid_pdrop={resid_p}")
+    base = dict(
+        vocab_size=hf_config.vocab_size,
+        max_seq_len=hf_config.n_positions,
+        num_layers=hf_config.n_layer,
+        num_heads=hf_config.n_head,
+        d_model=hf_config.n_embd,
+        d_ff=getattr(hf_config, "n_inner", None) or 4 * hf_config.n_embd,
+        layer_norm_eps=hf_config.layer_norm_epsilon,
+        dropout=resid_p,
+        embed_dropout=getattr(hf_config, "embd_pdrop", 0.0) or 0.0,
+        tie_embeddings=getattr(hf_config, "tie_word_embeddings", True),
+    )
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+def load_hf_gpt2(hf_model, **config_overrides):
+    """(GPT, params) from a transformers GPT2LMHeadModel.
+
+    Usage:
+        from transformers import GPT2LMHeadModel
+        hf = GPT2LMHeadModel.from_pretrained("gpt2")   # or local files
+        model, params = load_hf_gpt2(hf)
+        engine, *_ = deepspeed_tpu.initialize(model=model,
+                                              model_parameters=params, ...)
+    """
+    import torch
+
+    # float() first: torch .numpy() rejects bfloat16, and the values are
+    # re-cast to cfg.param_dtype below anyway
+    sd = {k: np.asarray(v.detach().to(torch.float32).cpu().numpy())
+          for k, v in hf_model.state_dict().items()}
+    cfg = gpt2_config_from_hf(hf_model.config, **config_overrides)
+    model = GPT(cfg)
+    params = hf_gpt2_state_dict_to_params(sd, cfg)
+    return model, params
+
+
+def hf_gpt2_state_dict_to_params(sd: Dict[str, Any],
+                                 cfg: GPTConfig):
+    """Torch GPT-2 state_dict (numpy values) -> GPT params pytree."""
+    g = lambda k: jnp.asarray(sd[k], cfg.param_dtype)
+
+    def block(i):
+        p = f"transformer.h.{i}."
+        return {
+            "ln1": {"scale": g(p + "ln_1.weight"),
+                    "bias": g(p + "ln_1.bias")},
+            "attn": {
+                "qkv": {"w": g(p + "attn.c_attn.weight"),
+                        "b": g(p + "attn.c_attn.bias")},
+                "proj": {"w": g(p + "attn.c_proj.weight"),
+                         "b": g(p + "attn.c_proj.bias")},
+            },
+            "ln2": {"scale": g(p + "ln_2.weight"),
+                    "bias": g(p + "ln_2.bias")},
+            "mlp": {
+                "fc1": {"w": g(p + "mlp.c_fc.weight"),
+                        "b": g(p + "mlp.c_fc.bias")},
+                "fc2": {"w": g(p + "mlp.c_proj.weight"),
+                        "b": g(p + "mlp.c_proj.bias")},
+            },
+        }
+
+    params = {
+        "wte": g("transformer.wte.weight"),
+        "wpe": g("transformer.wpe.weight"),
+        "blocks": [block(i) for i in range(cfg.num_layers)],
+        "ln_f": {"scale": g("transformer.ln_f.weight"),
+                 "bias": g("transformer.ln_f.bias")},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = g("lm_head.weight").T
+    return params

@@ -1,0 +1,100 @@
+"""HF GPT-2 weight import: logits must match the transformers (torch)
+implementation — an independent cross-framework parity oracle for the
+whole GPT forward (embeddings, attention, gelu variant, layernorm eps,
+tied head)."""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.models.hf import gpt2_config_from_hf, load_hf_gpt2
+
+
+def _hf_model():
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=2, n_head=2,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0)
+    torch.manual_seed(0)
+    return transformers.GPT2LMHeadModel(cfg).eval()
+
+
+def test_hf_gpt2_logits_parity():
+    hf = _hf_model()
+    model, params = load_hf_gpt2(hf)
+    rng = np.random.RandomState(0)
+    tokens = rng.randint(0, 96, (2, 17)).astype(np.int32)
+
+    with torch.no_grad():
+        want = hf(torch.tensor(tokens, dtype=torch.long)).logits.numpy()
+    got = np.asarray(model.apply(params, jnp.asarray(tokens)),
+                     np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_hf_gpt2_loss_parity():
+    hf = _hf_model()
+    model, params = load_hf_gpt2(hf)
+    rng = np.random.RandomState(1)
+    tokens = rng.randint(0, 96, (2, 17)).astype(np.int32)
+    inp, labels = tokens[:, :-1], tokens[:, 1:]
+
+    with torch.no_grad():
+        t_in = torch.tensor(tokens, dtype=torch.long)
+        want = hf(t_in, labels=t_in).loss.item()
+    got = float(model.loss(params, (inp, labels), train=False))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_hf_weights_train_through_engine():
+    import deepspeed_tpu
+
+    hf = _hf_model()
+    model, params = load_hf_gpt2(hf)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config_params={
+            "train_batch_size": 8,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": 8},
+            "steps_per_print": 0,
+        })
+    rng = np.random.RandomState(2)
+    tok = rng.randint(0, 96, (8, 17)).astype(np.int32)
+    losses = []
+    for _ in range(6):
+        loss = engine.forward((tok[:, :-1], tok[:, 1:]))
+        engine.backward()
+        engine.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_hf_untied_and_unsupported_configs():
+    # untied embeddings: trained lm_head must be used, not wte.T
+    cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=64, n_embd=32, n_layer=1, n_head=2,
+        attn_pdrop=0.0, embd_pdrop=0.0, resid_pdrop=0.0,
+        tie_word_embeddings=False)
+    torch.manual_seed(1)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    model, params = load_hf_gpt2(hf)
+    assert not model.config.tie_embeddings and "lm_head" in params
+    toks = np.random.RandomState(5).randint(0, 96, (1, 9)).astype(np.int32)
+    with torch.no_grad():
+        want = hf(torch.tensor(toks, dtype=torch.long)).logits.numpy()
+    import jax.numpy as jnp
+    got = np.asarray(model.apply(params, jnp.asarray(toks)), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+    # unrepresentable options must refuse, not silently mis-load
+    bad = transformers.GPT2Config(activation_function="gelu")
+    with pytest.raises(ValueError, match="activation_function"):
+        gpt2_config_from_hf(bad)
+    bad2 = transformers.GPT2Config(scale_attn_by_inverse_layer_idx=True)
+    with pytest.raises(ValueError, match="scale_attn"):
+        gpt2_config_from_hf(bad2)
