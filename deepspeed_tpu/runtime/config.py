@@ -49,9 +49,17 @@ def get_fp16_enabled(param_dict):
 
 
 def get_precision(param_dict):
-    """Return the compute dtype name. The EleutherAI fork extends the fp16
-    section with "type": "bfloat16" (reference runtime/constants.py:127-161,
-    engine.py:613-620)."""
+    """Return the compute dtype name. Two spellings are accepted: the
+    EleutherAI fork's fp16 section with "type": "bfloat16" (reference
+    runtime/constants.py:127-161, engine.py:613-620), and the top-level
+    `{"bf16": {"enabled": true}}` section of later DeepSpeed versions —
+    the latter was previously IGNORED (silently training in fp32)."""
+    bf16 = param_dict.get("bf16", param_dict.get("bfloat16", {})) or {}
+    if get_scalar_param(bf16, c.FP16_ENABLED, False):
+        if get_fp16_enabled(param_dict):
+            raise DeepSpeedConfigError(
+                "bf16 and fp16 cannot both be enabled")
+        return "bfloat16"
     if not get_fp16_enabled(param_dict):
         return "float32"
     raw = get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_TYPE,

@@ -1077,6 +1077,43 @@ class DeepSpeedEngine:
             dataset, batch_size=global_micro, shuffle=True,
             collate_fn=collate_fn or self.collate_fn)
 
+    def save_fp16_model(self, save_dir, save_filename="mp_rank_00_model_states.msgpack"):
+        """Weights-only export in the compute dtype (reference
+        engine.py:1882 save_fp16_model): no optimizer/scheduler state,
+        loadable as a plain pytree."""
+        from flax import serialization
+
+        tree = self.module_state_dict_fp16()
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        if jax.process_index() == 0:
+            with open(path, "wb") as f:
+                f.write(serialization.msgpack_serialize(tree))
+        log_dist(f"saved {self.precision()} model weights to {path}",
+                 ranks=[0])
+        return path
+
+    def module_state_dict_fp16(self):
+        """Consolidated compute-dtype weights (reference
+        _zero3_consolidated_fp16_state_dict, engine.py:1820-1881): for
+        ZeRO-3 the per-leaf host fetch performs the all-gather the
+        reference hand-rolls with partition hooks; non-addressable
+        (multi-host) shards gather via process_allgather first."""
+        params = self.params  # infinity: host masters; else device tree
+        dtype = self.compute_dtype
+
+        def to_host(p):
+            if isinstance(p, jax.Array) and not p.is_fully_addressable:
+                from jax.experimental import multihost_utils
+
+                p = multihost_utils.process_allgather(p, tiled=True)
+            floating = jnp.issubdtype(
+                getattr(p, "dtype", np.float32), jnp.floating)
+            arr = np.asarray(p)
+            return arr.astype(dtype) if floating else arr
+
+        return jax.tree_util.tree_map(to_host, params)
+
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:1491-1890)
     # ------------------------------------------------------------------

@@ -532,6 +532,48 @@ class PipelineEngine(DeepSpeedEngine):
                 rt.ro_tied[key] = jax.device_put(
                     owner.own["tied"][key], rt.replicated)
 
+    @property
+    def params(self):
+        """Full {'layers': ..., 'tied': ...} pytree reassembled from the
+        per-stage placements (the base property would return the nulled
+        whole-tree placement in staged mode — exports/params access must
+        see the live stage weights)."""
+        if not self._staged:
+            return DeepSpeedEngine.params.fget(self)
+        module: PipelineModule = self.module
+        layers = [None] * module.num_layers()
+        tied = {}
+        for s, rt in enumerate(self.stages):
+            lo = module.parts[s]
+            for j, lp in enumerate(rt.own["layers"]):
+                layers[lo + j] = lp
+            tied.update(rt.own["tied"])
+        return {"layers": layers, "tied": tied}
+
+    def memory_status(self, tag: str = ""):
+        """Per-stage device-memory report (reference pipe/engine.py:
+        1195-1243 memory_status): bytes in use / peak per stage's device
+        group, plus live pipeline-buffer counts."""
+        if not self._staged:
+            from ...utils.timer import SynchronizedWallClockTimer
+
+            log_dist(f"MEMSTATS {tag} "
+                     f"{SynchronizedWallClockTimer.memory_usage()}",
+                     ranks=[0])
+            return
+        for rt in self.stages:
+            used = peak = 0
+            for d in rt.devices:
+                stats = (d.memory_stats() or {}) \
+                    if hasattr(d, "memory_stats") else {}
+                used += stats.get("bytes_in_use", 0) or 0
+                peak += stats.get("peak_bytes_in_use", 0) or 0
+            log_dist(
+                f"MEMSTATS {tag} stage {rt.stage_id}: "
+                f"in_use {used / 2**30:.2f} GB | peak {peak / 2**30:.2f} GB"
+                f" | buffers: x_in={len(rt.x_in)} y_out={len(rt.y_out)} "
+                f"dx_out={len(rt.dx_out)}", ranks=[0])
+
     # ------------------------------------------------------------------
     # eval / inference
     # ------------------------------------------------------------------

@@ -143,3 +143,30 @@ def test_mesh_section():
                          "mesh": {"data": 2, "model": 4}})
     assert c.mesh_shape == {"data": 2, "model": 4}
     assert c.world_size == 2  # from explicit data axis
+
+
+def test_top_level_bf16_section_enables_bfloat16():
+    """`{"bf16": {"enabled": true}}` (later-DeepSpeed spelling) must select
+    bfloat16 compute — it was previously ignored, silently training fp32."""
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+    cfg = DeepSpeedConfig({"train_batch_size": 8,
+                           "bf16": {"enabled": True}}, world_size=1)
+    assert cfg.precision == "bfloat16"
+    cfg2 = DeepSpeedConfig({"train_batch_size": 8,
+                            "bf16": {"enabled": False}}, world_size=1)
+    assert cfg2.precision == "float32"
+    cfg3 = DeepSpeedConfig({"train_batch_size": 8,
+                            "fp16": {"enabled": True,
+                                     "type": "bfloat16"}}, world_size=1)
+    assert cfg3.precision == "bfloat16"
+
+
+def test_bf16_and_fp16_both_enabled_raises():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+
+    with pytest.raises(DeepSpeedConfigError, match="both"):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "bf16": {"enabled": True},
+                         "fp16": {"enabled": True}}, world_size=1)

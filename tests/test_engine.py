@@ -231,3 +231,21 @@ def test_scan_fused_train_batch_matches_manual_accumulation():
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-7),
         scan_engine.params, manual_engine.params)
+
+
+def test_save_fp16_model_and_consolidated_state(tmp_path):
+    cfg = base_config(bf16={"enabled": True},
+                      zero_optimization={"stage": 3}, mesh={"data": 8})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    train(engine, steps=2)
+    sd = engine.module_state_dict_fp16()
+    leaf = jax.tree_util.tree_leaves(sd)[0]
+    assert str(leaf.dtype) == "bfloat16"  # consolidated, compute dtype
+    path = engine.save_fp16_model(str(tmp_path))
+    from flax import serialization
+    with open(path, "rb") as f:
+        restored = serialization.msgpack_restore(f.read())
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a, np.float32), np.asarray(b, np.float32)),
+        sd, restored)
