@@ -64,6 +64,21 @@ def spmd_pipeline(block_fn: Callable, stacked_params, x,
         out, _ = jax.lax.scan(body, x, stacked_params)
         return out
 
+    # XLA:CPU workaround: the AllReducePromotion pass aborts ("Invalid
+    # binary instruction opcode copy") on a bf16 collective this shard_map
+    # pipeline's autodiff produces. On the CPU backend (virtual-mesh tests
+    # and the driver dryrun) run the pipeline region in fp32; TPU keeps
+    # bf16 end to end.
+    orig_dtype = x.dtype
+    if jax.default_backend() == "cpu" and orig_dtype == jnp.bfloat16:
+        up = lambda t: jax.tree_util.tree_map(
+            lambda a: a.astype(jnp.float32)
+            if a.dtype == jnp.bfloat16 else a, t)
+        out = spmd_pipeline(block_fn, up(stacked_params),
+                            x.astype(jnp.float32), mesh_info,
+                            num_micro=num_micro, remat=remat)
+        return out.astype(orig_dtype)
+
     M = num_micro or P
     B = x.shape[0]
     assert B % M == 0, f"batch {B} not divisible by micro count {M}"
@@ -109,10 +124,16 @@ def spmd_pipeline(block_fn: Callable, stacked_params, x,
         out0 = jax.lax.pcast(jnp.zeros_like(chunks), (PIPE_AXIS,), to='varying')
         (_, out_buf), _ = jax.lax.scan(
             tick, (held0, out0), jnp.arange(M + P - 1))
-        # broadcast last stage's outputs to all stages (sum of one nonzero)
-        return jax.lax.psum(
-            jnp.where(stage == P - 1, out_buf, jnp.zeros_like(out_buf)),
+        # broadcast last stage's outputs to all stages (sum of one nonzero).
+        # fp32 for the wire: XLA:CPU's AllReducePromotion pass crashes
+        # ("Invalid binary instruction opcode copy") cloning a bf16
+        # all-reduce here; promoting explicitly sidesteps it and costs
+        # nothing on TPU (the collective would promote anyway)
+        summed = jax.lax.psum(
+            jnp.where(stage == P - 1, out_buf,
+                      jnp.zeros_like(out_buf)).astype(jnp.float32),
             PIPE_AXIS)
+        return summed.astype(out_buf.dtype)
 
     from jax.sharding import PartitionSpec as PSpec
 
