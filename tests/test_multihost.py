@@ -1,0 +1,82 @@
+"""Real multi-process (2 "hosts" x 4 CPU devices) integration: DP
+training agrees across processes, and the sharded checkpoint writer's
+one-writer-per-piece rule holds with genuinely non-addressable shards
+(the reference's per-rank writer behaviour, engine.py:1462-1489)."""
+
+import glob
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_training_and_sharded_checkpoint(tmp_path):
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__), "multihost_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(nprocs), coord,
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(nprocs)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=600)
+        outs.append(out)
+        assert p.returncode == 0, out[-3000:]
+
+    # all processes computed the same loss and the same updated params
+    lines = [ln for out in outs for ln in out.splitlines()
+             if ln.startswith("MHOK")]
+    assert len(lines) == nprocs, outs
+    losses = {ln.split("loss=")[1].split()[0] for ln in lines}
+    psums = {ln.split("params0=")[1].split()[0] for ln in lines}
+    assert len(losses) == 1 and len(psums) == 1, lines
+
+    # the dp=8 optimizer shards produced 8 piece files, written across
+    # BOTH processes with no filename collisions (owner-device naming)
+    rank_files = glob.glob(str(tmp_path / "mh" / "zero_pp_rank_*"))
+    assert len(rank_files) == 8, rank_files
+
+    # a single-process world can load the multi-host checkpoint
+    code = f"""
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import sys
+sys.path.insert(0, {os.path.dirname(__file__)!r})
+sys.path.insert(0, {os.path.join(os.path.dirname(__file__), "..")!r})
+import deepspeed_tpu
+from simple_model import SimpleModel
+engine, *_ = deepspeed_tpu.initialize(model=SimpleModel(hidden_dim=64), config_params={{
+    "train_batch_size": 8,
+    "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+    "zero_optimization": {{"stage": 2}},
+    "mesh": {{"data": 8}}}})
+ckpt_dir, _ = engine.load_checkpoint({str(tmp_path)!r}, tag="mh")
+assert ckpt_dir is not None
+assert engine.global_steps == 3
+print("LOAD OK")
+"""
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=600)
+    assert r.returncode == 0 and "LOAD OK" in r.stdout, \
+        r.stdout[-2000:] + r.stderr[-2000:]
