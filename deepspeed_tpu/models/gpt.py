@@ -47,6 +47,9 @@ class GPTConfig:
     layer_norm_eps: float = 1e-5
     tie_embeddings: bool = True
     loss_chunks: int = 0             # CE chunking: 0 auto, 1 off, n chunks
+    loss_impl: str = "auto"          # auto/xla: chunked XLA CE; pallas:
+                                     # fused streaming kernel (no logits in
+                                     # HBM; invalid with vocab-parallel TP)
     remat: bool = False              # per-block rematerialisation
     shard_activations: bool = True   # seq/data sharding constraints
     attn_impl: str = "auto"          # auto|pallas|xla (ops/transformer)
@@ -279,7 +282,8 @@ def _ce_rows(logits32, labels, valid):
     return jnp.sum(jnp.where(valid, lse - ll, 0.0))
 
 
-def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0):
+def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
+                              impl="auto"):
     """Fused projection + cross entropy: hidden states [N, D] and the [D, V]
     head weight go straight to summed NLL without a [N, V] activation
     surviving the loss.
@@ -297,6 +301,29 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0):
     """
     N, D = x.shape
     V = w.shape[-1]
+
+    if impl == "pallas":
+        from ..comm.mesh import peek_mesh
+        from ..ops.transformer.fused_xent import fused_softmax_xent_sum
+
+        info = peek_mesh()
+        if info is not None and info.mesh.shape.get("model", 1) > 1:
+            raise ValueError(
+                "loss_impl='pallas' is invalid with vocab-parallel TP "
+                "(model axis > 1): the kernel's logsumexp is row-global")
+        # block sizes must divide the shapes; vocab 50304 = 393*128 takes
+        # 384, the padded-to-128 GPT-2 family always has a lane-aligned
+        # divisor
+        br = next((b for b in (256, 128) if N % b == 0), None)
+        bv = next((b for b in (512, 448, 384, 256, 128) if V % b == 0),
+                  None)
+        if br and bv:
+            return fused_softmax_xent_sum(x, jnp.asarray(w), labels, valid,
+                                          br, bv)
+        from ..utils.logging import logger
+
+        logger.warning(f"loss_impl='pallas': shapes N={N}, V={V} have no "
+                       f"lane-aligned block divisor; using the XLA path")
 
     def project(rows):
         return jax.lax.dot_general(rows, w, (((1,), (0,)), ((), ())),
@@ -466,7 +493,7 @@ class GPT(TrainModule):
         nll_sum = _softmax_xent_from_hidden(
             x.reshape(B * S, D), self._proj_weight(params),
             safe_labels.reshape(-1), valid.reshape(-1),
-            self.config.loss_chunks)
+            self.config.loss_chunks, impl=self.config.loss_impl)
         ce = nll_sum / jnp.maximum(jnp.sum(valid), 1)
         if self.config.num_experts > 1 and train:
             # aux applies to the training objective only — eval loss stays
@@ -555,7 +582,7 @@ class GPT(TrainModule):
         B, S, D = x.shape
         nll = _softmax_xent_from_hidden(
             x.reshape(B * S, D), w, labels.reshape(-1), valid.reshape(-1),
-            cfg.loss_chunks)
+            cfg.loss_chunks, impl=cfg.loss_impl)
         return nll / jnp.maximum(jnp.sum(valid), 1)
 
     # -- convenience ---------------------------------------------------

@@ -69,3 +69,17 @@ def test_xla_attention_dropout_changes_output():
     drop = xla_attention(q, k, v, dropout_rate=0.5,
                          dropout_rng=jax.random.PRNGKey(5), train=True)
     assert not np.allclose(np.asarray(base), np.asarray(drop))
+
+
+@pytest.mark.parametrize("bq,bk", [(256, 256), (256, 512), (512, 512)])
+def test_flash_nondefault_blocks_match_xla(bq, bk):
+    """The perf sweep's candidate block sizes must be numerically correct
+    before they're ever timed on a chip (interpret mode here)."""
+    B, S, H, D = 1, 1024, 2, 64
+    ks = jax.random.split(jax.random.PRNGKey(3), 3)
+    q, k, v = (jax.random.normal(kk, (B, S, H, D), jnp.float32) * 0.3
+               for kk in ks)
+    want = xla_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-5)

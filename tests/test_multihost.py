@@ -37,10 +37,15 @@ def test_two_process_training_and_sharded_checkpoint(tmp_path):
         for i in range(nprocs)
     ]
     outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=600)
-        outs.append(out)
-        assert p.returncode == 0, out[-3000:]
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+    finally:  # a hung coordinator must not leak workers into CI
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
 
     # all processes computed the same loss and the same updated params
     lines = [ln for out in outs for ln in out.splitlines()

@@ -35,7 +35,8 @@ def chip_matmul_tflops(n=4096, iters=50):
 
 
 def measure(size, seq, micro, steps=20, loss_chunks=0, attn_impl="auto",
-            block_q=0, block_k=0, remat=False, zero_stage=2):
+            block_q=0, block_k=0, remat=False, zero_stage=2,
+            loss_impl="auto"):
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT, gpt2_config
 
@@ -43,7 +44,7 @@ def measure(size, seq, micro, steps=20, loss_chunks=0, attn_impl="auto",
     cfg = gpt2_config(size, max_seq_len=seq, shard_activations=n_dev > 1,
                       remat=remat, loss_chunks=loss_chunks,
                       attn_impl=attn_impl, flash_block_q=block_q,
-                      flash_block_k=block_k)
+                      flash_block_k=block_k, loss_impl=loss_impl)
     model = GPT(cfg)
     config = {
         "train_batch_size": micro * n_dev,
@@ -82,6 +83,7 @@ def measure(size, seq, micro, steps=20, loss_chunks=0, attn_impl="auto",
     tflops = 6.0 * n_params * tok_s / n_dev / 1e12
     return {"size": size, "seq": seq, "micro": micro,
             "loss_chunks": loss_chunks, "attn": attn_impl,
+            "loss_impl": loss_impl,
             "bq": block_q, "bk": block_k, "remat": remat,
             "step_ms": dt / steps * 1000, "tok_s_chip": tok_s / n_dev,
             "tflops": tflops, "compile_s": compile_s,
@@ -118,7 +120,7 @@ def main():
     runs = []
     if args.phase in ("all", "ce"):
         runs += [dict(loss_chunks=1), dict(loss_chunks=0),
-                 dict(loss_chunks=8)]
+                 dict(loss_chunks=8), dict(loss_impl="pallas")]
     if args.phase in ("all", "flash") and backend != "cpu":
         runs += [dict(attn_impl="xla"),
                  dict(block_q=256, block_k=256),
