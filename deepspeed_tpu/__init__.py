@@ -9,10 +9,25 @@ are sharding specs, kernels are Pallas/XLA.
 from .version import __version__, git_hash  # noqa: F401
 from . import comm  # noqa: F401
 from . import module_inject  # noqa: F401
+from . import ops  # noqa: F401
 from .comm import init_distributed  # noqa: F401
 from .runtime.activation_checkpointing import checkpointing  # noqa: F401
 from .runtime import zero  # noqa: F401
+# top-level names a reference user reaches for (reference __init__.py:7-23)
+from .runtime.engine import DeepSpeedEngine  # noqa: F401
+from .runtime.pipe.engine import PipelineEngine  # noqa: F401
+from .runtime.pipe.module import (PipelineModule, LayerSpec,  # noqa: F401
+                                  TiedLayerSpec)
+from .runtime import pipe  # noqa: F401
+from .runtime.lr_schedules import add_tuning_arguments  # noqa: F401
+from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
+from .runtime.constants import (ADAM_OPTIMIZER,  # noqa: F401
+                                LAMB_OPTIMIZER)
+from .ops.transformer import (DeepSpeedTransformerLayer,  # noqa: F401
+                              DeepSpeedTransformerConfig)
+from .utils.logging import log_dist  # noqa: F401
 
+version = __version__
 __git_hash__ = git_hash
 __git_branch__ = "main"
 

@@ -405,11 +405,19 @@ class GPT(TrainModule):
         return specs
 
     # -- forward -------------------------------------------------------
-    def _trunk(self, params, tokens, rng=None, train=False, pld_mask=None):
+    def _trunk(self, params, tokens, rng=None, train=False, pld_mask=None,
+               capture_layers=None):
         """Everything up to (and including) the final layer norm.
-        tokens [B, S] int32 -> ([B, S, D] hidden states, MoE aux loss)."""
+        tokens [B, S] int32 -> ([B, S, D] hidden states, MoE aux loss,
+        {layer_idx: block output} for capture_layers).
+
+        capture_layers is the TPU-native form of the reference's
+        layer-output forward hooks (reference engine.py:227-254): JAX has
+        no module hooks, so requested per-block outputs flow out of the
+        traced program as explicit extra outputs instead."""
         cfg = self.config
         aux_total = jnp.zeros((), jnp.float32)
+        captures = {}
         B, S = tokens.shape
         x = params["wte"][tokens] + params["wpe"][:S][None, :, :]
         if rng is not None:
@@ -418,6 +426,10 @@ class GPT(TrainModule):
         x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
 
         if cfg.pipeline_stages > 1:
+            if capture_layers:
+                raise NotImplementedError(
+                    "layer-output capture is not supported in SPMD pipeline "
+                    "mode (block outputs live on their owning stage)")
             from ..comm.mesh import get_current_mesh
             from ..parallel.pipeline import spmd_pipeline
 
@@ -444,8 +456,12 @@ class GPT(TrainModule):
                     out = jnp.where(pld_mask[i], out, x)
                 aux_total = aux_total + aux
                 x = out
+                if capture_layers is not None and \
+                        (capture_layers == "all" or i in capture_layers):
+                    captures[i] = x
 
-        return layer_norm(x, params["ln_f"], cfg.layer_norm_eps), aux_total
+        return (layer_norm(x, params["ln_f"], cfg.layer_norm_eps), aux_total,
+                captures)
 
     def _proj_weight(self, params):
         """[D, V] projection weight in the trunk's compute dtype."""
@@ -457,17 +473,21 @@ class GPT(TrainModule):
               with_aux=False):
         """tokens [B, S] int32 -> logits [B, S, V] (with_aux: also the
         summed MoE load-balancing loss)."""
-        x, aux_total = self._trunk(params, tokens, rng=rng, train=train,
-                                   pld_mask=pld_mask)
+        x, aux_total, _ = self._trunk(params, tokens, rng=rng, train=train,
+                                      pld_mask=pld_mask)
         logits = x @ self._proj_weight(params).astype(x.dtype)
         if with_aux:
             return logits, aux_total
         return logits
 
     def loss(self, params, batch, rng=None, train=True,
-             progressive_layer_drop=False, pld_theta=None):
+             progressive_layer_drop=False, pld_theta=None,
+             capture_layers=None):
         """Next-token cross entropy. batch: (tokens, labels) or dict with
-        input_ids/labels; labels == -100 positions are masked (HF parity)."""
+        input_ids/labels; labels == -100 positions are masked (HF parity).
+
+        capture_layers ("all" | iterable of layer indices): also return
+        {idx: block output} — the engine's register_forward_hook path."""
         if isinstance(batch, dict):
             tokens = batch["input_ids"]
             labels = batch.get("labels")
@@ -485,8 +505,9 @@ class GPT(TrainModule):
             pld_mask = jax.random.bernoulli(
                 sub, pld_theta, (self.config.num_layers,))
 
-        x, moe_aux = self._trunk(params, tokens, rng=rng, train=train,
-                                 pld_mask=pld_mask)
+        x, moe_aux, captures = self._trunk(params, tokens, rng=rng,
+                                           train=train, pld_mask=pld_mask,
+                                           capture_layers=capture_layers)
         valid = (labels >= 0)
         safe_labels = jnp.where(valid, labels, 0)
         B, S, D = x.shape
@@ -499,6 +520,8 @@ class GPT(TrainModule):
             # aux applies to the training objective only — eval loss stays
             # pure CE so perplexity comparisons are unbiased
             ce = ce + self.config.moe_aux_loss_weight * moe_aux
+        if capture_layers is not None:
+            return ce, captures
         return ce
 
     # -- ZeRO-Infinity streaming protocol ------------------------------

@@ -166,6 +166,8 @@ class DeepSpeedEngine:
         self.training_dataloader = (self.deepspeed_io(training_data)
                                     if training_data is not None else None)
 
+        self._init_hook_state()
+
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print() or 50)
@@ -174,7 +176,7 @@ class DeepSpeedEngine:
 
         # observability (reference engine.py:177-181, 966-1019, 1058-1068)
         self.timers = SynchronizedWallClockTimer()
-        self.wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
+        self._wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
         self.monitor = None
         if self._config.tensorboard_enabled and comm.get_rank() == 0:
             from ..utils.tensorboard import TensorBoardMonitor
@@ -190,6 +192,18 @@ class DeepSpeedEngine:
     # construction helpers
     # ------------------------------------------------------------------
 
+    def _init_hook_state(self):
+        """Layer-output hooks + gradient stashing (EleutherAI fork
+        additions, reference engine.py:222-254 and :139-140,1156-1161)."""
+        self.layer_outputs = {}
+        self.layers_to_hook = []
+        self.layer_name_pattern = "transformerlayer"
+        self.hooks = []  # API parity; JAX has no hook handles
+        self._capture_layers = None
+        self._store_gradients = False
+        self.store_gradients_cpu = False
+        self.stored_gradients = None
+
     def _configure_infinity(self, init_key):
         zc = self._config.zero_config
         if not (self._config.zero_optimization_stage >= 3
@@ -199,12 +213,6 @@ class DeepSpeedEngine:
         if self.gradient_accumulation_steps() != 1:
             raise ValueError("ZeRO-Infinity streaming requires "
                              "gradient_accumulation_steps == 1")
-        if jax.process_count() > 1:
-            # the streamed step has no cross-host gradient reduction yet;
-            # silent replica divergence is worse than refusing
-            raise NotImplementedError(
-                "ZeRO-Infinity streaming is single-host for now "
-                "(no cross-process grad reduction in the streamed step)")
         from .zero.infinity import InfinityRuntime
 
         hparams = dict(self._config.optimizer_params or {})
@@ -231,13 +239,14 @@ class DeepSpeedEngine:
         self.progressive_layer_drop = None
         self.training_dataloader = (self.deepspeed_io(training_data)
                                     if training_data is not None else None)
+        self._init_hook_state()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print() or 50)
         self._step_fns = {}
         self._last_lr = self._current_lr()
         self.timers = SynchronizedWallClockTimer()
-        self.wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
+        self._wall_clock_breakdown = bool(self._config.wall_clock_breakdown)
         self.monitor = None
         self._flops_profiled = True
         self._last_loss = None
@@ -331,30 +340,42 @@ class DeepSpeedEngine:
         predivide = float(self._config.gradient_predivide_factor or 1.0)
         scaler = self.loss_scaler
         pld_enabled = self.progressive_layer_drop is not None
+        capture = self._capture_layers
+        store_grads = self._store_gradients
 
         def cast(tree, dtype):
             return jax.tree_util.tree_map(
                 lambda x: x.astype(dtype) if jnp.issubdtype(
                     x.dtype, jnp.floating) else x, tree)
 
+        def run_loss(p, batch, rng, pld_theta, loss_scale):
+            """Shared scaled-loss body: returns (scaled_loss, (loss, caps)).
+            caps is {} unless layer-output hooks are registered
+            (register_forward_hook) — then the model threads the requested
+            block outputs out of the traced program as aux."""
+            kwargs = {}
+            if pld_enabled:
+                kwargs = {"progressive_layer_drop": True,
+                          "pld_theta": pld_theta}
+            if capture is not None:
+                kwargs["capture_layers"] = capture
+            out = model.loss(p, batch, rng=rng, train=True, **kwargs)
+            caps = {}
+            if capture is not None:
+                out, caps = out
+            loss = out[0] if isinstance(out, tuple) else out
+            scale_factor = loss_scale / (predivide if prescale else 1.0)
+            return loss.astype(jnp.float32) * scale_factor, (loss, caps)
+
         def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
             cparams = cast(params, compute_dtype)
-
-            def scaled_loss_fn(p):
-                kwargs = {}
-                if pld_enabled:
-                    kwargs = {"progressive_layer_drop": True,
-                              "pld_theta": pld_theta}
-                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
-                loss = out[0] if isinstance(out, tuple) else out
-                scale_factor = loss_scale / (predivide if prescale else 1.0)
-                return loss.astype(jnp.float32) * scale_factor, loss
-
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(cparams)
+            grads, (loss, caps) = jax.grad(
+                lambda p: run_loss(p, batch, rng, pld_theta, loss_scale),
+                has_aux=True)(cparams)
             grads = cast(grads, jnp.float32)
             new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
             new_acc = plan.constrain_grads(new_acc)
-            return loss, new_acc
+            return loss, new_acc, {"layer_outputs": caps}
 
         def apply_step(params, opt_state, scaler_state, acc, lr):
             loss_scale = scaler_state["cur_scale"]
@@ -366,6 +387,7 @@ class DeepSpeedEngine:
             grad_norm = jnp.asarray(0.0, jnp.float32)
             if clip > 0.0:
                 grads, grad_norm = clip_grad_norm(grads, clip)
+            extras = {"grads": grads} if store_grads else {}
             # grads here are already DP-averaged (XLA psum at the loss-mean
             # boundary), so a 1-bit optimizer on this path runs dense
             # (comm_axis=None). The compressed hot path is
@@ -384,7 +406,8 @@ class DeepSpeedEngine:
             new_opt = plan.constrain_opt_state(new_opt)
             new_scaler = scaler.jit_update(scaler_state, overflow)
             zero_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return new_params, new_opt, new_scaler, zero_acc, overflow, grad_norm
+            return (new_params, new_opt, new_scaler, zero_acc, overflow,
+                    grad_norm, extras)
 
         def full_step(params, opt_state, scaler_state, batch, rng, lr,
                       pld_theta):
@@ -397,18 +420,9 @@ class DeepSpeedEngine:
             overlap the optimizer with the tail of the backward."""
             loss_scale = scaler_state["cur_scale"]
             cparams = cast(params, compute_dtype)
-
-            def scaled_loss_fn(p):
-                kwargs = {}
-                if pld_enabled:
-                    kwargs = {"progressive_layer_drop": True,
-                              "pld_theta": pld_theta}
-                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
-                loss = out[0] if isinstance(out, tuple) else out
-                scale_factor = loss_scale / (predivide if prescale else 1.0)
-                return loss.astype(jnp.float32) * scale_factor, loss
-
-            grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(cparams)
+            grads, (loss, caps) = jax.grad(
+                lambda p: run_loss(p, batch, rng, pld_theta, loss_scale),
+                has_aux=True)(cparams)
             grads = cast(grads, jnp.float32)
             grads = plan.constrain_grads(grads)
             overflow = has_overflow(grads)
@@ -419,6 +433,9 @@ class DeepSpeedEngine:
             grad_norm = jnp.asarray(0.0, jnp.float32)
             if clip > 0.0:
                 grads, grad_norm = clip_grad_norm(grads, clip)
+            extras = {"layer_outputs": caps}
+            if store_grads:
+                extras["grads"] = grads
             new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
             sel = lambda new, old: jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new, old)
@@ -427,7 +444,8 @@ class DeepSpeedEngine:
             new_params = plan.constrain_params(new_params)
             new_opt = plan.constrain_opt_state(new_opt)
             new_scaler = scaler.jit_update(scaler_state, overflow)
-            return new_params, new_opt, new_scaler, loss, overflow, grad_norm
+            return (new_params, new_opt, new_scaler, loss, overflow,
+                    grad_norm, extras)
 
         donate_micro = jax.jit(micro_step, donate_argnums=(1,))
         # lr=None (optimizer-default) is a static arg value: jit treats None
@@ -443,33 +461,42 @@ class DeepSpeedEngine:
             loss_scale = scaler_state["cur_scale"]
             cparams = cast(params, compute_dtype)
 
-            def scaled_loss_fn(p, batch, rng):
-                kwargs = {}
-                if pld_enabled:
-                    kwargs = {"progressive_layer_drop": True,
-                              "pld_theta": pld_theta}
-                out = model.loss(p, batch, rng=rng, train=True, **kwargs)
-                loss = out[0] if isinstance(out, tuple) else out
-                scale_factor = loss_scale / (predivide if prescale else 1.0)
-                return loss.astype(jnp.float32) * scale_factor, loss
+            # captured layer outputs ride the scan CARRY (overwritten per
+            # micro step — reference hooks overwrite per forward), not the
+            # stacked ys: as ys they'd materialize a [gas, ...] buffer per
+            # hooked layer only for the last slice to survive
+            caps0 = {}
+            if capture is not None:
+                caps_struct = jax.eval_shape(
+                    lambda p, b, r, ls, th: run_loss(p, b, r, th, ls)[1][1],
+                    cparams, jax.tree_util.tree_map(lambda x: x[0], batches),
+                    rngs[0], loss_scale, pld_theta)
+                caps0 = jax.tree_util.tree_map(
+                    lambda s: jnp.zeros(s.shape, s.dtype), caps_struct)
 
-            def body(acc, inp):
+            def body(carry, inp):
+                acc, _ = carry
                 batch_i, rng_i = inp
-                grads, loss = jax.grad(scaled_loss_fn, has_aux=True)(
-                    cparams, batch_i, rng_i)
+                grads, (loss, caps) = jax.grad(
+                    lambda p: run_loss(p, batch_i, rng_i, pld_theta,
+                                       loss_scale),
+                    has_aux=True)(cparams)
                 acc = jax.tree_util.tree_map(
                     lambda a, g: a + g.astype(jnp.float32), acc, grads)
-                return plan.constrain_grads(acc), loss
+                return (plan.constrain_grads(acc), caps), loss
 
             acc0 = jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), params)
             acc0 = plan.constrain_grads(acc0)
-            acc, losses = jax.lax.scan(body, acc0, (batches, rngs))
+            (acc, caps), losses = jax.lax.scan(body, (acc0, caps0),
+                                               (batches, rngs))
             (new_params, new_opt, new_scaler, zero_acc, overflow,
-             grad_norm) = apply_step(params, opt_state, scaler_state, acc,
-                                     lr)
+             grad_norm, extras) = apply_step(params, opt_state, scaler_state,
+                                             acc, lr)
+            extras = dict(extras)
+            extras["layer_outputs"] = caps
             return (new_params, new_opt, new_scaler, jnp.mean(losses),
-                    overflow, grad_norm)
+                    overflow, grad_norm, extras)
 
         fns = {"micro": donate_micro, "apply": donate_apply}
         if self._use_onebit_comm():
@@ -523,16 +550,18 @@ class DeepSpeedEngine:
                            "compressed path (local grads are never "
                            "globally reduced; reference parity)")
 
-        # per-rank error-feedback buffers: [dp, *param] sharded over data
-        self._opt_state = dict(self._opt_state)
-        for key in ("worker_error", "server_error"):
-            expanded = jax.tree_util.tree_map(
-                lambda e: jnp.zeros((dp,) + tuple(e.shape), jnp.float32),
-                self._opt_state[key])
-            self._opt_state[key] = jax.device_put(
-                expanded, jax.tree_util.tree_map(
-                    lambda _: NamedSharding(
-                        mesh, PartitionSpec(DATA_AXIS)), expanded))
+        if not getattr(self, "_onebit_hot", False):
+            # per-rank error-feedback buffers: [dp, *param] sharded over
+            # data (skip when rebuilding step fns — already expanded)
+            self._opt_state = dict(self._opt_state)
+            for key in ("worker_error", "server_error"):
+                expanded = jax.tree_util.tree_map(
+                    lambda e: jnp.zeros((dp,) + tuple(e.shape), jnp.float32),
+                    self._opt_state[key])
+                self._opt_state[key] = jax.device_put(
+                    expanded, jax.tree_util.tree_map(
+                        lambda _: NamedSharding(
+                            mesh, PartitionSpec(DATA_AXIS)), expanded))
 
         self._onebit_hot = True
         err_spec = PartitionSpec(DATA_AXIS)
@@ -577,8 +606,10 @@ class DeepSpeedEngine:
                     lambda e: e[None], new_opt[key])
             new_scaler = scaler.jit_update(scaler_state, overflow)
             loss_mean = jax.lax.pmean(loss, DATA_AXIS)
+            # layer capture / grad stashing are not offered on this path
+            # (local grads never exist globally-reduced); empty extras
             return (new_params, new_opt, new_scaler, loss_mean, overflow,
-                    jnp.zeros((), jnp.float32))
+                    jnp.zeros((), jnp.float32), {})
 
         smapped = jax.shard_map(
             run, mesh=mesh,
@@ -586,7 +617,8 @@ class DeepSpeedEngine:
                       PartitionSpec(DATA_AXIS), PartitionSpec(),
                       PartitionSpec(), PartitionSpec()),
             out_specs=(PartitionSpec(), state_specs, PartitionSpec(),
-                       PartitionSpec(), PartitionSpec(), PartitionSpec()),
+                       PartitionSpec(), PartitionSpec(), PartitionSpec(),
+                       PartitionSpec()),
             axis_names={DATA_AXIS}, check_vma=False)
         return jax.jit(smapped, donate_argnums=(0, 1))
 
@@ -658,12 +690,13 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop else 1.0, jnp.float32)
         profiling = self._maybe_profile_flops(batch, rng, theta)
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("forward").start()
-        loss, self._grad_acc = self._step_fns["micro"](
+        loss, self._grad_acc, extras = self._step_fns["micro"](
             self._params, self._grad_acc, batch, rng,
             self._scaler_state["cur_scale"], theta)
-        if self.wall_clock_breakdown:
+        self._consume_extras(extras)
+        if self._wall_clock_breakdown:
             # one fused fwd+bwd program: this IS forward+backward time
             self.timers("forward").stop(sync=loss)
         if profiling is not None:
@@ -678,7 +711,10 @@ class DeepSpeedEngine:
         return loss
 
     def _infinity_forward(self, batch):
-        """Streamed whole-step (fwd+bwd+host update); step() bookkeeps."""
+        """Streamed whole-step (fwd+bwd+host update); step() bookkeeps.
+        Multi-host: `batch` is this process's LOCAL shard of the global
+        batch (the dataloader already strides per process); grads/loss are
+        averaged across processes inside the runtime."""
         self._resolve_pending_overflow()  # settle the PREVIOUS step first
         self.tput_timer.start()
         loss, overflow = self._infinity.train_step(
@@ -707,13 +743,14 @@ class DeepSpeedEngine:
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         profiling = self._maybe_profile_flops(batch, rng, theta, lr=lr)
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("forward").start()
         (self._params, self._opt_state, new_scaler, loss,
-         overflow, grad_norm) = self._step_fns["full"](
+         overflow, grad_norm, extras) = self._step_fns["full"](
             self._params, self._opt_state, self._scaler_state, batch, rng,
             lr, theta)
-        if self.wall_clock_breakdown:
+        self._consume_extras(extras)
+        if self._wall_clock_breakdown:
             # the fused program IS forward+backward+step
             self.timers("forward").stop(sync=loss)
         if profiling is not None:
@@ -762,6 +799,103 @@ class DeepSpeedEngine:
         self._cached = None
         return loss
 
+    # ------------------------------------------------------------------
+    # layer-output hooks + gradient stashing (EleutherAI fork additions)
+    # ------------------------------------------------------------------
+
+    def register_forward_hook(self, layers_to_hook,
+                              layer_name_pattern="transformerlayer"):
+        """Capture per-layer block outputs into engine.layer_outputs
+        (reference engine.py:227-254). JAX has no module hooks: the model
+        instead threads the requested outputs out of the jitted step as
+        explicit aux (model.loss(..., capture_layers=...)), so capture
+        costs one extra HBM write per hooked layer and nothing else.
+
+        layers_to_hook: "all" or a list of layer indices ([] disables).
+        layer_name_pattern is accepted for API parity; layer selection here
+        is by index (the model's blocks are a list, not named submodules)."""
+        self.layer_name_pattern = layer_name_pattern
+        self.layers_to_hook = layers_to_hook
+        self.layer_outputs = {}
+        if layers_to_hook == "all":
+            cap = "all"
+        elif layers_to_hook:
+            cap = tuple(int(i) for i in layers_to_hook)
+            n_layers = getattr(getattr(self.module, "config", None),
+                               "num_layers", None)
+            if n_layers is not None:
+                bad = [i for i in cap if not 0 <= i < n_layers]
+                if bad:
+                    raise ValueError(
+                        f"layers_to_hook {bad} out of range for a "
+                        f"{n_layers}-layer model")
+        else:
+            cap = None
+        if cap is not None:
+            if self._infinity is not None:
+                raise NotImplementedError(
+                    "layer-output hooks are unavailable under ZeRO-Infinity "
+                    "streaming (block outputs are consumed as they stream)")
+            if getattr(self, "_onebit_hot", False):
+                raise NotImplementedError(
+                    "layer-output hooks are unavailable on the 1-bit "
+                    "compressed step path")
+            if not self._model_supports_capture():
+                raise TypeError(
+                    f"{type(self.module).__name__}.loss does not accept "
+                    "capture_layers; implement it to use forward hooks")
+        if cap != self._capture_layers:
+            self._capture_layers = cap
+            self._step_fns = self._build_step_fns()
+
+    def _model_supports_capture(self) -> bool:
+        import inspect
+
+        try:
+            sig = inspect.signature(self.module.loss)
+        except (TypeError, ValueError):
+            return False
+        return "capture_layers" in sig.parameters
+
+    @property
+    def store_gradients(self) -> bool:
+        """When True, each optimizer step stashes the post-clip, unscaled,
+        DP-averaged gradient pytree in engine.stored_gradients (reference
+        engine.py:139-140,1156-1161; set store_gradients_cpu for a host
+        numpy copy). Flipping this retraces the step program."""
+        return self._store_gradients
+
+    @store_gradients.setter
+    def store_gradients(self, value):
+        value = bool(value)
+        if value == self._store_gradients:
+            return
+        if value and getattr(self, "_onebit_hot", False):
+            raise NotImplementedError(
+                "gradient stashing is unavailable on the 1-bit compressed "
+                "step path (gradients are never globally reduced)")
+        if value and self._infinity is not None:
+            raise NotImplementedError(
+                "gradient stashing is unavailable under ZeRO-Infinity "
+                "streaming (per-block grads are consumed as they stream)")
+        self._store_gradients = value
+        if not value:
+            self.stored_gradients = None
+        if self._step_fns:
+            self._step_fns = self._build_step_fns()
+
+    def _consume_extras(self, extras):
+        """Host-side sink for optional step outputs (layer captures, grad
+        stash)."""
+        caps = extras.get("layer_outputs")
+        if caps:
+            self.layer_outputs = dict(caps)
+        grads = extras.get("grads")
+        if grads is not None:
+            if self.store_gradients_cpu:
+                grads = jax.device_get(grads)
+            self.stored_gradients = grads
+
     def is_gradient_accumulation_boundary(self) -> bool:
         return (self.micro_steps % self.gradient_accumulation_steps()) == 0
 
@@ -773,15 +907,16 @@ class DeepSpeedEngine:
             return self._offload_step()
         if getattr(self, "_pending_full", None) is not None:
             return self._fused_step_bookkeeping()
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("step").start()
         self._resolve_pending_overflow()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         (self._params, self._opt_state, self._scaler_state, self._grad_acc,
-         overflow, grad_norm) = self._step_fns["apply"](
+         overflow, grad_norm, extras) = self._step_fns["apply"](
             self._params, self._opt_state, self._scaler_state,
             self._grad_acc, lr)
+        self._consume_extras(extras)
         self.global_steps += 1
         # DEFERRED overflow handling: bool(overflow) here would sync every
         # step, serializing Python dispatch against device compute (the
@@ -794,7 +929,7 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("step").stop(sync=grad_norm)
             self._log_timers()
         if self.monitor is not None:
@@ -829,7 +964,7 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()  # optimistic; rolled back on overflow
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self._log_timers()
         if self.monitor is not None:
             self._resolve_pending_overflow()
@@ -890,13 +1025,20 @@ class DeepSpeedEngine:
     def _offload_step(self):
         """Host-side step: grads D2H -> native CPU-Adam on fp32 masters ->
         updated weights H2D. Loss-scale bookkeeping mirrors the device path."""
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("step").start()
         denom = float(self._scaler_state["cur_scale"]) * \
             self.gradient_accumulation_steps()
         if self._config.prescale_gradients:
             denom /= float(self._config.gradient_predivide_factor or 1.0)
         grad_leaves = jax.tree_util.tree_leaves(self._grad_acc)
+        if self._store_gradients:
+            # host path: stash pre-clip unscaled grads (clipping happens
+            # inside the native step; documented divergence from the
+            # device path's post-clip stash)
+            self.stored_gradients = jax.tree_util.tree_unflatten(
+                jax.tree_util.tree_structure(self._grad_acc),
+                [np.asarray(g, np.float32) / denom for g in grad_leaves])
         new_params, overflow, _norm = self._offload.step(
             grad_leaves, denom, self._current_lr(),
             clip=float(self._config.gradient_clipping or 0.0))
@@ -912,7 +1054,7 @@ class DeepSpeedEngine:
             if self.lr_scheduler is not None:
                 self.lr_scheduler.step()
         self._grad_acc = None
-        if self.wall_clock_breakdown:
+        if self._wall_clock_breakdown:
             self.timers("step").stop()  # host step: already synchronous
             self._log_timers()
         self._emit_monitor_scalars()
@@ -967,9 +1109,10 @@ class DeepSpeedEngine:
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         (self._params, self._opt_state, new_scaler, loss, overflow,
-         grad_norm) = self._step_fns["full_scan"](
+         grad_norm, extras) = self._step_fns["full_scan"](
             self._params, self._opt_state, self._scaler_state, stacked,
             rngs, lr, theta)
+        self._consume_extras(extras)
         self.micro_steps += gas
         self.global_samples += self.train_micro_batch_size_per_gpu() * \
             self.dp_world_size * gas
@@ -1026,6 +1169,13 @@ class DeepSpeedEngine:
             return self._infinity.masters_tree()  # host fp32 masters
         return self._params
 
+    def get_batch_info(self):
+        """(train_batch_size, micro_batch_size, gradient_accumulation_steps)
+        — reference engine.py:256-268."""
+        return (self._config.train_batch_size,
+                self._config.train_micro_batch_size_per_gpu,
+                self._config.gradient_accumulation_steps)
+
     def train_batch_size(self):
         return self._config.train_batch_size
 
@@ -1038,14 +1188,257 @@ class DeepSpeedEngine:
     def steps_per_print(self):
         return self._config.steps_per_print
 
-    def zero_optimization_stage(self):
-        return self._config.zero_optimization_stage
-
     def fp16_enabled(self):
         return self._config.fp16_enabled
 
     def precision(self):
         return self._config.precision
+
+    # -- config accessor surface (reference engine.py:300-536) ---------
+
+    def train(self, mode: bool = True):
+        """torch Module-parity mode toggle. Train/eval behaviour here is
+        selected per-call (model.loss(train=...)), so this only records
+        intent for API compatibility."""
+        self.training = bool(mode)
+        return self
+
+    def eval(self):
+        return self.train(False)
+
+    def zero_grad(self):
+        """API parity (reference engine.py:1103): gradient zeroing happens
+        inside the jitted apply step (the accumulator is returned zeroed),
+        so there is nothing to do between steps."""
+        self._grad_acc = None
+
+    def allreduce_gradients(self, bucket_size=None):
+        """API parity (reference engine.py:1023-1038): DP gradient
+        reduction is fused into the jitted step (XLA psum at the loss-mean
+        boundary), so an explicit allreduce pass does not exist."""
+
+    def get_mom(self):
+        """First-moment decay (beta1) per param group (reference :525)."""
+        groups = getattr(self.optimizer, "param_groups", None) or []
+        out = []
+        for g in groups:
+            if "betas" in g:
+                out.append(g["betas"][0])
+            else:
+                out.append(g.get("momentum", 0.0))
+        return out
+
+    def get_pld_theta(self):
+        if self.progressive_layer_drop is not None:
+            return self.progressive_layer_drop.get_theta()
+        return None
+
+    def pld_enabled(self):
+        return self._config.pld_enabled
+
+    def pld_params(self):
+        return self._config.pld_params
+
+    def pld_theta(self):
+        return (self._config.pld_params or {}).get(const.PLD_THETA, 1.0)
+
+    def pld_gamma(self):
+        return (self._config.pld_params or {}).get(const.PLD_GAMMA, 0.001)
+
+    def get_summary_writer(self):
+        return getattr(self.monitor, "writer", None)
+
+    def dump_state(self):
+        return self._config.dump_state
+
+    def dynamic_loss_scale(self):
+        return self._config.loss_scale == 0
+
+    def initial_dynamic_scale(self):
+        return 2 ** self._config.initial_scale_power
+
+    def dynamic_loss_scale_args(self):
+        return {"init_scale": 2 ** self._config.initial_scale_power,
+                "scale_window": self._config.loss_scale_window,
+                "delayed_shift": self._config.hysteresis,
+                "min_scale": self._config.min_loss_scale}
+
+    def amp_enabled(self):
+        return self._config.amp_enabled
+
+    def amp_params(self):
+        return self._config.amp_params
+
+    def elasticity_enabled(self):
+        return self._config.elasticity_enabled
+
+    def gradient_clipping(self):
+        return self._config.gradient_clipping
+
+    def gradient_predivide_factor(self):
+        return self._config.gradient_predivide_factor
+
+    def postscale_gradients(self):
+        return not self._config.prescale_gradients
+
+    def allreduce_always_fp32(self):
+        """Always true here: gradients are cast to fp32 before the fused
+        psum/reduce-scatter (reference fp32_allreduce option)."""
+        return True
+
+    def memory_breakdown(self):
+        return self._config.memory_breakdown
+
+    def optimizer_name(self):
+        return self._config.optimizer_name
+
+    def optimizer_params(self):
+        return self._config.optimizer_params
+
+    def optimizer_legacy_fusion(self):
+        return self._config.optimizer_legacy_fusion
+
+    def scheduler_name(self):
+        return self._config.scheduler_name
+
+    def scheduler_params(self):
+        return self._config.scheduler_params
+
+    def sparse_gradients_enabled(self):
+        return self._config.sparse_gradients_enabled
+
+    def wall_clock_breakdown(self):
+        return self._wall_clock_breakdown
+
+    def tensorboard_enabled(self):
+        return self._config.tensorboard_enabled
+
+    def tensorboard_output_path(self):
+        return self._config.tensorboard_output_path
+
+    def tensorboard_job_name(self):
+        return self._config.tensorboard_job_name
+
+    def checkpoint_tag_validation_enabled(self):
+        return self._config.checkpoint_tag_validation_enabled
+
+    def checkpoint_tag_validation_fail(self):
+        return self._config.checkpoint_tag_validation_fail
+
+    def flops_profiler_enabled(self):
+        return self._config.flops_profiler_config.enabled
+
+    def flops_profiler_profile_step(self):
+        return self._config.flops_profiler_config.profile_step
+
+    def flops_profiler_module_depth(self):
+        return self._config.flops_profiler_config.module_depth
+
+    def flops_profiler_top_modules(self):
+        return self._config.flops_profiler_config.top_modules
+
+    def flops_profiler_detailed(self):
+        return self._config.flops_profiler_config.detailed
+
+    def zero_optimization(self):
+        return self._config.zero_enabled
+
+    def zero_allow_untested_optimizer(self):
+        return self._config.zero_allow_untested_optimizer
+
+    def zero_reduce_scatter(self):
+        return self._config.zero_config.reduce_scatter
+
+    def zero_overlap_comm(self):
+        return self._config.zero_config.overlap_comm
+
+    def zero_cpu_offload(self):
+        return self._config.zero_config.cpu_offload
+
+    def zero_offload_optimizer(self):
+        return self._config.zero_config.offload_optimizer
+
+    def zero_offload_param(self):
+        return self._config.zero_config.offload_param
+
+    def zero_sub_group_size(self):
+        return self._config.zero_config.sub_group_size
+
+    def zero_optimization_stage(self):
+        return self._config.zero_optimization_stage
+
+    def zero_reduce_bucket_size(self):
+        return self._config.zero_config.reduce_bucket_size
+
+    def zero_allgather_bucket_size(self):
+        return self._config.zero_config.allgather_bucket_size
+
+    def zero_allgather_partitions(self):
+        return self._config.zero_config.allgather_partitions
+
+    def zero_contiguous_gradients(self):
+        return self._config.zero_config.contiguous_gradients
+
+    def zero_elastic_checkpoint(self):
+        return self._config.zero_config.elastic_checkpoint
+
+    def zero_load_from_fp32_weights(self):
+        return self._config.zero_config.load_from_fp32_weights
+
+    def zero_max_live_parameters(self):
+        return self._config.zero_config.max_live_parameters
+
+    def zero_max_reuse_distance(self):
+        return self._config.zero_config.max_reuse_distance
+
+    def zero_prefetch_bucket_size(self):
+        return self._config.zero_config.prefetch_bucket_size
+
+    def zero_param_persistence_threshold(self):
+        return self._config.zero_config.param_persistence_threshold
+
+    def zero_gather_fp16_weights_on_model_save(self):
+        return self._config.zero_config.gather_fp16_weights_on_model_save
+
+    def zero_optimization_partition_gradients(self):
+        return self.zero_optimization_stage() >= 2
+
+    def zero_optimization_partition_weights(self):
+        return self.zero_optimization_stage() >= 3
+
+    def module_state_dict(self):
+        """Module weights as a host pytree (reference engine.py:1443)."""
+        return jax.tree_util.tree_map(np.asarray, self.params)
+
+    def load_module_state_dict(self, state_dict, strict=True):
+        """Replace module weights from a host pytree (reference :1456).
+        strict: require the same tree structure.
+
+        Under CPU-offload/Infinity the fp32 masters are re-seeded from the
+        given weights — if those came from module_state_dict() (compute
+        dtype under offload), master precision is truncated to it. Use
+        save_checkpoint/load_checkpoint to move state losslessly."""
+        if strict:
+            expect = jax.tree_util.tree_structure(self.params)
+            got = jax.tree_util.tree_structure(state_dict)
+            if expect != got:
+                raise ValueError(
+                    f"state_dict tree mismatch: {got} != {expect}")
+        if self._infinity is not None:
+            # stays on host — the streamed tree must never fully
+            # materialize on device
+            self._infinity.load_masters_tree(state_dict)
+            return
+        params = jax.tree_util.tree_map(jnp.asarray, state_dict)
+        if self._offload is not None:
+            self._offload.masters = [
+                np.asarray(l, np.float32).ravel().copy()
+                for l in jax.tree_util.tree_leaves(state_dict)]
+            params = jax.tree_util.tree_map(
+                lambda p: p.astype(self.compute_dtype)
+                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+        self._params = jax.device_put(params,
+                                      self.zero_plan.param_shardings())
 
     @property
     def skipped_steps(self):

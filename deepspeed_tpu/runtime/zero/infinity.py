@@ -50,6 +50,94 @@ def _tokens_labels(batch):
     return tokens, labels
 
 
+class CrossProcessGradReducer:
+    """Mean host fp32 gradient vectors across jax.distributed processes.
+
+    The streamed step computes LOCAL grads per process (each process
+    trains on its shard of the global batch); the fp32 masters are
+    updated on every host identically, so the grads must be averaged
+    across processes first. Host data can't ride a collective directly —
+    chunks are staged through the devices: a [P, chunk] global array
+    (one row per process, via make_array_from_process_local_data) is
+    mean-reduced by a tiny jitted program whose replicated output every
+    process can read. Chunking bounds the device working set, so this
+    works even when total grads far exceed HBM (the Infinity regime).
+
+    Reference capability: stage-3's dp grad reduce-scatter
+    (zero/stage3.py:1119-1170) ahead of the partitioned host update."""
+
+    def __init__(self, chunk_elems: int = 32 * 1024 * 1024):
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        self.nprocs = jax.process_count()
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        per_proc = len(devs) // self.nprocs
+        grid = np.array(devs).reshape(self.nprocs, per_proc)
+        self.mesh = Mesh(grid, ("proc", "dev"))
+        self._row_sharding = NamedSharding(self.mesh, P("proc"))
+        self._out_sharding = NamedSharding(self.mesh, P())
+        self.chunk = int(chunk_elems)
+        self._buf = None  # lazily-allocated reusable staging buffer
+        self._mean = jax.jit(lambda x: jnp.mean(x, axis=0),
+                             out_shardings=self._out_sharding)
+
+    def _reduce_chunk(self, local: np.ndarray) -> np.ndarray:
+        """local [n] fp32 -> mean over processes [n] fp32 (n <= chunk)."""
+        from jax import make_array_from_process_local_data
+
+        garr = make_array_from_process_local_data(
+            self._row_sharding, local[None, :], (self.nprocs, local.size))
+        out = self._mean(garr)
+        return np.asarray(out.addressable_data(0))
+
+    def mean_inplace(self, sink: dict) -> None:
+        """Average every vector in {key: fp32 1-D ndarray} across
+        processes, packing keys (deterministic order — identical trees on
+        every process) into chunk-sized staging buffers."""
+        keys = sorted(sink)
+        if self._buf is None:
+            self._buf = np.empty((self.chunk,), np.float32)
+        buf = self._buf
+        pending: list = []  # (key, start, end) spans inside buf
+        used = 0
+
+        def flush():
+            nonlocal used
+            if not pending:
+                return
+            reduced = self._reduce_chunk(buf[:used])
+            for key, s, e in pending:
+                sink[key] = reduced[s:e]
+            pending.clear()
+            used = 0
+
+        for key in keys:
+            g = sink[key]
+            if g.size > self.chunk:
+                # reduce into a FRESH array: g may be a read-only zero-copy
+                # view of a device buffer (CPU backend np.asarray)
+                flush()
+                out = np.empty(g.size, np.float32)
+                for s in range(0, g.size, self.chunk):
+                    e = min(s + self.chunk, g.size)
+                    out[s:e] = self._reduce_chunk(
+                        np.ascontiguousarray(g[s:e]))
+                sink[key] = out
+                continue
+            if used + g.size > self.chunk:
+                flush()
+            buf[used:used + g.size] = g
+            pending.append((key, used, used + g.size))
+            used += g.size
+        flush()
+
+    def mean_scalar(self, value) -> jnp.ndarray:
+        return jnp.asarray(
+            self._reduce_chunk(
+                np.asarray([value], np.float32))[0], jnp.float32)
+
+
 class InfinityRuntime:
     def __init__(self, model, rng, hparams: dict, adam_w_mode: bool = True,
                  compute_dtype=jnp.bfloat16, nvme_path: Optional[str] = None):
@@ -91,8 +179,14 @@ class InfinityRuntime:
             self._leaf_base[name] = base
             base += len(self.masters[name][0])
         self._jits: Dict[str, Any] = {}
+        # multi-host DP: each process streams on its shard of the global
+        # batch; grads are averaged across processes before the (replicated)
+        # host master update
+        self.reducer = (CrossProcessGradReducer()
+                        if jax.process_count() > 1 else None)
         log_dist(f"ZeRO-Infinity: {n_elem / 1e6:.1f}M params streamed from "
-                 f"host ({'moments on NVMe' if nvme_path else 'RAM'})",
+                 f"host ({'moments on NVMe' if nvme_path else 'RAM'}"
+                 f"{', dp=' + str(jax.process_count()) if self.reducer else ''})",
                  ranks=[0])
 
     # -- host <-> device -----------------------------------------------
@@ -216,7 +310,14 @@ class InfinityRuntime:
                       "wpe": dembed["wpe"]}
         self._grads_to_host("embed", dembed, sink)
 
+        # ---- multi-host DP: average grads + loss across processes -------
+        if self.reducer is not None:
+            self.reducer.mean_inplace(sink)
+            loss = self.reducer.mean_scalar(loss)
+
         # ---- host optimizer over ALL groups (skip-step on any inf) ------
+        # (post-reduction: a non-finite grad on ANY process poisons the
+        # mean, so every process skips in lockstep)
         overflow = not all(np.isfinite(g).all() for g in sink.values())
         if overflow:
             return loss, True

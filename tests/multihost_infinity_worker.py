@@ -1,0 +1,61 @@
+"""Worker for test_multihost.py::test_two_process_infinity_dp: one of N
+jax.distributed processes training a streamed (ZeRO-Infinity) GPT on its
+local shard of the global batch; grads are averaged across processes by
+CrossProcessGradReducer, so masters (and losses printed per step) must
+agree bit-for-bit across workers."""
+
+import os
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=proc_id)
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+
+    cfg = gpt2_config("nano", vocab_size=128, dropout=0.0, embed_dropout=0.0)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=GPT(cfg),
+        dist_init_required=False,
+        config_params={
+            "train_batch_size": 4 * nprocs,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {
+                "stage": 3,
+                "offload_param": {"device": "cpu"},
+            },
+            "steps_per_print": 0,
+        })
+    assert engine._infinity is not None and engine._infinity.reducer is not None
+
+    rng = np.random.RandomState(0)  # same global batch everywhere
+    for step in range(2):
+        tokens = rng.randint(0, 128, size=(4 * nprocs, 33)).astype(np.int32)
+        local = tokens[proc_id * 4:(proc_id + 1) * 4]  # this process's shard
+        loss = engine.forward((local[:, :-1], local[:, 1:]))
+        engine.backward()
+        engine.step()
+
+    m0 = jax.tree_util.tree_leaves(engine.params)[0]
+    print(f"MHINF proc={proc_id} loss={float(loss):.6f} "
+          f"params0={float(np.asarray(m0, np.float32).sum()):.6f}",
+        flush=True)
+
+
+if __name__ == "__main__":
+    main()

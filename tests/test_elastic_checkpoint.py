@@ -34,6 +34,7 @@ def _batch(key=0):
     ({"data": 4, "model": 2}, 1),   # dp 8 -> 4, ZeRO 2 -> 1
     ({"data": 8}, 3),               # same dp, ZeRO 2 -> 3
 ])
+@pytest.mark.slow
 def test_resume_across_world_sizes(tmp_path, resume_mesh, resume_stage):
     engine = _engine({"data": 8}, zero_stage=2)
     for i in range(3):
@@ -62,6 +63,7 @@ def test_resume_across_world_sizes(tmp_path, resume_mesh, resume_stage):
     assert resumed.global_steps == 4
 
 
+@pytest.mark.slow
 def test_resume_preserves_training_trajectory(tmp_path):
     """Train 6 steps straight vs 3 + save/load at different dp + 3 more:
     final weights must match (optimizer state survives the re-partition)."""

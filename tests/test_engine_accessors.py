@@ -1,0 +1,84 @@
+"""Engine config-accessor surface parity (reference engine.py:300-536)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu as ds
+from tests.simple_model import SimpleModel, random_batches
+
+
+@pytest.fixture(scope="module")
+def engine():
+    eng, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={
+            "train_batch_size": 32,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-2, "betas": [0.8, 0.95]}},
+            "gradient_clipping": 0.5,
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 0,
+        })
+    return eng
+
+
+def test_batch_info(engine):
+    assert engine.get_batch_info() == (32, 4, 1)
+
+
+def test_accessor_values(engine):
+    assert engine.zero_optimization() is True
+    assert engine.zero_optimization_stage() == 2
+    assert engine.zero_optimization_partition_gradients() is True
+    assert engine.zero_optimization_partition_weights() is False
+    assert engine.gradient_clipping() == 0.5
+    assert engine.postscale_gradients() is True
+    assert engine.allreduce_always_fp32() is True
+    assert engine.optimizer_name() == "adam"
+    assert engine.optimizer_params()["lr"] == 1e-2
+    assert engine.scheduler_name() is None
+    assert engine.amp_enabled() is False
+    assert engine.pld_enabled() is False
+    assert engine.dynamic_loss_scale() is True
+    assert engine.initial_dynamic_scale() == 2 ** 32
+    args = engine.dynamic_loss_scale_args()
+    assert args["scale_window"] == 1000 and args["min_scale"] == 1
+    assert engine.wall_clock_breakdown() is False
+    assert engine.tensorboard_enabled() is False
+    assert engine.flops_profiler_enabled() is False
+    assert engine.zero_reduce_scatter() is True
+    assert engine.zero_cpu_offload() is False
+    assert engine.sparse_gradients_enabled() is False
+    assert engine.get_mom() == [0.8]
+    assert engine.get_pld_theta() is None
+    assert engine.get_summary_writer() is None
+
+
+def test_train_eval_zero_grad_noops(engine):
+    assert engine.train() is engine and engine.training
+    assert engine.eval().training is False
+    engine.zero_grad()
+    engine.allreduce_gradients()
+
+
+def test_module_state_dict_roundtrip(engine):
+    for batch in random_batches(3, batch_size=32, seed=1):
+        engine.forward(batch)
+        engine.backward()
+        engine.step()
+    sd = engine.module_state_dict()
+    leaves = jax.tree_util.tree_leaves(sd)
+    assert leaves and all(isinstance(l, np.ndarray) for l in leaves)
+    # perturb then restore
+    zeroed = jax.tree_util.tree_map(np.zeros_like, sd)
+    engine.load_module_state_dict(zeroed)
+    z = jax.tree_util.tree_leaves(engine.module_state_dict())
+    assert all(np.all(l == 0) for l in z)
+    engine.load_module_state_dict(sd)
+    back = jax.tree_util.tree_leaves(engine.module_state_dict())
+    for a, b in zip(back, leaves):
+        np.testing.assert_array_equal(a, b)
+    with pytest.raises(ValueError):
+        engine.load_module_state_dict({"bogus": np.zeros(3)})

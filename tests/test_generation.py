@@ -28,6 +28,7 @@ def _greedy_nocache(model, params, prompt, n):
     return np.stack([np.asarray(t) for t in out], axis=1)
 
 
+@pytest.mark.slow
 def test_cached_greedy_matches_full_recompute():
     model, params = _model()
     prompt = np.random.RandomState(0).randint(0, 96, (3, 7)).astype(np.int32)

@@ -115,6 +115,7 @@ def test_gpt_tensor_parallel_matches_single():
     np.testing.assert_allclose(tp_loss, ref, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_chunked_ce_matches_full_logits():
     """loss_chunks={1,4} and the materialized log_softmax reference all
     agree (forward AND gradients) — the chunked path is a pure perf

@@ -49,6 +49,7 @@ def test_hf_gpt2_loss_parity():
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_hf_weights_train_through_engine():
     import deepspeed_tpu
 

@@ -1,0 +1,155 @@
+"""Layer-output forward hooks + gradient stashing (EleutherAI fork
+additions: reference engine.py:227-254 register_forward_hook and
+engine.py:139-140,1156-1161 store_gradients)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT, gpt2_config
+from tests.simple_model import SimpleModel, random_batches
+
+
+def _gpt_engine(gas=1, **over):
+    cfg = gpt2_config("nano", vocab_size=256)
+    config = {
+        "train_batch_size": 8 * gas,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+    }
+    config.update(over)
+    engine, *_ = ds.initialize(model=GPT(cfg), config=config)
+    return engine, cfg
+
+
+def _gpt_batch(seed=0, B=8, S=32, V=256):
+    tokens = jax.random.randint(jax.random.PRNGKey(seed), (B, S + 1), 0, V)
+    return tokens[:, :-1], tokens[:, 1:]
+
+
+def _one_step(engine, gas=1, seed=0):
+    for i in range(gas):
+        loss = engine.forward(_gpt_batch(seed + i))
+        engine.backward()
+    engine.step()
+    return loss
+
+
+def test_forward_hook_fused_path():
+    engine, cfg = _gpt_engine(gas=1)
+    engine.register_forward_hook(layers_to_hook=[0, 2])
+    _one_step(engine)
+    assert sorted(engine.layer_outputs) == [0, 2]
+    out = engine.layer_outputs[0]
+    assert out.shape == (8, 32, cfg.d_model)
+    assert np.isfinite(np.asarray(out, np.float32)).all()
+    # hook outputs track the current step, not the registration-time one
+    before = np.asarray(engine.layer_outputs[2], np.float32)
+    _one_step(engine, seed=7)
+    after = np.asarray(engine.layer_outputs[2], np.float32)
+    assert not np.allclose(before, after)
+
+
+def test_forward_hook_all_and_disable():
+    engine, cfg = _gpt_engine(gas=1)
+    engine.register_forward_hook(layers_to_hook="all")
+    _one_step(engine)
+    assert sorted(engine.layer_outputs) == list(range(cfg.num_layers))
+    engine.register_forward_hook(layers_to_hook=[])
+    assert engine.layer_outputs == {}
+    _one_step(engine)
+    assert engine.layer_outputs == {}
+
+
+def test_forward_hook_micro_accum_path():
+    engine, cfg = _gpt_engine(gas=2)
+    engine.register_forward_hook(layers_to_hook=[1])
+    _one_step(engine, gas=2)
+    assert list(engine.layer_outputs) == [1]
+    assert engine.layer_outputs[1].shape == (8, 32, cfg.d_model)
+
+
+def test_forward_hook_train_batch_scan_path():
+    engine, cfg = _gpt_engine(gas=2)
+    engine.register_forward_hook(layers_to_hook=[0])
+    batches = iter([_gpt_batch(0), _gpt_batch(1)])
+    engine.train_batch(batches)
+    assert engine.layer_outputs[0].shape == (8, 32, cfg.d_model)
+
+
+def test_forward_hook_unsupported_model():
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={"train_batch_size": 32,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0})
+    with pytest.raises(TypeError):
+        engine.register_forward_hook(layers_to_hook=[0])
+
+
+def test_store_gradients_fused_path():
+    engine, _ = _gpt_engine(gas=1)
+    engine.store_gradients = True
+    _one_step(engine)
+    assert engine.stored_gradients is not None
+    g_leaves = jax.tree_util.tree_leaves(engine.stored_gradients)
+    p_leaves = jax.tree_util.tree_leaves(engine.params)
+    assert len(g_leaves) == len(p_leaves)
+    for g, p in zip(g_leaves, p_leaves):
+        assert g.shape == p.shape
+        assert np.isfinite(np.asarray(g, np.float32)).all()
+    norm = sum(float(jnp.sum(jnp.square(g))) for g in g_leaves)
+    assert norm > 0.0
+    # disabling clears the stash and stops re-stashing
+    engine.store_gradients = False
+    assert engine.stored_gradients is None
+    _one_step(engine, seed=3)
+    assert engine.stored_gradients is None
+
+
+def test_store_gradients_cpu_split_path():
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config={"train_batch_size": 32,
+                "gradient_accumulation_steps": 2,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "steps_per_print": 0})
+    engine.store_gradients = True
+    engine.store_gradients_cpu = True
+    it = random_batches(2, batch_size=16, seed=0)
+    for batch in it:
+        engine.forward(batch)
+        engine.backward()
+    engine.step()
+    leaves = jax.tree_util.tree_leaves(engine.stored_gradients)
+    assert leaves and all(isinstance(g, np.ndarray) for g in leaves)
+
+
+@pytest.mark.slow
+def test_store_gradients_match_manual_grad():
+    """Stashed grads equal jax.grad of the same loss (gas=1, no clip)."""
+    engine, _ = _gpt_engine(gas=1)
+    engine.store_gradients = True
+    batch = _gpt_batch(11)
+    params_before = engine.params
+    model = engine.module
+    # engine consumes one rng split per step; replicate it
+    rng_key = engine._rng_key
+    _, expect_rng = jax.random.split(rng_key)
+    expected = jax.grad(
+        lambda p, b: model.loss(p, b, rng=expect_rng, train=True))(
+            jax.tree_util.tree_map(lambda x: x, params_before), batch)
+    loss = engine.forward(batch)
+    engine.backward()
+    engine.step()
+    got = engine.stored_gradients
+    for e, g in zip(jax.tree_util.tree_leaves(expected),
+                    jax.tree_util.tree_leaves(got)):
+        np.testing.assert_allclose(np.asarray(e, np.float32),
+                                   np.asarray(g, np.float32),
+                                   rtol=2e-2, atol=2e-4)
+    assert np.isfinite(float(loss))

@@ -85,6 +85,7 @@ def test_moe_grads_flow_to_all_parts():
     assert float(jnp.abs(grads["experts"]["w1"]).sum()) > 0
 
 
+@pytest.mark.slow
 def test_gpt_moe_trains_expert_parallel():
     cfg = gpt2_config("nano", num_layers=4, num_experts=8, moe_top_k=2,
                       vocab_size=128, max_seq_len=32)

@@ -85,3 +85,40 @@ print("LOAD OK")
                        text=True, env=env, timeout=600)
     assert r.returncode == 0 and "LOAD OK" in r.stdout, \
         r.stdout[-2000:] + r.stderr[-2000:]
+
+
+@pytest.mark.slow
+def test_two_process_infinity_dp():
+    """Multi-host ZeRO-Infinity: each process streams on its local batch
+    shard; CrossProcessGradReducer averages grads, so losses and updated
+    masters must agree across workers (replica-divergence guard)."""
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_infinity_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    lines = [ln for out in outs for ln in out.splitlines()
+             if ln.startswith("MHINF")]
+    assert len(lines) == nprocs, outs
+    losses = {ln.split("loss=")[1].split()[0] for ln in lines}
+    psums = {ln.split("params0=")[1].split()[0] for ln in lines}
+    assert len(losses) == 1 and len(psums) == 1, lines

@@ -203,6 +203,7 @@ def test_onebit_lamb_converges_quadratic():
     assert np.isfinite(np.asarray(params["w"])).all()
 
 
+@pytest.mark.slow
 def test_onebit_lamb_through_engine():
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT, gpt2_config

@@ -62,6 +62,7 @@ def test_ring_seq1_falls_back():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_sequence_parallel_through_engine():
     cfg = gpt2_config("nano", sequence_parallel=True, max_seq_len=64)
     model = GPT(cfg)
