@@ -203,6 +203,7 @@ class DeepSpeedEngine:
         self._store_gradients = False
         self.store_gradients_cpu = False
         self.stored_gradients = None
+        self.training = True  # torch Module-parity default (train()/eval())
 
     def _configure_infinity(self, init_key):
         zc = self._config.zero_config
@@ -387,7 +388,12 @@ class DeepSpeedEngine:
             grad_norm = jnp.asarray(0.0, jnp.float32)
             if clip > 0.0:
                 grads, grad_norm = clip_grad_norm(grads, clip)
-            extras = {"grads": grads} if store_grads else {}
+            extras = {}
+            if store_grads:
+                # zeroed on overflow: the step is skipped, so consumers
+                # (e.g. GradientNoiseScale) must not ingest inf/nan grads
+                extras["grads"] = jax.tree_util.tree_map(
+                    lambda g: jnp.where(overflow, 0.0, g), grads)
             # grads here are already DP-averaged (XLA psum at the loss-mean
             # boundary), so a 1-bit optimizer on this path runs dense
             # (comm_axis=None). The compressed hot path is
@@ -435,7 +441,9 @@ class DeepSpeedEngine:
                 grads, grad_norm = clip_grad_norm(grads, clip)
             extras = {"layer_outputs": caps}
             if store_grads:
-                extras["grads"] = grads
+                # zeroed on overflow (the step is skipped; see apply_step)
+                extras["grads"] = jax.tree_util.tree_map(
+                    lambda g: jnp.where(overflow, 0.0, g), grads)
             new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
             sel = lambda new, old: jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new, old)
@@ -862,7 +870,8 @@ class DeepSpeedEngine:
         """When True, each optimizer step stashes the post-clip, unscaled,
         DP-averaged gradient pytree in engine.stored_gradients (reference
         engine.py:139-140,1156-1161; set store_gradients_cpu for a host
-        numpy copy). Flipping this retraces the step program."""
+        numpy copy). On an overflow (skipped) step the stash is zeros —
+        never inf/nan. Flipping this retraces the step program."""
         return self._store_gradients
 
     @store_gradients.setter
@@ -1032,16 +1041,20 @@ class DeepSpeedEngine:
         if self._config.prescale_gradients:
             denom /= float(self._config.gradient_predivide_factor or 1.0)
         grad_leaves = jax.tree_util.tree_leaves(self._grad_acc)
-        if self._store_gradients:
-            # host path: stash pre-clip unscaled grads (clipping happens
-            # inside the native step; documented divergence from the
-            # device path's post-clip stash)
-            self.stored_gradients = jax.tree_util.tree_unflatten(
-                jax.tree_util.tree_structure(self._grad_acc),
-                [np.asarray(g, np.float32) / denom for g in grad_leaves])
         new_params, overflow, _norm = self._offload.step(
             grad_leaves, denom, self._current_lr(),
             clip=float(self._config.gradient_clipping or 0.0))
+        if self._store_gradients:
+            # host path: stash pre-clip unscaled grads (clipping happens
+            # inside the native step; documented divergence from the
+            # device path's post-clip stash); zeroed on overflow like the
+            # device paths — the step was skipped
+            treedef = jax.tree_util.tree_structure(self._grad_acc)
+            self.stored_gradients = jax.tree_util.tree_unflatten(
+                treedef,
+                [np.zeros(np.shape(g), np.float32) if overflow
+                 else np.asarray(g, np.float32) / denom
+                 for g in grad_leaves])
         self._scaler_state = self.loss_scaler.jit_update(
             self._scaler_state, jnp.asarray(overflow))
         self.global_steps += 1
@@ -1424,16 +1437,22 @@ class DeepSpeedEngine:
             if expect != got:
                 raise ValueError(
                     f"state_dict tree mismatch: {got} != {expect}")
+        self._install_module_weights(state_dict)
+
+    def _install_module_weights(self, host_tree):
+        """Weight install shared by load_checkpoint and
+        load_module_state_dict. Infinity: host masters only (the streamed
+        tree must never fully materialize on device). Offload: reseed the
+        fp32 masters and keep compute-dtype working weights on device.
+        Otherwise: device fp32 tree under the ZeRO plan's shardings."""
         if self._infinity is not None:
-            # stays on host — the streamed tree must never fully
-            # materialize on device
-            self._infinity.load_masters_tree(state_dict)
+            self._infinity.load_masters_tree(host_tree)
             return
-        params = jax.tree_util.tree_map(jnp.asarray, state_dict)
+        params = jax.tree_util.tree_map(jnp.asarray, host_tree)
         if self._offload is not None:
             self._offload.masters = [
                 np.asarray(l, np.float32).ravel().copy()
-                for l in jax.tree_util.tree_leaves(state_dict)]
+                for l in jax.tree_util.tree_leaves(host_tree)]
             params = jax.tree_util.tree_map(
                 lambda p: p.astype(self.compute_dtype)
                 if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
@@ -1620,15 +1639,7 @@ class DeepSpeedEngine:
                                          "loss_scaler")}
             return ckpt_dir, client_state
 
-        params = jax.tree_util.tree_map(jnp.asarray, model_state["module"])
-        if self._offload is not None:
-            self._offload.masters = [
-                np.asarray(l, np.float32).ravel().copy()
-                for l in jax.tree_util.tree_leaves(model_state["module"])]
-            params = jax.tree_util.tree_map(
-                lambda p: p.astype(self.compute_dtype)
-                if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
-        self._params = jax.device_put(params, self.zero_plan.param_shardings())
+        self._install_module_weights(model_state["module"])
         if load_optimizer_states and optim_state is not None and \
                 self._offload is not None and optim_state.get("offload"):
             self._offload.load_state_dict(optim_state["optimizer_state"])

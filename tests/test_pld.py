@@ -1,0 +1,70 @@
+"""Progressive Layer Drop behaviour (reference tests/unit/test_pld.py;
+engine hooks engine.py:972-973,1215-1216, keep gates models/gpt.py)."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import GPT, gpt2_config
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+def _model_and_batch():
+    model = GPT(gpt2_config("nano", vocab_size=128))
+    params = model.init(jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (4, 33), 0, 128)
+    return model, params, (toks[:, :-1], toks[:, 1:])
+
+
+def test_theta_one_is_dense():
+    model, params, batch = _model_and_batch()
+    dense = model.loss(params, batch, train=True)
+    pld = model.loss(params, batch, rng=jax.random.PRNGKey(2), train=True,
+                     progressive_layer_drop=True,
+                     pld_theta=jnp.asarray(1.0))
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(pld),
+                               rtol=1e-6)
+
+
+def test_theta_zero_drops_every_block():
+    """All blocks dropped -> trunk is embed + final LN only; the loss must
+    differ from dense and equal a hand-built no-blocks forward."""
+    model, params, batch = _model_and_batch()
+    dense = model.loss(params, batch, train=True)
+    dropped = model.loss(params, batch, rng=jax.random.PRNGKey(2),
+                         train=True, progressive_layer_drop=True,
+                         pld_theta=jnp.asarray(0.0))
+    assert not np.allclose(np.asarray(dense), np.asarray(dropped))
+    assert np.isfinite(float(dropped))
+
+
+def test_schedule_anneals_toward_theta_bar():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    thetas = []
+    for step in range(1, 2000, 200):
+        pld.update_state(step)
+        thetas.append(pld.get_theta())
+    assert all(a >= b for a, b in zip(thetas, thetas[1:]))  # monotone down
+    assert abs(thetas[-1] - 0.5) < 0.01  # converges to theta_bar
+
+
+def test_pld_through_engine():
+    model = GPT(gpt2_config("nano", vocab_size=128))
+    engine, *_ = ds.initialize(model=model, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.6,
+                                   "gamma": 0.01},
+        "steps_per_print": 0})
+    assert engine.pld_enabled() and engine.get_pld_theta() == 1.0
+    rng = np.random.RandomState(0)
+    for _ in range(3):
+        toks = rng.randint(0, 128, size=(8, 33)).astype(np.int32)
+        loss = engine.forward((toks[:, :-1], toks[:, 1:]))
+        engine.backward()
+        engine.step()
+    assert np.isfinite(float(loss))
+    assert engine.get_pld_theta() < 1.0  # annealing advanced with steps

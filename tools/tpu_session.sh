@@ -7,4 +7,5 @@ timeout 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
 timeout 2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
 timeout 2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
 timeout 3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
+timeout 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
 echo done
