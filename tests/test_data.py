@@ -1,0 +1,133 @@
+"""Dataloader + CLI-argument tests (reference tests/unit/test_data.py,
+test_ds_arguments.py)."""
+
+import argparse
+
+import numpy as np
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              RepeatingLoader)
+
+
+def _dataset(n=40, d=4):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(d).astype(np.float32), np.int32(i)) for i in range(n)]
+
+
+def test_batching_shapes_and_length():
+    data = _dataset(40)
+    loader = DeepSpeedDataLoader(data, batch_size=8,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    assert len(loader) == 5
+    batches = list(loader)
+    assert len(batches) == 5
+    x, y = batches[0]
+    assert x.shape == (8, 4) and y.shape == (8,)
+
+
+def test_drop_last_false_yields_tail():
+    data = _dataset(42)
+    loader = DeepSpeedDataLoader(data, batch_size=8, drop_last=False,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 6
+    assert batches[-1][0].shape[0] == 2
+
+
+def test_shuffle_is_epoch_deterministic():
+    data = _dataset(32)
+    loader = DeepSpeedDataLoader(data, batch_size=8, shuffle=True,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    a = [b[1].tolist() for b in loader]
+    b = [b[1].tolist() for b in loader]
+    assert a == b                       # same epoch -> same order
+    loader.set_epoch(1)
+    c = [b2[1].tolist() for b2 in loader]
+    assert a != c                       # new epoch -> reshuffled
+    assert sorted(sum(a, [])) == sorted(sum(c, []))  # same coverage
+
+
+def test_process_striding_partitions_samples():
+    """DistributedSampler semantics: shards are disjoint, equal-length,
+    and wrap-pad to cover the dataset (reference dataloader.py:33-101)."""
+    data = _dataset(32)
+    seen = []
+    for rank in range(4):
+        loader = DeepSpeedDataLoader(data, batch_size=8,
+                                     data_parallel_world_size=4,
+                                     data_parallel_rank=rank)
+        assert len(loader) == 4          # 32/4 ranks / 2-per-shard... 8/4=2
+        ids = [int(i) for b in loader for i in b[1]]
+        assert len(ids) == 8
+        seen.append(set(ids))
+    assert set().union(*seen) == set(range(32))
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not (seen[i] & seen[j])
+
+
+def test_uneven_dataset_pads_by_wrapping():
+    data = _dataset(30)  # not divisible by 4 shards
+    lens = set()
+    union = set()
+    for rank in range(4):
+        loader = DeepSpeedDataLoader(data, batch_size=4,
+                                     data_parallel_world_size=4,
+                                     data_parallel_rank=rank)
+        ids = [int(i) for b in loader for i in b[1]]
+        lens.add(len(ids))
+        union |= set(ids)
+    assert len(lens) == 1               # every shard yields the same count
+    assert union == set(range(30))      # full coverage despite padding
+
+
+def test_indivisible_batch_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedDataLoader(_dataset(16), batch_size=6,
+                            data_parallel_world_size=4,
+                            data_parallel_rank=0)
+
+
+def test_dict_samples_collate():
+    data = [{"x": np.ones(3, np.float32) * i, "y": np.int32(i)}
+            for i in range(8)]
+    loader = DeepSpeedDataLoader(data, batch_size=4,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    batch = next(iter(loader))
+    assert batch["x"].shape == (4, 3) and batch["y"].shape == (4,)
+
+
+def test_repeating_loader_cycles():
+    data = _dataset(16)
+    loader = DeepSpeedDataLoader(data, batch_size=8,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    rep = iter(RepeatingLoader(loader))
+    batches = [next(rep) for _ in range(5)]  # 2-batch epoch cycled 2.5x
+    np.testing.assert_array_equal(batches[0][0], batches[2][0])
+    np.testing.assert_array_equal(batches[1][0], batches[3][0])
+
+
+# -- CLI arguments (reference test_ds_arguments.py) ------------------------
+
+def test_add_config_arguments_parses():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--other", type=int, default=1)
+    parser = ds.add_config_arguments(parser)
+    args = parser.parse_args(
+        ["--deepspeed", "--deepspeed_config", "cfg.json", "--other", "2"])
+    assert args.deepspeed is True
+    assert args.deepspeed_config == "cfg.json"
+    assert args.other == 2
+
+
+def test_add_config_arguments_defaults():
+    parser = ds.add_config_arguments(argparse.ArgumentParser())
+    args = parser.parse_args([])
+    assert args.deepspeed is False and args.deepspeed_config is None

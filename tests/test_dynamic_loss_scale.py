@@ -1,0 +1,96 @@
+"""Dynamic loss-scale schedule semantics (reference
+tests/unit/test_dynamic_loss_scale.py — overflow halving, window growth,
+hysteresis/delayed shift, min-scale floor), exercised on the branchless
+jit-state update the engine carries through its step programs."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
+                                                    LossScaler,
+                                                    make_scaler_state,
+                                                    update_scale_jit)
+
+
+def _run(state, overflows, **kw):
+    for ov in overflows:
+        state = update_scale_jit(state, jnp.asarray(bool(ov)), **kw)
+    return state
+
+
+def test_overflow_halves_scale():
+    state = make_scaler_state(2 ** 8)
+    state = _run(state, [True])
+    assert float(state["cur_scale"]) == 2 ** 7
+
+
+def test_consecutive_overflows_keep_halving():
+    state = make_scaler_state(2 ** 8)
+    state = _run(state, [True] * 3)
+    assert float(state["cur_scale"]) == 2 ** 5
+
+
+def test_scale_grows_after_clean_window():
+    state = make_scaler_state(2 ** 8)
+    state = _run(state, [False] * 10, scale_window=10)
+    assert float(state["cur_scale"]) == 2 ** 9
+    # a second full window doubles again
+    state = _run(state, [False] * 10, scale_window=10)
+    assert float(state["cur_scale"]) == 2 ** 10
+
+
+def test_overflow_resets_window():
+    state = make_scaler_state(2 ** 8)
+    state = _run(state, [False] * 5 + [True] + [False] * 5, scale_window=10)
+    # growth window restarts at the overflow: 5 clean steps < 10, no growth
+    assert float(state["cur_scale"]) == 2 ** 7
+    state = _run(state, [False] * 5, scale_window=10)
+    assert float(state["cur_scale"]) == 2 ** 8  # 10 clean since overflow
+
+
+def test_min_scale_floor():
+    state = make_scaler_state(2.0)
+    state = _run(state, [True] * 5, min_scale=1.0)
+    assert float(state["cur_scale"]) == 1.0
+
+
+def test_hysteresis_delays_the_shift():
+    """delayed_shift=2: the FIRST overflow only decrements hysteresis;
+    the second one actually halves (reference DynamicLossScaler
+    delayed-shift semantics)."""
+    state = make_scaler_state(2 ** 8)
+    state["cur_hysteresis"] = jnp.asarray(2, jnp.int32)
+    state = _run(state, [True], delayed_shift=2)
+    assert float(state["cur_scale"]) == 2 ** 8      # absorbed
+    assert int(state["cur_hysteresis"]) == 1
+    state = _run(state, [True], delayed_shift=2)
+    assert float(state["cur_scale"]) == 2 ** 7      # now shifts
+
+
+def test_hysteresis_recovers_on_clean_window():
+    state = make_scaler_state(2 ** 8)
+    state["cur_hysteresis"] = jnp.asarray(1, jnp.int32)
+    state = _run(state, [False] * 10, scale_window=10, delayed_shift=2)
+    assert int(state["cur_hysteresis"]) == 2        # restocked at growth
+
+
+def test_static_scaler_never_moves():
+    s = LossScaler(scale=128.0)
+    st = s.jit_state()
+    st = s.jit_update(st, jnp.asarray(True))
+    st = s.jit_update(st, jnp.asarray(False))
+    assert float(st["cur_scale"]) == 128.0
+
+
+def test_dynamic_scaler_class_roundtrip():
+    s = DynamicLossScaler(init_scale=2 ** 16, scale_window=100,
+                          min_scale=1.0)
+    st = s.jit_state()
+    assert float(st["cur_scale"]) == 2 ** 16
+    st = s.jit_update(st, jnp.asarray(True))
+    assert float(st["cur_scale"]) == 2 ** 15
+    sd = {k: np.asarray(v) for k, v in st.items()}
+    st2 = {k: jnp.asarray(v) for k, v in sd.items()}  # ckpt round-trip
+    st2 = s.jit_update(st2, jnp.asarray(False))
+    assert float(st2["cur_scale"]) == 2 ** 15

@@ -1,0 +1,67 @@
+"""Mesh construction / axis math (reference tests/unit/test_topology.py —
+PipelineParallelGrid rank/axes mapping; here the grid IS the mesh)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu import comm
+from deepspeed_tpu.comm import mesh as mesh_mod
+
+
+def test_default_all_data():
+    info = comm.make_mesh(set_current=False)
+    assert info.size == 8
+    assert info.get_data_parallel_world_size() == 8
+    assert info.get_model_parallel_world_size() == 1
+
+
+def test_minus_one_infers_remainder():
+    info = comm.make_mesh(data=-1, model=2, set_current=False)
+    assert info.axis_size("data") == 4 and info.axis_size("model") == 2
+    info = comm.make_mesh(data=-1, model=2, pipe=2, set_current=False)
+    assert info.axis_size("data") == 2
+
+
+def test_full_3d_mesh_axes():
+    info = comm.make_mesh(data=2, model=2, pipe=2, set_current=False)
+    assert info.get_data_parallel_world_size() == 2
+    assert info.get_model_parallel_world_size() == 2
+    assert info.get_pipe_parallel_world_size() == 2
+    assert info.get_seq_parallel_world_size() == 1
+    assert info.mesh.shape["data"] == 2
+
+
+def test_oversubscribed_raises():
+    with pytest.raises(ValueError):
+        comm.make_mesh(data=4, model=4, set_current=False)
+    with pytest.raises(ValueError):
+        comm.make_mesh(data=3, set_current=False)  # 3 does not divide 8
+
+
+def test_underused_devices_raise():
+    with pytest.raises(ValueError):
+        comm.make_mesh(data=1, model=1, set_current=False)
+
+
+def test_sharding_and_replicated_specs():
+    info = comm.make_mesh(data=4, model=2, set_current=False)
+    s = info.sharding("data", None)
+    x = jax.device_put(np.zeros((8, 4), np.float32), s)
+    assert x.sharding.is_equivalent_to(s, 2)
+    r = info.replicated()
+    y = jax.device_put(np.zeros((3,), np.float32), r)
+    assert y.sharding.is_fully_replicated
+
+
+def test_current_mesh_context():
+    info = comm.make_mesh(data=8, set_current=False)
+    assert mesh_mod.peek_mesh() is None
+    with mesh_mod.use_mesh(info):
+        assert mesh_mod.get_current_mesh() is info
+    assert mesh_mod.peek_mesh() is None
+
+
+def test_largest_divisible_axis():
+    assert mesh_mod.largest_divisible_axis((3, 16, 7), 8) == 1
+    assert mesh_mod.largest_divisible_axis((5, 7), 8) is None

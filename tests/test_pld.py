@@ -37,7 +37,14 @@ def test_theta_zero_drops_every_block():
                          train=True, progressive_layer_drop=True,
                          pld_theta=jnp.asarray(0.0))
     assert not np.allclose(np.asarray(dense), np.asarray(dropped))
-    assert np.isfinite(float(dropped))
+    # a zero-layer model with the same embeddings/head IS the all-dropped
+    # network (dropped blocks contribute neither output nor aux)
+    no_blocks = GPT(gpt2_config("nano", vocab_size=128, num_layers=0))
+    params0 = dict(params)
+    params0["blocks"] = []
+    expected = no_blocks.loss(params0, batch, train=True)
+    np.testing.assert_allclose(np.asarray(dropped), np.asarray(expected),
+                               rtol=1e-6)
 
 
 def test_schedule_anneals_toward_theta_bar():

@@ -131,8 +131,12 @@ def sparse_sweep(steps=20):
     for S in seqs:
         q, k, v = (jax.random.normal(jax.random.PRNGKey(i), (B, S, H, D),
                                      jnp.bfloat16) for i in range(3))
-        cfgs = {"fixed": FixedSparsityConfig(num_heads=H, block=block),
-                "bigbird": BigBirdSparsityConfig(num_heads=H, block=block)}
+        # unidirectional so every variant times the SAME causal operator
+        # (flash paths run causal=True below)
+        cfgs = {"fixed": FixedSparsityConfig(num_heads=H, block=block,
+                                             attention="unidirectional"),
+                "bigbird": BigBirdSparsityConfig(
+                    num_heads=H, block=block, attention="unidirectional")}
         variants = {}
         if on_tpu:  # Pallas kernels on CPU run in interpret mode — not a
             # meaningful timing; the CPU smoke covers the XLA paths only
@@ -180,9 +184,10 @@ def main():
 
     backend = jax.default_backend()
     print(f"backend={backend} devices={jax.device_count()}", flush=True)
-    if args.phase == "sparse":
+    if args.phase in ("all", "sparse"):
         sparse_sweep(steps=3 if backend == "cpu" else args.steps)
-        return
+        if args.phase == "sparse":
+            return
     peak = chip_matmul_tflops(1024 if backend == "cpu" else 4096,
                               10 if backend == "cpu" else 50)
     print(f"chip dense bf16 matmul: {peak:.1f} TFLOPs", flush=True)

@@ -8,4 +8,6 @@ timeout 2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_c
 timeout 2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
 timeout 3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
 timeout 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
+timeout 1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
+timeout 1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
 echo done
