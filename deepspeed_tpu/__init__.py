@@ -18,7 +18,8 @@ from .runtime.engine import DeepSpeedEngine  # noqa: F401
 from .runtime.pipe.engine import PipelineEngine  # noqa: F401
 from .runtime.pipe.module import (PipelineModule, LayerSpec,  # noqa: F401
                                   TiedLayerSpec)
-from .runtime import pipe  # noqa: F401
+from . import pipe  # noqa: F401  (the deepspeed.pipe parity package —
+#                    NOT runtime.pipe, which would shadow it)
 from .runtime.lr_schedules import add_tuning_arguments  # noqa: F401
 from .runtime.config import DeepSpeedConfig, DeepSpeedConfigError  # noqa: F401
 from .runtime.constants import (ADAM_OPTIMIZER,  # noqa: F401

@@ -48,6 +48,38 @@ def op_report(out=sys.stdout):
     print("-" * 74, file=out)
 
 
+def _probe_devices(timeout_s: int = 60):
+    """Device inventory via a subprocess with a hard timeout: a status
+    report must never hang, and accelerator-plugin backend init CAN hang
+    indefinitely when its transport is down (observed with the tunneled
+    TPU plugin — same hardening as bench.py's probe)."""
+    import json
+    import subprocess
+
+    # honor an explicit JAX_PLATFORMS in the child: the ambient
+    # sitecustomize may pin another platform via jax.config (which beats
+    # the env var), so re-assert the user's choice before first use
+    code = ("import os, jax, json\n"
+            "p = os.environ.get('JAX_PLATFORMS')\n"
+            "if p:\n"
+            "    jax.config.update('jax_platforms', p)\n"
+            "d = jax.devices()\n"
+            "print(json.dumps([jax.default_backend(), len(d), "
+            "d[0].device_kind]))")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, timeout=timeout_s, text=True)
+        if r.returncode == 0:
+            backend, n, kind = json.loads(r.stdout.strip().splitlines()[-1])
+            return backend, f"{n} x {kind}"
+        return None, f"unavailable (rc={r.returncode})"
+    except subprocess.TimeoutExpired:
+        return None, (f"unavailable (backend init exceeded {timeout_s}s — "
+                      "accelerator transport down?)")
+    except Exception as e:  # pragma: no cover
+        return None, f"unavailable ({type(e).__name__}: {e})"
+
+
 def debug_report(out=sys.stdout):
     import jax
 
@@ -66,12 +98,10 @@ def debug_report(out=sys.stdout):
                          importlib.import_module(mod).__version__))
         except Exception:
             rows.append((f"{mod} version", "not installed"))
-    try:
-        devs = jax.devices()
-        rows.append(("backend", jax.default_backend()))
-        rows.append(("devices", f"{len(devs)} x {devs[0].device_kind}"))
-    except Exception as e:
-        rows.append(("devices", f"unavailable ({e})"))
+    backend, devices = _probe_devices()
+    if backend is not None:
+        rows.append(("backend", backend))
+    rows.append(("devices", devices))
     print("DeepSpeed-TPU general environment info:", file=out)
     for name, val in rows:
         print(f"{name} {'.' * max(1, 24 - len(name))} {val}", file=out)

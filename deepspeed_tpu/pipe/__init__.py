@@ -1,0 +1,9 @@
+"""`deepspeed_tpu.pipe` — import-path parity with the reference's
+top-level `deepspeed/pipe/__init__.py` (re-exports the pipeline module
+surface so `from deepspeed_tpu.pipe import PipelineModule` works)."""
+
+from ..runtime.pipe.module import (LayerSpec, PipelineModule,  # noqa: F401
+                                   TiedLayerSpec)
+from ..runtime.pipe.engine import PipelineEngine  # noqa: F401
+from ..runtime.pipe.schedule import (InferenceSchedule,  # noqa: F401
+                                     TrainSchedule)

@@ -1,0 +1,99 @@
+"""SQuAD-style span-extraction fine-tune e2e (reference
+tests/model/BingBertSquad/test_e2e_squad.py asserts EM/F1 after a real
+SQuAD run; this is the CI-scale analogue: a synthetic span task whose
+answer is recoverable from the input, fine-tuned through the engine on
+a QA head over the in-tree BERT encoder via the TrainModule protocol).
+
+Also exercises the bring-your-own-model path (runtime/module.py
+TrainModule) with a custom head on a stock encoder."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import Bert, bert_config
+from deepspeed_tpu.runtime.module import TrainModule
+
+V, S = 128, 32
+MARK_S, MARK_E = 7, 8  # answer span runs from token MARK_S to token MARK_E
+
+
+class BertForQA(TrainModule):
+    """BERT encoder + start/end span head (BingBertSquad head shape)."""
+
+    def __init__(self, cfg):
+        self.bert = Bert(cfg)
+        self.cfg = cfg
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"bert": self.bert.init(k1),
+                "qa": (0.02 * jax.random.normal(
+                    k2, (self.cfg.d_model, 2))).astype(jnp.float32)}
+
+    def logits(self, params, batch, rng=None, train=False):
+        x = self.bert.encode(params["bert"], batch["input_ids"],
+                             rng=rng, train=train)
+        span = x @ params["qa"].astype(x.dtype)  # [B, S, 2]
+        return span[..., 0], span[..., 1]
+
+    def loss(self, params, batch, rng=None, train=True):
+        start_logits, end_logits = self.logits(params, batch, rng=rng,
+                                               train=train)
+        lp_s = jax.nn.log_softmax(start_logits.astype(jnp.float32), -1)
+        lp_e = jax.nn.log_softmax(end_logits.astype(jnp.float32), -1)
+        nll = -jnp.take_along_axis(lp_s, batch["start"][:, None], 1) \
+              - jnp.take_along_axis(lp_e, batch["end"][:, None], 1)
+        return jnp.mean(nll) / 2
+
+
+def synth_batch(rng, B):
+    """Sequences where the answer span is delimited by unique MARK_S /
+    MARK_E tokens — exactly recoverable from content, so EM must
+    approach 1 after fine-tuning."""
+    ids = rng.randint(10, V, size=(B, S)).astype(np.int32)
+    starts = rng.randint(1, S - 3, size=(B,)).astype(np.int32)
+    ends = (starts + 2).astype(np.int32)
+    for i in range(B):
+        ids[i, starts[i]] = MARK_S
+        ids[i, ends[i]] = MARK_E
+    return {"input_ids": ids, "start": starts, "end": ends}
+
+
+def exact_match(model, params, batch):
+    s_log, e_log = model.logits(params, batch)
+    s_hat = np.asarray(jnp.argmax(s_log, -1))
+    e_hat = np.asarray(jnp.argmax(e_log, -1))
+    return float(np.mean((s_hat == batch["start"]) &
+                         (e_hat == batch["end"])))
+
+
+@pytest.mark.slow
+def test_squad_style_finetune_em():
+    cfg = bert_config("bert-base", num_layers=2, num_heads=4, d_model=64,
+                      vocab_size=V, max_seq_len=S,
+                      attn_dropout=0.0, hidden_dropout=0.0)
+    model = BertForQA(cfg)
+    engine, *_ = ds.initialize(model=model, config={
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2},
+        "steps_per_print": 0})
+    rng = np.random.RandomState(0)
+    eval_batch = synth_batch(rng, 64)
+    em0 = exact_match(model, engine.params, eval_batch)
+    losses = []
+    for _ in range(60):
+        batch = synth_batch(rng, 32)
+        losses.append(float(engine.forward(batch)))
+        engine.backward()
+        engine.step()
+    em1 = exact_match(model, engine.params, eval_batch)
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    # the reference asserts absolute EM/F1 after real SQuAD; here the
+    # synthetic answer is fully recoverable, so EM must become strong
+    assert em0 < 0.1 and em1 > 0.8, (em0, em1)

@@ -61,6 +61,12 @@ def main():
     import deepspeed_tpu as ds
     from deepspeed_tpu.models import Bert, bert_config
 
+    # stdout must be EXACTLY the result JSON (tpu_session.sh redirects it
+    # to a .json artifact) — route the framework logger to stderr
+    import logging
+    for h in logging.getLogger("deepspeed_tpu").handlers:
+        h.setStream(sys.stderr)
+
     n_dev = jax.device_count()
     if args.smoke:
         cfg = bert_config("bert-base", num_layers=2, num_heads=4, d_model=64,

@@ -10,4 +10,5 @@ timeout 3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_swee
 timeout 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
 timeout 1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
 timeout 1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
+timeout 1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
 echo done
