@@ -85,9 +85,9 @@ def _init_caches(model: GPT, B, L, dtype):
     return [(z(), z()) for _ in range(cfg.num_layers)]
 
 
-@partial(jax.jit, static_argnums=(0, 3, 5, 6))
+@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8))
 def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
-                  cache_len):
+                  cache_len, top_k, top_p):
     B, T = prompt.shape
     caches = _init_caches(model, B, cache_len, params["wte"].dtype)
     logits, caches = _forward_cached(model, params, prompt, caches, 0)
@@ -98,7 +98,21 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
         greedy = jnp.argmax(logits, axis=-1)
         if temperature == 0.0:
             return greedy
-        return jax.random.categorical(rng, logits / temperature, axis=-1)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k > 0:
+            # mask everything below the k-th largest logit
+            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+            logits = jnp.where(logits < kth, -jnp.inf, logits)
+        if top_p < 1.0:
+            # nucleus: keep the smallest set with cumulative prob > top_p
+            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # number of tokens kept = first index where cum exceeds top_p
+            keep = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)
+            cutoff = jnp.take_along_axis(sorted_logits, keep - 1, axis=-1)
+            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        return jax.random.categorical(rng, logits, axis=-1)
 
     def step(carry, _):
         logits, flat_caches, pos, rng = carry
@@ -118,10 +132,13 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
 
 def generate(model: GPT, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
-             cache_len: Optional[int] = None):
+             cache_len: Optional[int] = None, top_k: int = 0,
+             top_p: float = 1.0):
     """Generate continuations. prompt [B, T] int32; returns
     [B, max_new_tokens]. temperature 0 = greedy; otherwise categorical
-    sampling with `rng`. The model's dropout must be 0 (inference)."""
+    sampling with `rng`, optionally truncated to the top_k highest
+    logits and/or the top_p nucleus (HF-style semantics: k first, then
+    p). The model's dropout must be 0 (inference)."""
     cfg = model.config
     if cfg.num_experts > 1 or cfg.pipeline_stages > 1:
         raise NotImplementedError(
@@ -139,6 +156,9 @@ def generate(model: GPT, params, prompt, max_new_tokens: int,
                          f"{max_new_tokens}")
     if rng is None:
         rng = jax.random.PRNGKey(0)
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_k must be >= 0 and 0 < top_p <= 1, got "
+                         f"{top_k}, {top_p}")
     return _generate_jit(model, params, jnp.asarray(prompt),
                          int(max_new_tokens), rng, float(temperature),
-                         int(L))
+                         int(L), int(top_k), float(top_p))

@@ -1,3 +1,4 @@
 from .handle import AsyncIOHandle, aio_read, aio_write
+from ..op_builder import AsyncIOBuilder  # reference ops/aio exports it
 
-__all__ = ["AsyncIOHandle", "aio_read", "aio_write"]
+__all__ = ["AsyncIOHandle", "aio_read", "aio_write", "AsyncIOBuilder"]

@@ -5,5 +5,5 @@ surface so `from deepspeed_tpu.pipe import PipelineModule` works)."""
 from ..runtime.pipe.module import (LayerSpec, PipelineModule,  # noqa: F401
                                    TiedLayerSpec)
 from ..runtime.pipe.engine import PipelineEngine  # noqa: F401
-from ..runtime.pipe.schedule import (InferenceSchedule,  # noqa: F401
-                                     TrainSchedule)
+from ..runtime.pipe.schedule import (DataParallelSchedule,  # noqa: F401
+                                     InferenceSchedule, TrainSchedule)

@@ -84,6 +84,12 @@ def reset():
                    cpu_checkpointing=False, num_checkpoints=None)
 
 
+def partition_activations_in_checkpoint(partition_activation):
+    """Toggle activation partitioning at runtime (reference
+    checkpointing.py:699-703)."""
+    _CONFIG["partition_activations"] = bool(partition_activation)
+
+
 def _partition_spec_for(x) -> Optional[PartitionSpec]:
     """Shard the largest divisible dim over the model axis (the reference
     flattens and scatters 1/mp per rank, :240-292; sharding a whole dim is

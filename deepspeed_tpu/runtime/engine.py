@@ -291,8 +291,29 @@ class DeepSpeedEngine:
             return OnebitAdam(**params)
         if name == const.ONEBIT_LAMB_OPTIMIZER:
             return OnebitLamb(**params)
+        if name.startswith("optax:"):
+            # any optax optimizer by name — the torch.optim passthrough
+            # analogue (reference engine.py:702-757); gated under ZeRO by
+            # zero_allow_untested_optimizer (reference :655-664)
+            if self._config.zero_enabled and \
+                    not self._config.zero_allow_untested_optimizer:
+                raise ValueError(
+                    f"{name!r} is untested with ZeRO; set "
+                    "zero_allow_untested_optimizer to proceed")
+            import optax
+
+            from .optax_adapter import OptaxOptimizer
+
+            fn_name = name.split(":", 1)[1]
+            fn = getattr(optax, fn_name, None)
+            if fn is None:
+                raise ValueError(f"optax has no optimizer {fn_name!r}")
+            lr = params.pop("lr", params.pop("learning_rate", 1e-3))
+            wrapped = optax.inject_hyperparams(fn)(learning_rate=lr,
+                                                   **params)
+            return OptaxOptimizer(wrapped, lr=lr)
         raise ValueError(f"unknown optimizer {name!r}; supported: "
-                         f"{const.DEEPSPEED_OPTIMIZERS}")
+                         f"{const.DEEPSPEED_OPTIMIZERS} or 'optax:<name>'")
 
     def _configure_offload(self, params):
         """ZeRO-Offload: host-RAM or NVMe optimizer state + native CPU-Adam
@@ -1570,6 +1591,11 @@ class DeepSpeedEngine:
             **self._client_state(client_state),
         }
         opt_to_save = self._opt_state
+        if opt_to_save is not None and hasattr(self.optimizer,
+                                               "serialize_state"):
+            # optimizers with msgpack-hostile state (optax namedtuples)
+            # flatten themselves; deserialize_state rebuilds on load
+            opt_to_save = self.optimizer.serialize_state(opt_to_save)
         if getattr(self, "_onebit_hot", False) and opt_to_save is not None:
             # per-rank error-feedback buffers ([dp, *param] fp32 x2) are
             # re-zeroed on load anyway — don't write 2x dp x model-size of
@@ -1646,6 +1672,9 @@ class DeepSpeedEngine:
         elif load_optimizer_states and optim_state is not None and \
                 self._offload is None:
             restored = optim_state["optimizer_state"]
+            if hasattr(self.optimizer, "deserialize_state"):
+                restored = self.optimizer.deserialize_state(
+                    restored, self._params)
             if getattr(self, "_onebit_hot", False):
                 # per-rank error-feedback buffers are world-size-shaped;
                 # on any resume they restart at zero for the CURRENT dp

@@ -1,0 +1,102 @@
+"""Adapter exposing any optax GradientTransformation as an engine
+optimizer.
+
+Reference analogue: the engine's torch.optim passthrough — reference
+_configure_basic_optimizer falls back to any torch optimizer class
+(engine.py:702-757) and `zero_allow_untested_optimizer` gates ZeRO over
+it. Here the whole JAX optimizer ecosystem plugs in the same way:
+
+    import optax
+    opt = OptaxOptimizer(optax.adafactor(learning_rate=1e-3))
+    engine, *_ = ds.initialize(model=model, optimizer=opt, config=cfg)
+
+The adapter satisfies the engine's functional protocol
+(init / update(grads, state, params, lr)) and the torch-style
+param_groups surface the LR schedulers mutate. A schedule-driven lr is
+threaded by injecting it through optax's standard `learning_rate`
+hyperparameter when the transformation was built with
+optax.inject_hyperparams, else by scaling the update (exact for any
+transform whose final step is scale_by_learning_rate, i.e. all stock
+optax optimizers)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class OptaxOptimizer:
+    name = "OptaxOptimizer"
+
+    def __init__(self, transform, lr: Optional[float] = None):
+        """transform: an optax.GradientTransformation (or the result of
+        optax.inject_hyperparams(...) for exact lr injection). lr: the
+        nominal learning rate exposed to schedulers via param_groups;
+        defaults to 1.0, meaning scheduler values multiply the
+        transform's own internal rate."""
+        self.transform = transform
+        self.param_groups = [dict(lr=1.0 if lr is None else float(lr))]
+
+    @property
+    def lr(self):
+        return self.param_groups[0]["lr"]
+
+    def init(self, params):
+        return {"optax": self.transform.init(params),
+                "_base_lr": jnp.asarray(self.lr, jnp.float32)}
+
+    def _inject_lr(self, opt_state, lr):
+        """If the state carries inject_hyperparams' hyperparams dict with
+        a learning_rate entry, set it (exact); returns (state, handled)."""
+        hp = getattr(opt_state, "hyperparams", None)
+        if isinstance(hp, dict) and "learning_rate" in hp:
+            new_hp = dict(hp)
+            new_hp["learning_rate"] = jnp.asarray(lr, jnp.float32)
+            return opt_state._replace(hyperparams=new_hp), True
+        return opt_state, False
+
+    def update(self, grads, state, params, lr=None, **_):
+        opt_state = state["optax"]
+        base_lr = state["_base_lr"]
+        handled = False
+        if lr is not None:
+            opt_state, handled = self._inject_lr(opt_state, lr)
+        updates, new_opt = self.transform.update(grads, opt_state, params)
+        if lr is not None and not handled:
+            # stock optax optimizers end in scale_by_learning_rate, so a
+            # multiplicative rescale by (lr / base_lr) is exact
+            ratio = jnp.asarray(lr, jnp.float32) / jnp.maximum(
+                base_lr, jnp.asarray(1e-30, jnp.float32))
+            updates = jax.tree_util.tree_map(
+                lambda u: (u.astype(jnp.float32) * ratio).astype(u.dtype),
+                updates)
+        new_params = jax.tree_util.tree_map(
+            lambda p, u: (p.astype(jnp.float32) +
+                          u.astype(jnp.float32)).astype(p.dtype),
+            params, updates)
+        return new_params, {"optax": new_opt, "_base_lr": base_lr}
+
+    # torch-parity niceties used by checkpoint/save paths
+    def state_dict(self) -> Any:
+        return {"param_groups": self.param_groups}
+
+    def load_state_dict(self, sd) -> None:
+        if sd and "param_groups" in sd:
+            self.param_groups = [dict(g) for g in sd["param_groups"]]
+
+    # checkpoint protocol: optax states contain arbitrary namedtuples the
+    # msgpack writer can't encode; flatten to a leaf list and rebuild the
+    # structure from a fresh init at load (engine save/load hooks these)
+    def serialize_state(self, state):
+        return {"__optax_leaves__": list(jax.tree_util.tree_leaves(state))}
+
+    def deserialize_state(self, payload, params):
+        if not (isinstance(payload, dict) and "__optax_leaves__" in payload):
+            return payload  # old/plain format
+        # eval_shape: the treedef without allocating a throwaway state
+        template = jax.eval_shape(self.init, params)
+        treedef = jax.tree_util.tree_structure(template)
+        leaves = [jnp.asarray(l) for l in payload["__optax_leaves__"]]
+        return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -169,8 +169,14 @@ class ZeroShardingPlan:
                     out[k] = jax.tree_util.tree_map(
                         lambda leaf, s: for_leaf_path(leaf, s), v,
                         self.opt_spec)
-                else:  # scalars like "step"
-                    out[k] = NamedSharding(mesh, PartitionSpec())
+                else:
+                    # scalars (step counters) and states of unknown shape
+                    # (e.g. OptaxOptimizer's wrapped transform state):
+                    # replicate every leaf — stage-1 moment sharding only
+                    # applies to the moment trees it understands
+                    out[k] = jax.tree_util.tree_map(
+                        lambda leaf: NamedSharding(mesh, PartitionSpec()),
+                        v)
             return out
 
         return map_state(opt_state)

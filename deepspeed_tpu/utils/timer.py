@@ -96,3 +96,8 @@ class SynchronizedWallClockTimer:
         if memory_breakdown:
             line += " | " + self.memory_usage()
         log_dist(line, ranks=ranks or [0])
+
+
+# reference utils/timer.py:105 defines ThroughputTimer here; ours lives
+# with the runtime helpers — re-exported for import-path parity
+from ..runtime.utils import ThroughputTimer  # noqa: E402,F401

@@ -83,3 +83,40 @@ def test_generate_rejects_bad_configs():
     moe = GPT(gpt2_config("nano", vocab_size=96, num_experts=4))
     with pytest.raises(NotImplementedError, match="MoE"):
         generate(moe, params, prompt, 4)
+
+
+def test_topk_one_equals_greedy():
+    """top_k=1 at any temperature must reproduce greedy decoding."""
+    model, params = _model()
+    prompt = jnp.asarray([[5, 6, 7, 8]], jnp.int32)
+    greedy = generate(model, params, prompt, max_new_tokens=8)
+    k1 = generate(model, params, prompt, max_new_tokens=8,
+                  temperature=1.0, top_k=1,
+                  rng=jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_topk_topp_sample_valid_tokens():
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=12,
+                   temperature=0.8, top_k=20, top_p=0.9,
+                   rng=jax.random.PRNGKey(0))
+    toks = np.asarray(out)
+    assert toks.shape == (1, 12)
+    assert (toks >= 0).all() and (toks < model.config.vocab_size).all()
+    # tiny top_p ~ greedy (nucleus collapses to the argmax token)
+    p_small = generate(model, params, prompt, max_new_tokens=8,
+                       temperature=1.0, top_p=1e-6,
+                       rng=jax.random.PRNGKey(1))
+    greedy = generate(model, params, prompt, max_new_tokens=8)
+    np.testing.assert_array_equal(np.asarray(p_small), np.asarray(greedy))
+
+
+def test_sampling_args_validated():
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2]], jnp.int32)
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, 4, top_p=0.0)
+    with pytest.raises(ValueError):
+        generate(model, params, prompt, 4, top_k=-1)

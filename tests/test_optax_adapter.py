@@ -1,0 +1,99 @@
+"""OptaxOptimizer adapter — the torch.optim-passthrough analogue
+(reference engine.py:702-757 basic-optimizer fallback +
+zero_allow_untested_optimizer gate :655-664)."""
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.runtime.optax_adapter import OptaxOptimizer
+from tests.simple_model import SimpleModel, random_batches
+
+
+def _cfg(**over):
+    cfg = {"train_batch_size": 32, "steps_per_print": 0}
+    cfg.update(over)
+    return cfg
+
+
+def _train(engine, steps=25):
+    losses = []
+    for batch in random_batches(steps, batch_size=32, seed=0):
+        losses.append(float(engine.forward(batch)))
+        engine.backward()
+        engine.step()
+    return losses
+
+
+def test_optax_by_config_name_converges():
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(
+        optimizer={"type": "optax:adamw",
+                   "params": {"lr": 1e-2, "weight_decay": 1e-4}}))
+    assert isinstance(engine.optimizer, OptaxOptimizer)
+    losses = _train(engine, steps=40)
+    assert losses[-1] < losses[0] * 0.4
+
+
+def test_client_optax_transform_converges():
+    opt = OptaxOptimizer(optax.sgd(learning_rate=0.1), lr=0.1)
+    engine, *_ = ds.initialize(model=SimpleModel(), optimizer=opt,
+                               config=_cfg())
+    losses = _train(engine, steps=30)
+    assert losses[-1] < losses[0]
+
+
+def test_scheduler_drives_injected_lr():
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(
+        optimizer={"type": "optax:adam", "params": {"lr": 5e-2}},
+        scheduler={"type": "WarmupLR",
+                   "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 5e-2,
+                              "warmup_num_steps": 10}}))
+    _train(engine, steps=12)
+    # scheduler wrote through param_groups; post-warmup lr is the max
+    assert engine.get_lr()[0] == pytest.approx(5e-2, rel=1e-6)
+    # and the value was actually THREADED into the optax hyperparams
+    # state (the injected-lr path, not just the param_groups mirror)
+    hp = engine._opt_state["optax"].hyperparams
+    assert float(hp["learning_rate"]) == pytest.approx(5e-2, rel=1e-5)
+
+
+def test_zero_gate_matches_reference():
+    with pytest.raises(ValueError, match="untested"):
+        ds.initialize(model=SimpleModel(), config=_cfg(
+            optimizer={"type": "optax:adam", "params": {"lr": 1e-2}},
+            zero_optimization={"stage": 2}))
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(
+        optimizer={"type": "optax:adam", "params": {"lr": 1e-2}},
+        zero_optimization={"stage": 2},
+        zero_allow_untested_optimizer=True))
+    losses = _train(engine, steps=15)
+    assert losses[-1] < losses[0]
+
+
+def test_checkpoint_roundtrip_with_optax(tmp_path):
+    engine, *_ = ds.initialize(model=SimpleModel(), config=_cfg(
+        optimizer={"type": "optax:adam", "params": {"lr": 1e-2}}))
+    _train(engine, steps=5)
+    engine.save_checkpoint(tmp_path, tag="t")
+    engine2, *_ = ds.initialize(model=SimpleModel(), config=_cfg(
+        optimizer={"type": "optax:adam", "params": {"lr": 1e-2}}))
+    engine2.load_checkpoint(tmp_path, tag="t")
+    a = jax.tree_util.tree_leaves(engine.params)
+    b = jax.tree_util.tree_leaves(engine2.params)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    assert engine2.global_steps == 5
+    # the optax state itself (moments, counters, hyperparams) survives
+    sa = jax.tree_util.tree_leaves(engine._opt_state)
+    sb = jax.tree_util.tree_leaves(engine2._opt_state)
+    assert len(sa) == len(sb)
+    for x, y in zip(sa, sb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+    # and one more step from each stays in lockstep
+    batch = next(random_batches(1, batch_size=32, seed=9))
+    l1 = float(engine.forward(batch)); engine.backward(); engine.step()
+    l2 = float(engine2.forward(batch)); engine2.backward(); engine2.step()
+    assert l1 == pytest.approx(l2, rel=1e-6)
