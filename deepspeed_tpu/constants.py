@@ -1,0 +1,12 @@
+"""Top-level distributed constants (reference deepspeed/constants.py).
+
+The NCCL rendezvous port becomes the jax.distributed coordinator port;
+the process-group timeout maps to the coordinator's initialization
+timeout (jax.distributed.initialize initialization_timeout)."""
+
+from datetime import timedelta
+
+TORCH_DISTRIBUTED_DEFAULT_PORT = 29500  # kept name for config parity
+DEFAULT_COORDINATOR_PORT = TORCH_DISTRIBUTED_DEFAULT_PORT
+
+default_pg_timeout = timedelta(minutes=30)

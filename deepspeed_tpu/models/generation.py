@@ -99,19 +99,26 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
         if temperature == 0.0:
             return greedy
         logits = logits.astype(jnp.float32) / temperature
-        if top_k > 0:
-            # mask everything below the k-th largest logit
-            kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        if top_p < 1.0:
-            # nucleus: keep the smallest set with cumulative prob > top_p
-            sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # number of tokens kept = first index where cum exceeds top_p
-            keep = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)
-            cutoff = jnp.take_along_axis(sorted_logits, keep - 1, axis=-1)
-            logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+        V = logits.shape[-1]
+        if top_k > 0 or top_p < 1.0:
+            # ONE descending sort serves both filters (HF semantics:
+            # k-truncate first, then nucleus over the renormalized
+            # survivors — masking the sorted tail reproduces the sort of
+            # the masked logits exactly)
+            sorted_desc = jnp.sort(logits, axis=-1)[..., ::-1]
+            if top_k > 0:
+                k = min(top_k, V)  # clamp like HF for generous defaults
+                kth = sorted_desc[..., k - 1][..., None]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+                sorted_desc = jnp.where(jnp.arange(V) >= k, -jnp.inf,
+                                        sorted_desc)
+            if top_p < 1.0:
+                # nucleus: keep the smallest set with cum prob > top_p
+                probs = jax.nn.softmax(sorted_desc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                keep = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_desc, keep - 1, axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
         return jax.random.categorical(rng, logits, axis=-1)
 
     def step(carry, _):

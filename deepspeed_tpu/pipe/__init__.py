@@ -6,4 +6,5 @@ from ..runtime.pipe.module import (LayerSpec, PipelineModule,  # noqa: F401
                                    TiedLayerSpec)
 from ..runtime.pipe.engine import PipelineEngine  # noqa: F401
 from ..runtime.pipe.schedule import (DataParallelSchedule,  # noqa: F401
-                                     InferenceSchedule, TrainSchedule)
+                                     InferenceSchedule,
+                                     InterleavedTrainSchedule, TrainSchedule)

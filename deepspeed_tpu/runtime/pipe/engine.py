@@ -46,10 +46,10 @@ from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
 from ..utils import has_overflow
 from .module import PipelineModule, TiedLayerSpec
-from .schedule import (BackwardPass, ForwardPass, LoadMicroBatch,
-                       OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
-                       ReduceTiedGrads, SendActivation, SendGrad,
-                       TrainSchedule)
+from .schedule import (BackwardPass, ForwardPass, InterleavedTrainSchedule,
+                       LoadMicroBatch, OptimizerStep, RecvActivation,
+                       RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad, TrainSchedule)
 
 
 class _StageRuntime:
@@ -241,44 +241,53 @@ class PipelineEngine(DeepSpeedEngine):
     def _build_stages(self):
         module: PipelineModule = self.module
         P = module.num_stages
+        v = getattr(module, "interleave", 1)
+        self._n_phys = P
+        self._v = v
+        n_mc = P * v  # model chunks; chunk index mc = chunk_id * P + stage
         devices = jax.devices()
         G = len(devices) // P
         clip = float(self._config.gradient_clipping or 0.0)
 
-        # tied ownership: first stage containing each tied key
-        def stage_of_layer(i):
-            for s in range(P):
-                if module.parts[s] <= i < module.parts[s + 1]:
-                    return s
-            return P - 1
+        # tied ownership: first MODEL CHUNK containing each tied key
+        def chunk_of_layer(i):
+            for mc in range(n_mc):
+                if module.parts[mc] <= i < module.parts[mc + 1]:
+                    return mc
+            return n_mc - 1
 
         tied_owner: Dict[str, int] = {}
         tied_users: Dict[str, set] = {}
         for i, spec in enumerate(module.layer_specs):
             if isinstance(spec, TiedLayerSpec):
-                s = stage_of_layer(i)
-                tied_owner.setdefault(spec.key, s)
-                tied_users.setdefault(spec.key, set()).add(s)
+                mc = chunk_of_layer(i)
+                tied_owner.setdefault(spec.key, mc)
+                tied_users.setdefault(spec.key, set()).add(mc)
         self._tied_owner = tied_owner
         self._tied_users = tied_users
 
-        # whole-model params were built by the base engine; redistribute
+        # whole-model params were built by the base engine; redistribute.
+        # self.stages is in MODEL-CHUNK order (= model order), so every
+        # walk over it — eval, checkpointing, the params property — sees
+        # the layers in sequence; interleaving only changes which device
+        # group hosts each chunk (chunk mc -> physical stage mc % P).
         full = jax.tree_util.tree_map(np.asarray, self._params)
         self.stages: List[_StageRuntime] = []
-        for s in range(P):
-            lo, hi = module.parts[s], module.parts[s + 1]
+        for mc in range(n_mc):
+            s_phys = mc % P
+            lo, hi = module.parts[mc], module.parts[mc + 1]
             rt = _StageRuntime(
-                stage_id=s,
+                stage_id=mc,
                 layers=module._layers[lo:hi],
                 specs=module.layer_specs[lo:hi],
-                devices=devices[s * G:(s + 1) * G],
-                is_last=(s == P - 1),
+                devices=devices[s_phys * G:(s_phys + 1) * G],
+                is_last=(mc == n_mc - 1),
                 loss_fn=module.loss_fn,
                 compute_dtype=self.compute_dtype)
             own_tied = {k: full["tied"][k] for k, o in tied_owner.items()
-                        if o == s}
+                        if o == mc}
             ro_tied = {k: full["tied"][k] for k, users in tied_users.items()
-                       if s in users and tied_owner[k] != s}
+                       if mc in users and tied_owner[k] != mc}
             rt.own = rt.place_replicated(
                 {"layers": full["layers"][lo:hi], "tied": own_tied})
             rt.ro_tied = rt.place_replicated(ro_tied)
@@ -294,26 +303,36 @@ class PipelineEngine(DeepSpeedEngine):
         self._opt_state = None
         self._grad_acc = None
         log_dist(
-            f"pipeline: {P} stages x {G} device(s)/stage, partitions "
-            f"{module.parts}, tied={ {k: sorted(v) for k, v in tied_users.items()} }",
+            f"pipeline: {P} stages x {G} device(s)/stage"
+            + (f" x {v} interleaved chunks" if v > 1 else "")
+            + f", partitions {module.parts}, "
+            f"tied={ {k: sorted(u) for k, u in tied_users.items()} }",
             ranks=[0])
 
     # ------------------------------------------------------------------
     # schedule execution
     # ------------------------------------------------------------------
 
+    def _mc(self, s: int, cmd) -> int:
+        """Model-chunk index a command targets: interleaved instructions
+        carry chunk_id (chunk c of physical stage s is model chunk
+        c * n_phys + s); plain 1F1B instructions default to chunk 0."""
+        return getattr(cmd, "chunk_id", 0) * self._n_phys + s
+
     def _deps_ready(self, s: int, tick) -> bool:
-        # mailboxes are keyed by (stage, micro_batch): buffer ids are
-        # stage-LOCAL (num_pipe_buffers differs per stage), while sends and
-        # recvs both occur in micro-batch order — the counters recover the
-        # mb each pending Recv is waiting for
+        # mailboxes are keyed by (model_chunk, micro_batch): buffer ids
+        # are stage-LOCAL (num_pipe_buffers differs per stage), while
+        # sends and recvs both occur in micro-batch order per model chunk
+        # — the counters recover the mb each pending Recv is waiting for
         for cmd in tick:
-            if isinstance(cmd, RecvActivation) and \
-                    (s, self._recv_act_cnt[s]) not in self._mail_act:
-                return False
-            if isinstance(cmd, RecvGrad) and \
-                    (s, self._recv_grad_cnt[s]) not in self._mail_grad:
-                return False
+            if isinstance(cmd, RecvActivation):
+                mc = self._mc(s, cmd)
+                if (mc, self._recv_act_cnt[mc]) not in self._mail_act:
+                    return False
+            if isinstance(cmd, RecvGrad):
+                mc = self._mc(s, cmd)
+                if (mc, self._recv_grad_cnt[mc]) not in self._mail_grad:
+                    return False
         return True
 
     def _run_schedule(self, streams, dispatch):
@@ -352,22 +371,28 @@ class PipelineEngine(DeepSpeedEngine):
 
         self.tput_timer.start()
         M = self.micro_batches
-        P = len(self.stages)
+        n_rt = len(self.stages)
+        P = self._n_phys
         self._mail_act: Dict[Any, Any] = {}
         self._mail_grad: Dict[Any, Any] = {}
         self._data_iter = data_iter
         self._batch_key = self._next_rng()
         self._step_applied = False
-        self._recv_act_cnt = [0] * P
-        self._recv_grad_cnt = [0] * P
-        self._sent_act_cnt = [0] * P
-        self._sent_grad_cnt = [0] * P
+        self._recv_act_cnt = [0] * n_rt
+        self._recv_grad_cnt = [0] * n_rt
+        self._sent_act_cnt = [0] * n_rt
+        self._sent_grad_cnt = [0] * n_rt
         for rt in self.stages:
             rt.losses = []
             rt.fwd_count = 0
             rt.bwd_count = 0
 
-        streams = [list(TrainSchedule(M, P, s).steps()) for s in range(P)]
+        if self._v > 1:
+            streams = [list(InterleavedTrainSchedule(
+                M, P, s, self._v).steps()) for s in range(P)]
+        else:
+            streams = [list(TrainSchedule(M, P, s).steps())
+                       for s in range(P)]
         self._run_schedule(streams, self._dispatch_train)
 
         last = self.stages[-1]
@@ -385,7 +410,8 @@ class PipelineEngine(DeepSpeedEngine):
     # -- instruction handlers ------------------------------------------
 
     def _dispatch_train(self, s: int, cmd):
-        rt = self.stages[s]
+        mc = self._mc(s, cmd)
+        rt = self.stages[mc]
         b = getattr(cmd, "buffer_id", None)
         if isinstance(cmd, LoadMicroBatch):
             inputs, labels = self._next_micro_batch()
@@ -393,13 +419,14 @@ class PipelineEngine(DeepSpeedEngine):
             rt.x_in[b] = rt.place_batch(inputs)
             self.stages[-1].labels[mb] = labels
         elif isinstance(cmd, RecvActivation):
-            mb = self._recv_act_cnt[s]
-            self._recv_act_cnt[s] += 1
-            rt.x_in[b] = self._mail_act.pop((s, mb))
+            mb = self._recv_act_cnt[mc]
+            self._recv_act_cnt[mc] += 1
+            rt.x_in[b] = self._mail_act.pop((mc, mb))
         elif isinstance(cmd, ForwardPass):
             mb = rt.fwd_count
             rt.fwd_count += 1
-            rng = jax.random.fold_in(self._batch_key, mb * len(self.stages) + s)
+            rng = jax.random.fold_in(self._batch_key,
+                                     mb * len(self.stages) + mc)
             rt.rng_in[b] = rng
             if rt.is_last:
                 labels = rt.place_batch(rt.labels[mb])
@@ -410,18 +437,21 @@ class PipelineEngine(DeepSpeedEngine):
             else:
                 rt.y_out[b] = rt.fwd_j(rt.own, rt.ro_tied, rt.x_in[b], rng)
         elif isinstance(cmd, SendActivation):
-            nxt = self.stages[s + 1]
-            mb = self._sent_act_cnt[s]
-            self._sent_act_cnt[s] += 1
+            # consecutive model chunks are adjacent in self.stages, so the
+            # interleaved wrap (last stage chunk c -> stage 0 chunk c+1)
+            # and the plain next-stage hop are both mc + 1
+            nxt = self.stages[mc + 1]
+            mb = self._sent_act_cnt[mc]
+            self._sent_act_cnt[mc] += 1
             y = rt.y_out.pop(b)
-            self._mail_act[(s + 1, mb)] = jax.device_put(
+            self._mail_act[(mc + 1, mb)] = jax.device_put(
                 y, nxt.batch_sharding
                 if y.shape[0] % len(nxt.devices) == 0 else nxt.replicated)
         elif isinstance(cmd, RecvGrad):
-            mb = self._recv_grad_cnt[s]
-            self._recv_grad_cnt[s] += 1
+            mb = self._recv_grad_cnt[mc]
+            self._recv_grad_cnt[mc] += 1
             rt.dy_in = getattr(rt, "dy_in", {})
-            rt.dy_in[b] = self._mail_grad.pop((s, mb))
+            rt.dy_in[b] = self._mail_grad.pop((mc, mb))
         elif isinstance(cmd, BackwardPass):
             mb = rt.bwd_count
             rt.bwd_count += 1
@@ -439,11 +469,11 @@ class PipelineEngine(DeepSpeedEngine):
                     rt.own, rt.ro_tied, x, rng, dy, rt.acc, rt.acc_ro)
             rt.dx_out[b] = dx
         elif isinstance(cmd, SendGrad):
-            prev = self.stages[s - 1]
-            mb = self._sent_grad_cnt[s]
-            self._sent_grad_cnt[s] += 1
+            prev = self.stages[mc - 1]
+            mb = self._sent_grad_cnt[mc]
+            self._sent_grad_cnt[mc] += 1
             dx = rt.dx_out.pop(b)
-            self._mail_grad[(s - 1, mb)] = jax.device_put(
+            self._mail_grad[(mc - 1, mb)] = jax.device_put(
                 dx, prev.batch_sharding
                 if dx.shape[0] % len(prev.devices) == 0 else prev.replicated)
         elif isinstance(cmd, ReduceTiedGrads):
@@ -655,10 +685,15 @@ class PipelineEngine(DeepSpeedEngine):
             "rng_key": np.asarray(self._rng_key),
             **self._client_state(client_state),
         }
+        def pack_opt(rt):
+            state = rt.opt_state
+            if hasattr(self.optimizer, "serialize_state"):
+                # namedtuple optimizer states (optax) can't ride msgpack
+                state = self.optimizer.serialize_state(state)
+            return jax.tree_util.tree_map(np.asarray, state)
+
         optim_state = {
-            "optimizer_state": [jax.tree_util.tree_map(np.asarray,
-                                                       rt.opt_state)
-                                for rt in self.stages],
+            "optimizer_state": [pack_opt(rt) for rt in self.stages],
             "pipeline_parts": list(module.parts),
             "zero_stage": self.zero_optimization_stage(),
             "offload": False,
@@ -694,9 +729,12 @@ class PipelineEngine(DeepSpeedEngine):
                  "tied": own_tied})
             if load_optimizer_states and optim_state is not None and \
                     optim_state.get("pipeline_parts") == list(module.parts):
+                restored = optim_state["optimizer_state"][s]
+                if hasattr(self.optimizer, "deserialize_state"):
+                    restored = self.optimizer.deserialize_state(
+                        restored, rt.own)
                 rt.opt_state = rt.place_replicated(
-                    jax.tree_util.tree_map(
-                        jnp.asarray, optim_state["optimizer_state"][s]))
+                    jax.tree_util.tree_map(jnp.asarray, restored))
             rt.zero_acc()
         self._refresh_tied_copies()
         if model_state.get("loss_scaler") is not None:

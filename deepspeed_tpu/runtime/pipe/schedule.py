@@ -190,6 +190,91 @@ class TrainSchedule(PipeSchedule):
         yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
 
 
+class InterleavedTrainSchedule(PipeSchedule):
+    """Megatron-style interleaved (virtual-stage) 1F1B: each physical
+    stage owns `chunks` model chunks (chunk c = model chunk c*stages +
+    stage_id), shrinking the pipeline bubble by ~1/chunks. Beyond the
+    reference (its schedule.py:182 has no virtual stages); the ordering
+    follows the public interleaved-1F1B formulation: virtual micro-batch
+    index k maps to model chunk (k // stages) % chunks (reversed for
+    backward) and micro batch stages*(k // (stages*chunks)) + k % stages,
+    with warmup min((stages - stage_id - 1)*2 + (chunks - 1)*stages,
+    total) forwards before the 1F1B steady state.
+
+    Instructions carry chunk_id; micro_batches must divide by stages."""
+
+    def __init__(self, micro_batches: int, stages: int, stage_id: int,
+                 chunks: int):
+        super().__init__(micro_batches, stages, stage_id)
+        if micro_batches % stages != 0:
+            raise ValueError(
+                f"interleaved schedule requires micro_batches "
+                f"({micro_batches}) divisible by stages ({stages})")
+        assert chunks >= 1
+        self.chunks = chunks
+
+    def num_pipe_buffers(self):
+        total = self.micro_batches * self.chunks
+        return min((self.stages - self.stage_id - 1) * 2
+                   + (self.chunks - 1) * self.stages + 1, total) or 1
+
+    def _chunk_of(self, k: int, forward: bool) -> int:
+        cid = (k // self.stages) % self.chunks
+        return cid if forward else self.chunks - 1 - cid
+
+    def _mb_of(self, k: int) -> int:
+        group = self.stages * self.chunks
+        return self.stages * (k // group) + k % self.stages
+
+    def _is_first_model_chunk(self, c: int) -> bool:
+        return self.stage_id == 0 and c == 0
+
+    def _is_last_model_chunk(self, c: int) -> bool:
+        return self.stage_id == self.stages - 1 and c == self.chunks - 1
+
+    def _fwd_cmds(self, c: int, mb: int):
+        cmds = []
+        if self._is_first_model_chunk(c):
+            cmds.append(LoadMicroBatch(mb, chunk_id=c))
+        else:
+            cmds.append(RecvActivation(mb, chunk_id=c))
+        cmds.append(ForwardPass(mb, chunk_id=c))
+        if not self._is_last_model_chunk(c):
+            cmds.append(SendActivation(mb, chunk_id=c))
+        return cmds
+
+    def _bwd_cmds(self, c: int, mb: int):
+        cmds = []
+        if not self._is_last_model_chunk(c):
+            cmds.append(RecvGrad(mb, chunk_id=c))
+        cmds.append(BackwardPass(mb, chunk_id=c))
+        if not self._is_first_model_chunk(c):
+            cmds.append(SendGrad(mb, chunk_id=c))
+        return cmds
+
+    def steps(self):
+        total = self.micro_batches * self.chunks
+        warmup = min((self.stages - self.stage_id - 1) * 2
+                     + (self.chunks - 1) * self.stages, total)
+        fwd_k = bwd_k = 0
+        for _ in range(warmup):
+            yield self._fwd_cmds(self._chunk_of(fwd_k, True),
+                                 self._mb_of(fwd_k))
+            fwd_k += 1
+        for _ in range(total - warmup):
+            yield self._fwd_cmds(self._chunk_of(fwd_k, True),
+                                 self._mb_of(fwd_k))
+            fwd_k += 1
+            yield self._bwd_cmds(self._chunk_of(bwd_k, False),
+                                 self._mb_of(bwd_k))
+            bwd_k += 1
+        for _ in range(warmup):
+            yield self._bwd_cmds(self._chunk_of(bwd_k, False),
+                                 self._mb_of(bwd_k))
+            bwd_k += 1
+        yield [ReduceTiedGrads(), ReduceGrads(), OptimizerStep()]
+
+
 class DataParallelSchedule(PipeSchedule):
     """Degenerate single-stage schedule (reference schedule.py:292)."""
 

@@ -131,3 +131,10 @@ def test_add_config_arguments_defaults():
     parser = ds.add_config_arguments(argparse.ArgumentParser())
     args = parser.parse_args([])
     assert args.deepspeed is False and args.deepspeed_config is None
+
+
+def test_top_level_constants_module():
+    from deepspeed_tpu.constants import (TORCH_DISTRIBUTED_DEFAULT_PORT,
+                                         default_pg_timeout)
+    assert TORCH_DISTRIBUTED_DEFAULT_PORT == 29500
+    assert default_pg_timeout.total_seconds() == 1800

@@ -120,3 +120,14 @@ def test_sampling_args_validated():
         generate(model, params, prompt, 4, top_p=0.0)
     with pytest.raises(ValueError):
         generate(model, params, prompt, 4, top_k=-1)
+
+
+def test_topk_larger_than_vocab_clamps():
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+    big_k = generate(model, params, prompt, 6, temperature=1.0,
+                     top_k=4096, rng=jax.random.PRNGKey(2))
+    plain = generate(model, params, prompt, 6, temperature=1.0,
+                     rng=jax.random.PRNGKey(2))
+    # k >= vocab is a no-op filter: identical to unfiltered sampling
+    np.testing.assert_array_equal(np.asarray(big_k), np.asarray(plain))

@@ -97,3 +97,22 @@ def test_checkpoint_roundtrip_with_optax(tmp_path):
     l1 = float(engine.forward(batch)); engine.backward(); engine.step()
     l2 = float(engine2.forward(batch)); engine2.backward(); engine2.step()
     assert l1 == pytest.approx(l2, rel=1e-6)
+
+
+def test_pipeline_engine_optax_checkpoint(tmp_path):
+    """The pipe engine's per-stage optimizer states go through the
+    serialize/deserialize hooks too (namedtuple states, msgpack)."""
+    from tests.test_pipe_engine import (build_module, config as pipe_cfg,
+                                        micro_batches, M)
+
+    cfg = pipe_cfg(2)
+    cfg["optimizer"] = {"type": "optax:adam", "params": {"lr": 1e-3}}
+    engine, *_ = ds.initialize(model=build_module(2), config_params=cfg)
+    assert engine._staged
+    engine.train_batch(iter(micro_batches(seed=0, n=M)))
+    engine.save_checkpoint(str(tmp_path), tag="po")
+    fresh, *_ = ds.initialize(model=build_module(2), config_params=cfg)
+    fresh.load_checkpoint(str(tmp_path), tag="po")
+    l1 = float(engine.train_batch(iter(micro_batches(seed=3, n=M))))
+    l2 = float(fresh.train_batch(iter(micro_batches(seed=3, n=M))))
+    assert l1 == pytest.approx(l2, rel=1e-5)
