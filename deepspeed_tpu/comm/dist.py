@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..constants import TORCH_DISTRIBUTED_DEFAULT_PORT
 from ..utils.logging import logger
 from . import mesh as mesh_mod
 
@@ -50,7 +51,7 @@ class ReduceOp:
 def init_distributed(
     dist_backend: str = "xla",
     auto_mpi_discovery: bool = True,
-    distributed_port: int = 29500,
+    distributed_port: int = TORCH_DISTRIBUTED_DEFAULT_PORT,
     verbose: bool = True,
     timeout=None,
     init_method: Optional[str] = None,

@@ -22,6 +22,7 @@ import subprocess
 import sys
 from typing import List
 
+from ..constants import TORCH_DISTRIBUTED_DEFAULT_PORT
 from ..utils.logging import logger
 from .runner import decode_world_info
 
@@ -31,7 +32,8 @@ def parse_args(args=None):
     parser.add_argument("--world_info", type=str, required=True)
     parser.add_argument("--node_rank", type=int, default=0)
     parser.add_argument("--master_addr", type=str, default="127.0.0.1")
-    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_port", type=int,
+                        default=TORCH_DISTRIBUTED_DEFAULT_PORT)
     parser.add_argument("--procs_per_node", type=int, default=1)
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)

@@ -26,6 +26,7 @@ import sys
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from ..constants import TORCH_DISTRIBUTED_DEFAULT_PORT
 from ..utils.logging import logger
 
 DLTS_HOSTFILE = "/job/hostfile"  # reference runner.py:26
@@ -47,7 +48,8 @@ def parse_args(args=None):
     parser.add_argument("--num_nodes", type=int, default=-1)
     parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1,
                         dest="num_gpus")
-    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_port", type=int,
+                        default=TORCH_DISTRIBUTED_DEFAULT_PORT)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
                         choices=["pdsh", "openmpi", "local"])

@@ -110,7 +110,10 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
                 k = min(top_k, V)  # clamp like HF for generous defaults
                 kth = sorted_desc[..., k - 1][..., None]
                 logits = jnp.where(logits < kth, -jnp.inf, logits)
-                sorted_desc = jnp.where(jnp.arange(V) >= k, -jnp.inf,
+                # mask the sorted copy by VALUE, not position: ties at the
+                # k-th logit survive the live mask above (HF semantics),
+                # so they must stay in the nucleus computation too
+                sorted_desc = jnp.where(sorted_desc < kth, -jnp.inf,
                                         sorted_desc)
             if top_p < 1.0:
                 # nucleus: keep the smallest set with cum prob > top_p

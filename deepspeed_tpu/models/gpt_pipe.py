@@ -1,0 +1,143 @@
+"""GPT as a PipelineModule — routes the flagship model family through
+the 1F1B TrainSchedule engine (heterogeneous LayerSpec executor,
+runtime/pipe/engine.py), including tied embeddings and interleaved
+virtual stages.
+
+This complements GPTConfig.pipeline_stages (the SPMD GPipe scan in
+parallel/pipeline.py, which requires homogeneous stacked blocks and
+compiles the whole pipeline into one jit): the LayerSpec form trades
+whole-program compilation for the 1F1B schedule's lower bubble/memory
+and per-layer checkpoint files.
+
+Note: the last stage materializes [B, S, V] logits for the loss (the
+engine's loss_fn contract, reference pipe semantics); the resident
+GPT.loss's chunked/streaming CE does not apply here."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
+from .gpt import GPTConfig, _dropout, _init_block, gpt_block, layer_norm
+
+
+class GPTTokenEmbed:
+    """Token embedding — ONLY the wte table, so the tied head neither
+    carries nor ships a useless wpe copy."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        cfg = self.cfg
+        return {"wte": (jax.random.normal(rng, (cfg.vocab_size, cfg.d_model))
+                        * 0.02).astype(cfg.param_dtype)}
+
+    def apply(self, p, tokens, rng=None, train=True):
+        return p["wte"][tokens]
+
+
+class GPTPosEmbed:
+    """Position embedding + embed dropout (the rest of gpt.py's _trunk
+    entry, applied after the tied token lookup)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        cfg = self.cfg
+        return {"wpe": (jax.random.normal(rng, (cfg.max_seq_len, cfg.d_model))
+                        * 0.01).astype(cfg.param_dtype)}
+
+    def apply(self, p, x, rng=None, train=True):
+        S = x.shape[1]
+        x = x + p["wpe"][:S][None, :, :]
+        return _dropout(x, self.cfg.embed_dropout, rng, train)
+
+
+class GPTBlock:
+    def __init__(self, cfg: GPTConfig, layer_idx: int):
+        self.cfg = cfg
+        self.layer_idx = layer_idx
+
+    def init(self, rng):
+        return _init_block(rng, self.cfg, self.layer_idx)
+
+    def apply(self, p, x, rng=None, train=True):
+        out, _aux = gpt_block(x, p, self.cfg, rng, train)
+        return out
+
+
+class GPTFinalNorm:
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        dt = self.cfg.param_dtype
+        return {"scale": jnp.ones((self.cfg.d_model,), dt),
+                "bias": jnp.zeros((self.cfg.d_model,), dt)}
+
+    def apply(self, p, x, rng=None, train=True):
+        return layer_norm(x, p, self.cfg.layer_norm_eps)
+
+
+class GPTHead:
+    """Untied LM head (tie_embeddings=False)."""
+
+    def __init__(self, cfg: GPTConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        cfg = self.cfg
+        return {"w": (jax.random.normal(rng, (cfg.d_model, cfg.vocab_size))
+                      * 0.02).astype(cfg.param_dtype)}
+
+    def apply(self, p, x, rng=None, train=True):
+        return x @ p["w"].astype(x.dtype)
+
+
+def _tied_head_forward(layer, p, x):
+    """Tied head: project with the embedding table transposed."""
+    return x @ p["wte"].astype(x.dtype).T
+
+
+def gpt_ce_loss(logits, labels):
+    """Masked next-token CE ((tokens, labels) batches; -100 masked)."""
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    return jnp.where(valid, nll, 0.0).sum() / jnp.maximum(valid.sum(), 1)
+
+
+def gpt_pipeline_module(cfg: GPTConfig, num_stages: int,
+                        interleave: int = 1,
+                        partition_method: str = "parameters",
+                        activation_checkpoint_interval: int = 0
+                        ) -> PipelineModule:
+    """Build the GPT stack as LayerSpecs for the 1F1B engine.
+
+    cfg.pipeline_stages must stay 1 (that flag selects the SPMD GPipe
+    executor inside GPT.loss; here staging is the engine's job)."""
+    if cfg.pipeline_stages > 1:
+        raise ValueError("leave cfg.pipeline_stages=1: gpt_pipeline_module "
+                         "stages through the 1F1B engine instead")
+    if cfg.num_experts > 1:
+        raise NotImplementedError("MoE blocks are not supported in the "
+                                  "LayerSpec pipeline form yet")
+    layers = [TiedLayerSpec("embed", GPTTokenEmbed, cfg)
+              if cfg.tie_embeddings else LayerSpec(GPTTokenEmbed, cfg)]
+    layers += [LayerSpec(GPTPosEmbed, cfg)]
+    layers += [LayerSpec(GPTBlock, cfg, i) for i in range(cfg.num_layers)]
+    layers += [LayerSpec(GPTFinalNorm, cfg)]
+    if cfg.tie_embeddings:
+        layers += [TiedLayerSpec("embed", GPTTokenEmbed, cfg,
+                                 forward_fn=_tied_head_forward)]
+    else:
+        layers += [LayerSpec(GPTHead, cfg)]
+    return PipelineModule(
+        layers, num_stages=num_stages, loss_fn=gpt_ce_loss,
+        partition_method=partition_method,
+        activation_checkpoint_interval=activation_checkpoint_interval,
+        interleave=interleave)

@@ -213,10 +213,9 @@ class InterleavedTrainSchedule(PipeSchedule):
         assert chunks >= 1
         self.chunks = chunks
 
-    def num_pipe_buffers(self):
-        total = self.micro_batches * self.chunks
-        return min((self.stages - self.stage_id - 1) * 2
-                   + (self.chunks - 1) * self.stages + 1, total) or 1
+    # note: buffer_ids here are raw micro-batch ids (the engine keys its
+    # buffer dicts per model chunk, so no wrap is needed); the in-flight
+    # count per chunk is still bounded by the warmup depth
 
     def _chunk_of(self, k: int, forward: bool) -> int:
         cid = (k // self.stages) % self.chunks
