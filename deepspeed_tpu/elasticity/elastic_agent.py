@@ -11,6 +11,16 @@ from .elasticity import compute_elastic_config
 
 
 def main(args=None):
+    import sys
+
+    argv = list(sys.argv[1:] if args is None else args)
+    if argv and argv[0] == "supervise":
+        # `ds_elastic supervise [opts] -- cmd ...`: restart supervisor
+        # (relaunch-on-failure + elastic-checkpoint resume)
+        from .supervisor import main as supervise_main
+
+        return supervise_main(argv[1:])
+    args = argv
     parser = argparse.ArgumentParser(description="DeepSpeed elasticity")
     parser.add_argument("-c", "--config", type=str, required=True,
                         help="DeepSpeed config json")

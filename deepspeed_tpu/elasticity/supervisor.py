@@ -1,0 +1,108 @@
+"""Restart supervisor — keep an elastic training job alive across
+failures.
+
+Capability beyond the reference (SURVEY.md §5: v0.3.15 has no in-run
+failure detector or rendezvous — its recovery story is "the launcher
+kills the local group on any child failure" + elastic checkpoints that
+resume at a different world size). This supervisor closes the loop: it
+runs the training command, and when the command dies it relaunches it
+with exponential backoff, relying on the framework's elastic
+checkpoints ("latest" tag) for the resumed process to pick up where it
+left off — at whatever world size the new launch discovers.
+
+Usage (also `ds_elastic supervise -- ...`):
+
+    python -m deepspeed_tpu.elasticity.supervisor \
+        [--max-restarts 10] [--backoff 5] [--success-window 300] \
+        -- deepspeed --hostfile hostfile train.py --deepspeed_config c.json
+
+Exit code: the child's final exit code (0 if it eventually succeeds,
+the last failure code once restarts are exhausted).
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import subprocess
+import sys
+import time
+
+from ..utils.logging import logger
+
+
+def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
+              backoff_cap: float = 300.0, success_window: float = 300.0):
+    """Run `command` (list) until it exits 0 or restarts are exhausted.
+
+    A child that stays alive longer than `success_window` seconds resets
+    the restart budget and the backoff (long-running training that dies
+    after hours should get its full retry budget back, not inherit the
+    count from startup flakes)."""
+    restarts_left = max_restarts
+    delay = backoff
+    attempt = 0
+    child = None
+
+    def forward(signum, _frame):
+        if child is not None and child.poll() is None:
+            child.send_signal(signum)
+
+    old_int = signal.signal(signal.SIGINT, forward)
+    old_term = signal.signal(signal.SIGTERM, forward)
+    try:
+        while True:
+            attempt += 1
+            start = time.monotonic()
+            logger.info(f"supervisor: launching attempt {attempt}: "
+                        f"{' '.join(command)}")
+            child = subprocess.Popen(command)
+            rc = child.wait()
+            ran_for = time.monotonic() - start
+            if rc == 0:
+                logger.info(f"supervisor: command succeeded after "
+                            f"{attempt} attempt(s)")
+                return 0
+            if ran_for >= success_window:
+                restarts_left = max_restarts
+                delay = backoff
+            if restarts_left <= 0:
+                logger.error(f"supervisor: giving up after {attempt} "
+                             f"attempt(s); last exit code {rc}")
+                return rc
+            restarts_left -= 1
+            logger.warning(
+                f"supervisor: exit code {rc} after {ran_for:.1f}s; "
+                f"relaunching in {delay:.1f}s "
+                f"({restarts_left} restart(s) left)")
+            time.sleep(delay)
+            delay = min(delay * 2, backoff_cap)
+    finally:
+        signal.signal(signal.SIGINT, old_int)
+        signal.signal(signal.SIGTERM, old_term)
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="restart supervisor for elastic training jobs")
+    parser.add_argument("--max-restarts", type=int, default=10)
+    parser.add_argument("--backoff", type=float, default=5.0,
+                        help="initial relaunch delay (doubles per failure)")
+    parser.add_argument("--backoff-cap", type=float, default=300.0)
+    parser.add_argument("--success-window", type=float, default=300.0,
+                        help="children alive this long reset the budget")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="-- training command")
+    args = parser.parse_args(argv)
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if not command:
+        parser.error("no command given (use: supervisor [opts] -- cmd ...)")
+    return supervise(command, max_restarts=args.max_restarts,
+                     backoff=args.backoff, backoff_cap=args.backoff_cap,
+                     success_window=args.success_window)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

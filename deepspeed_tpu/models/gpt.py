@@ -113,6 +113,29 @@ def gpt2_config(size: str = "small", **overrides) -> GPTConfig:
 # init
 # ---------------------------------------------------------------------------
 
+def init_wte(rng, cfg: GPTConfig):
+    """Token-embedding table — THE single definition of its init scale;
+    GPT.init, the streaming init, and the LayerSpec pipeline form
+    (gpt_pipe.py) all share it so their initializations cannot drift."""
+    return (jax.random.normal(rng, (cfg.vocab_size, cfg.d_model))
+            * 0.02).astype(cfg.param_dtype)
+
+
+def init_wpe(rng, cfg: GPTConfig):
+    return (jax.random.normal(rng, (cfg.max_seq_len, cfg.d_model))
+            * 0.01).astype(cfg.param_dtype)
+
+
+def init_final_ln(cfg: GPTConfig):
+    return {"scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
+            "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype)}
+
+
+def init_lm_head(rng, cfg: GPTConfig):
+    return (jax.random.normal(rng, (cfg.d_model, cfg.vocab_size))
+            * 0.02).astype(cfg.param_dtype)
+
+
 def _init_block(rng, cfg: GPTConfig, layer_idx: int = 0):
     k = jax.random.split(rng, 5)
     d, f = cfg.d_model, cfg.d_ff
@@ -362,19 +385,14 @@ class GPT(TrainModule):
     def init(self, rng):
         cfg = self.config
         keys = jax.random.split(rng, cfg.num_layers + 3)
-        dt = cfg.param_dtype
         params = {
-            "wte": (jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model))
-                    * 0.02).astype(dt),
-            "wpe": (jax.random.normal(keys[1], (cfg.max_seq_len, cfg.d_model))
-                    * 0.01).astype(dt),
+            "wte": init_wte(keys[0], cfg),
+            "wpe": init_wpe(keys[1], cfg),
             "blocks": self._init_blocks(keys[2:2 + cfg.num_layers], cfg),
-            "ln_f": {"scale": jnp.ones((cfg.d_model,), dt),
-                     "bias": jnp.zeros((cfg.d_model,), dt)},
+            "ln_f": init_final_ln(cfg),
         }
         if not cfg.tie_embeddings:
-            params["lm_head"] = (jax.random.normal(
-                keys[-1], (cfg.d_model, cfg.vocab_size)) * 0.02).astype(dt)
+            params["lm_head"] = init_lm_head(keys[-1], cfg)
         return params
 
     def _init_blocks(self, keys, cfg):
@@ -547,24 +565,16 @@ class GPT(TrainModule):
             lambda a: _np.asarray(a), t)
 
         def embed_init(k0, k1):
-            return {"wte": (jax.random.normal(k0, (cfg.vocab_size,
-                                                   cfg.d_model)) * 0.02
-                            ).astype(cfg.param_dtype),
-                    "wpe": (jax.random.normal(k1, (cfg.max_seq_len,
-                                                   cfg.d_model)) * 0.01
-                            ).astype(cfg.param_dtype)}
+            return {"wte": init_wte(k0, cfg), "wpe": init_wpe(k1, cfg)}
 
         yield "embed", to_host(jax.jit(embed_init)(keys[0], keys[1]))
         for i in range(cfg.num_layers):
             yield f"block:{i}", to_host(
                 jax.jit(lambda k, i=i: _init_block(k, cfg, i))(keys[2 + i]))
-        head = {"ln_f": {"scale": jnp.ones((cfg.d_model,), cfg.param_dtype),
-                         "bias": jnp.zeros((cfg.d_model,), cfg.param_dtype)}}
+        head = {"ln_f": init_final_ln(cfg)}
         if not cfg.tie_embeddings:
             head["lm_head"] = jax.jit(
-                lambda k: (jax.random.normal(k, (cfg.d_model,
-                                                 cfg.vocab_size)) * 0.02
-                           ).astype(cfg.param_dtype))(keys[-1])
+                lambda k: init_lm_head(k, cfg))(keys[-1])
         yield "head", to_host(head)
 
     def stream_groups(self, params):

@@ -19,7 +19,9 @@ import jax
 import jax.numpy as jnp
 
 from ..runtime.pipe.module import LayerSpec, PipelineModule, TiedLayerSpec
-from .gpt import GPTConfig, _dropout, _init_block, gpt_block, layer_norm
+from .gpt import (GPTConfig, _dropout, _init_block, gpt_block,
+                  init_final_ln, init_lm_head, init_wpe, init_wte,
+                  layer_norm)
 
 
 class GPTTokenEmbed:
@@ -30,9 +32,7 @@ class GPTTokenEmbed:
         self.cfg = cfg
 
     def init(self, rng):
-        cfg = self.cfg
-        return {"wte": (jax.random.normal(rng, (cfg.vocab_size, cfg.d_model))
-                        * 0.02).astype(cfg.param_dtype)}
+        return {"wte": init_wte(rng, self.cfg)}
 
     def apply(self, p, tokens, rng=None, train=True):
         return p["wte"][tokens]
@@ -46,9 +46,7 @@ class GPTPosEmbed:
         self.cfg = cfg
 
     def init(self, rng):
-        cfg = self.cfg
-        return {"wpe": (jax.random.normal(rng, (cfg.max_seq_len, cfg.d_model))
-                        * 0.01).astype(cfg.param_dtype)}
+        return {"wpe": init_wpe(rng, self.cfg)}
 
     def apply(self, p, x, rng=None, train=True):
         S = x.shape[1]
@@ -74,9 +72,7 @@ class GPTFinalNorm:
         self.cfg = cfg
 
     def init(self, rng):
-        dt = self.cfg.param_dtype
-        return {"scale": jnp.ones((self.cfg.d_model,), dt),
-                "bias": jnp.zeros((self.cfg.d_model,), dt)}
+        return init_final_ln(self.cfg)
 
     def apply(self, p, x, rng=None, train=True):
         return layer_norm(x, p, self.cfg.layer_norm_eps)
@@ -89,9 +85,7 @@ class GPTHead:
         self.cfg = cfg
 
     def init(self, rng):
-        cfg = self.cfg
-        return {"w": (jax.random.normal(rng, (cfg.d_model, cfg.vocab_size))
-                      * 0.02).astype(cfg.param_dtype)}
+        return {"w": init_lm_head(rng, self.cfg)}
 
     def apply(self, p, x, rng=None, train=True):
         return x @ p["w"].astype(x.dtype)
@@ -126,6 +120,11 @@ def gpt_pipeline_module(cfg: GPTConfig, num_stages: int,
     if cfg.num_experts > 1:
         raise NotImplementedError("MoE blocks are not supported in the "
                                   "LayerSpec pipeline form yet")
+    if cfg.sequence_parallel:
+        raise NotImplementedError(
+            "sequence_parallel needs a `seq` mesh axis; the 1F1B engine's "
+            "per-stage meshes are data-only — use the SPMD executor "
+            "(cfg.pipeline_stages) or drop SP for the LayerSpec form")
     layers = [TiedLayerSpec("embed", GPTTokenEmbed, cfg)
               if cfg.tie_embeddings else LayerSpec(GPTTokenEmbed, cfg)]
     layers += [LayerSpec(GPTPosEmbed, cfg)]

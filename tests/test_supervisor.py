@@ -1,0 +1,92 @@
+"""Restart supervisor (beyond-reference failure recovery; SURVEY §5's
+missing elastic-recovery loop): relaunch-on-failure with backoff, budget
+reset after long-lived children, checkpoint-resumed training across a
+forced crash."""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity.supervisor import supervise
+
+
+def test_succeeds_first_try(tmp_path):
+    rc = supervise([sys.executable, "-c", "print('ok')"],
+                   max_restarts=2, backoff=0.01)
+    assert rc == 0
+
+
+def test_retries_until_success(tmp_path):
+    marker = tmp_path / "tries"
+    code = textwrap.dedent(f"""
+        import os, sys
+        p = {str(marker)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(0 if n >= 2 else 17)
+    """)
+    rc = supervise([sys.executable, "-c", code],
+                   max_restarts=5, backoff=0.01, backoff_cap=0.02)
+    assert rc == 0
+    assert int(marker.read_text()) == 3  # failed twice, succeeded third
+
+
+def test_exhausts_budget_and_reports_last_code(tmp_path):
+    rc = supervise([sys.executable, "-c", "import sys; sys.exit(23)"],
+                   max_restarts=2, backoff=0.01, backoff_cap=0.02)
+    assert rc == 23
+
+
+def test_crash_then_checkpoint_resume(tmp_path):
+    """The full loop: training crashes mid-run, the supervisor
+    relaunches, the fresh process resumes from the latest checkpoint and
+    finishes all steps exactly once each."""
+    script = tmp_path / "train.py"
+    script.write_text(textwrap.dedent(f"""
+        import os
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        sys.path.insert(0, {os.path.dirname(os.path.abspath(__file__))!r})
+        import numpy as np
+        import deepspeed_tpu as ds
+        from simple_model import SimpleModel
+
+        ckpt = {str(tmp_path / "ck")!r}
+        engine, *_ = ds.initialize(model=SimpleModel(), config_params={{
+            "train_batch_size": 32,
+            "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+            "steps_per_print": 0}})
+        engine.load_checkpoint(ckpt)           # no-op on the first run
+        rng = np.random.RandomState(0)
+        TOTAL = 6
+        while engine.global_steps < TOTAL:
+            x = rng.randn(32, 16).astype(np.float32)
+            y = (x @ np.ones((16, 4), np.float32) * 0.1)
+            engine.forward((x, y)); engine.backward(); engine.step()
+            engine.save_checkpoint(ckpt, tag=f"s{{engine.global_steps}}")
+            if engine.global_steps == 3 and not os.path.exists(
+                    {str(tmp_path / "crashed")!r}):
+                open({str(tmp_path / "crashed")!r}, "w").write("1")
+                os._exit(41)                   # simulated mid-run failure
+        print("DONE", engine.global_steps)
+    """))
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    import subprocess
+
+    # run the supervisor as a CLI (the ds_elastic-adjacent entry point)
+    r = subprocess.run(
+        [sys.executable, "-m", "deepspeed_tpu.elasticity.supervisor",
+         "--max-restarts", "3", "--backoff", "0.01", "--",
+         sys.executable, str(script)],
+        capture_output=True, text=True, env=env, timeout=600,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "DONE 6" in r.stdout
+    assert (tmp_path / "crashed").exists()  # the crash really happened
