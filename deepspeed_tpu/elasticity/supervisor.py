@@ -43,10 +43,20 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
     delay = backoff
     attempt = 0
     child = None
+    stop_signal = None
 
     def forward(signum, _frame):
+        # an operator/scheduler signal means STOP, not "restart harder":
+        # remember it so the loop exits instead of relaunching
+        nonlocal stop_signal
+        stop_signal = signum
         if child is not None and child.poll() is None:
             child.send_signal(signum)
+
+    def to_exit_code(rc):
+        # negative Popen rc (signal-killed child) -> conventional
+        # 128+signum so sys.exit doesn't wrap it mod 256 into noise
+        return 128 - rc if rc < 0 else rc
 
     old_int = signal.signal(signal.SIGINT, forward)
     old_term = signal.signal(signal.SIGTERM, forward)
@@ -63,19 +73,26 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 logger.info(f"supervisor: command succeeded after "
                             f"{attempt} attempt(s)")
                 return 0
+            if stop_signal is not None:
+                logger.info(f"supervisor: stopping on signal "
+                            f"{stop_signal} (child exit {rc})")
+                return 128 + int(stop_signal)
             if ran_for >= success_window:
                 restarts_left = max_restarts
                 delay = backoff
             if restarts_left <= 0:
                 logger.error(f"supervisor: giving up after {attempt} "
                              f"attempt(s); last exit code {rc}")
-                return rc
+                return to_exit_code(rc)
             restarts_left -= 1
             logger.warning(
                 f"supervisor: exit code {rc} after {ran_for:.1f}s; "
                 f"relaunching in {delay:.1f}s "
                 f"({restarts_left} restart(s) left)")
             time.sleep(delay)
+            if stop_signal is not None:  # signal arrived during backoff
+                logger.info(f"supervisor: stopping on signal {stop_signal}")
+                return 128 + int(stop_signal)
             delay = min(delay * 2, backoff_cap)
     finally:
         signal.signal(signal.SIGINT, old_int)

@@ -90,3 +90,44 @@ def test_crash_then_checkpoint_resume(tmp_path):
     assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
     assert "DONE 6" in r.stdout
     assert (tmp_path / "crashed").exists()  # the crash really happened
+
+
+def test_sigterm_stops_instead_of_restarting(tmp_path):
+    """Operator/scheduler signals STOP the supervisor (128+signum exit);
+    they must never be treated as a failure to retry."""
+    import signal
+    import subprocess
+    import time
+
+    launches = tmp_path / "launches"
+    code = textwrap.dedent(f"""
+        import time
+        p = {str(launches)!r}
+        import os
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        time.sleep(30)
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.elasticity.supervisor",
+         "--max-restarts", "5", "--backoff", "0.05", "--",
+         sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.time() + 60
+    while not launches.exists() and time.time() < deadline:
+        time.sleep(0.2)
+    time.sleep(1.0)  # child is in its sleep; supervisor in wait()
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=60)
+    assert rc == 128 + signal.SIGTERM, rc
+    assert int(launches.read_text()) == 1  # never relaunched
+
+
+def test_signal_killed_child_maps_to_128_plus_signum(tmp_path):
+    """A child that dies on an uncaught signal (e.g. OOM SIGKILL)
+    yields the conventional 128+signum, not a negative rc."""
+    rc = supervise(
+        [sys.executable, "-c",
+         "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"],
+        max_restarts=1, backoff=0.01, backoff_cap=0.02)
+    assert rc == 128 + 9

@@ -52,9 +52,11 @@ def mse(out, labels):
 
 
 def time_engine(stages, micro_batches, d=256, f=1024, micro_size=8,
-                reps=5):
-    mod = PipelineModule([LayerSpec(Blk, d, f) for _ in range(stages * 2)],
-                         num_stages=stages, loss_fn=mse)
+                reps=5, interleave=1, n_layers=None):
+    mod = PipelineModule([LayerSpec(Blk, d, f)
+                          for _ in range(n_layers or stages * 2)],
+                         num_stages=stages, loss_fn=mse,
+                         interleave=interleave)
     engine, *_ = deepspeed_tpu.initialize(model=mod, config_params={
         "train_batch_size": micro_size * micro_batches,
         "train_micro_batch_size_per_gpu": micro_size,
@@ -99,6 +101,19 @@ def main():
     print(f"per-tick fit a={a * 1000:.1f} ms, max residual {resid:.1%} "
           f"(small residual => wall time follows the tick model; "
           f"bubble shrinks as (P-1)/(M+P-1))")
+
+    # interleaved virtual stages: same model depth, bubble /v
+    print(f"\ninterleaved 1F1B (P=2 physical stages, same total layers): "
+          f"theoretical bubble (P-1)/(v*M+P-1)")
+    print(f"{'v':>3} {'M':>4} {'s/batch':>9} {'s/micro':>9} {'bubble%':>8}")
+    for v in (1, 2):
+        for M in (4, 8):
+            # SAME total depth (8 layers) for every v — only the chunking
+            # changes, so s/micro differences are schedule, not model
+            dt, _ = time_engine(2, M, interleave=v, n_layers=8)
+            bubble = (2 - 1) / (v * M + 2 - 1) * 100
+            print(f"{v:>3} {M:>4} {dt:>9.3f} {dt / M:>9.3f} "
+                  f"{bubble:>7.1f}%")
 
 
 if __name__ == "__main__":
