@@ -16,8 +16,11 @@ Usage (also `ds_elastic supervise -- ...`):
         [--max-restarts 10] [--backoff 5] [--success-window 300] \
         -- deepspeed --hostfile hostfile train.py --deepspeed_config c.json
 
-Exit code: the child's final exit code (0 if it eventually succeeds,
-the last failure code once restarts are exhausted).
+Exit code: 0 if the command eventually succeeds; once restarts are
+exhausted, the last child exit code (signal-killed children map to the
+conventional 128+signum); 128+signum when the supervisor itself is
+stopped by SIGINT/SIGTERM (operator signals stop the loop, they are
+never retried).
 """
 
 from __future__ import annotations
@@ -58,15 +61,32 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
         # 128+signum so sys.exit doesn't wrap it mod 256 into noise
         return 128 - rc if rc < 0 else rc
 
+    def interruptible_sleep(seconds):
+        # PEP 475 restarts time.sleep after a handled signal — sleep in
+        # slices so a stop signal ends the backoff promptly
+        end = time.monotonic() + seconds
+        while stop_signal is None:
+            left = end - time.monotonic()
+            if left <= 0:
+                return
+            time.sleep(min(left, 0.5))
+
     old_int = signal.signal(signal.SIGINT, forward)
     old_term = signal.signal(signal.SIGTERM, forward)
     try:
         while True:
+            if stop_signal is not None:  # landed before (re)launch
+                logger.info(f"supervisor: stopping on signal {stop_signal}")
+                return 128 + int(stop_signal)
             attempt += 1
             start = time.monotonic()
             logger.info(f"supervisor: launching attempt {attempt}: "
                         f"{' '.join(command)}")
             child = subprocess.Popen(command)
+            if stop_signal is not None:
+                # raced the launch: the handler saw the OLD child; pass
+                # the stop on to the one we just started
+                child.send_signal(stop_signal)
             rc = child.wait()
             ran_for = time.monotonic() - start
             if rc == 0:
@@ -89,7 +109,7 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 f"supervisor: exit code {rc} after {ran_for:.1f}s; "
                 f"relaunching in {delay:.1f}s "
                 f"({restarts_left} restart(s) left)")
-            time.sleep(delay)
+            interruptible_sleep(delay)
             if stop_signal is not None:  # signal arrived during backoff
                 logger.info(f"supervisor: stopping on signal {stop_signal}")
                 return 128 + int(stop_signal)

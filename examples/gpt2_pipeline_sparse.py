@@ -101,7 +101,7 @@ def run_1f1b(args, n_dev):
         [TiedLayerSpec("emb", Embed)]
         + [LayerSpec(SparseBlock) for _ in range(3)]
         + [TiedLayerSpec("emb", Embed, forward_fn=head)],
-        num_stages=2, loss_fn=ce)
+        num_stages=2, loss_fn=ce, interleave=args.interleave)
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=mod,
         config_params={
@@ -118,12 +118,17 @@ def run_1f1b(args, n_dev):
         data = list(token_batches(4, args.micro, args.seq, V,
                                   seed=step))
         losses.append(float(engine.train_batch(iter(data))))
-    return "pipeline 1f1b + sparse-attn", losses
+    name = "pipeline 1f1b"
+    if args.interleave > 1:
+        name += f" x{args.interleave} interleaved"
+    return name + " + sparse-attn", losses
 
 
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--executor", choices=["spmd", "1f1b"], default="1f1b")
+    ap.add_argument("--interleave", type=int, default=1,
+                    help="virtual model chunks per stage (1f1b executor)")
     ap.add_argument("--seq", type=int, default=64)
     ap.add_argument("--micro", type=int, default=4)
     ap.add_argument("--steps", type=int, default=25)

@@ -131,3 +131,35 @@ def test_signal_killed_child_maps_to_128_plus_signum(tmp_path):
          "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"],
         max_restarts=1, backoff=0.01, backoff_cap=0.02)
     assert rc == 128 + 9
+
+
+def test_sigterm_during_backoff_stops_promptly(tmp_path):
+    """A stop signal during a long backoff must end the loop in well
+    under the backoff delay (interruptible sleep), with no relaunch."""
+    import signal
+    import subprocess
+    import time
+
+    launches = tmp_path / "n"
+    code = textwrap.dedent(f"""
+        import os, sys
+        p = {str(launches)!r}
+        n = int(open(p).read()) if os.path.exists(p) else 0
+        open(p, "w").write(str(n + 1))
+        sys.exit(7)    # fail fast -> supervisor enters backoff
+    """)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "deepspeed_tpu.elasticity.supervisor",
+         "--max-restarts", "5", "--backoff", "120", "--",
+         sys.executable, "-c", code],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    deadline = time.time() + 60
+    while not launches.exists() and time.time() < deadline:
+        time.sleep(0.2)
+    time.sleep(2.0)  # child exited; supervisor is inside the 120s backoff
+    t0 = time.time()
+    proc.send_signal(signal.SIGTERM)
+    rc = proc.wait(timeout=30)
+    assert rc == 128 + signal.SIGTERM, rc
+    assert time.time() - t0 < 10       # did NOT sit out the backoff
+    assert int(launches.read_text()) == 1
