@@ -230,8 +230,9 @@ def test_interleaved_schedule_invariants():
         InterleavedTrainSchedule(3, 2, 0, 2)
 
 
-def test_interleaved_loss_parity_vs_sequential():
-    """PP=2 x 2 virtual chunks trains the tied model to the same losses
+@pytest.mark.parametrize("stages,chunks", [(2, 2), (2, 3), (4, 2)])
+def test_interleaved_loss_parity_vs_sequential(stages, chunks):
+    """PP x virtual chunks trains the tied model to the same losses
     as the single-stage baseline — the interleaved wrap routing
     (stage P-1 chunk c -> stage 0 chunk c+1) is numerically invisible."""
     def run(num_stages, interleave, steps=3):
@@ -245,9 +246,9 @@ def test_interleaved_loss_parity_vs_sequential():
         return losses, engine
 
     seq_losses, _ = run(1, 1)
-    il_losses, engine = run(2, 2)
-    assert engine._staged and engine._v == 2
-    assert len(engine.stages) == 4
+    il_losses, engine = run(stages, chunks)
+    assert engine._staged and engine._v == chunks
+    assert len(engine.stages) == stages * chunks
     np.testing.assert_allclose(il_losses, seq_losses, rtol=1e-4, atol=1e-5)
     assert il_losses[-1] < il_losses[0]
     # tied copies stay synchronized across NON-adjacent model chunks
