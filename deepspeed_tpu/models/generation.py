@@ -116,7 +116,11 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
                 sorted_desc = jnp.where(sorted_desc < kth, -jnp.inf,
                                         sorted_desc)
             if top_p < 1.0:
-                # nucleus: keep the smallest set with cum prob > top_p
+                # nucleus: keep the smallest set with cum prob > top_p.
+                # Boundary semantics match modern HF TopPLogitsWarper, which
+                # removes (ascending sort) where cumsum <= 1-top_p — i.e.
+                # keep while the PREVIOUS descending cumulative is strictly
+                # < top_p. Exact-boundary ties drop the marginal token.
                 probs = jax.nn.softmax(sorted_desc, axis=-1)
                 cum = jnp.cumsum(probs, axis=-1)
                 keep = jnp.sum(cum - probs < top_p, axis=-1, keepdims=True)

@@ -335,16 +335,19 @@ class DeepSpeedEngine:
             param_shardings=self.zero_plan.param_shardings())
 
     def _configure_lr_scheduler(self, client_scheduler):
-        if client_scheduler is not None:
-            return client_scheduler
-        name = self._config.scheduler_name
-        if name is None:
-            return None
-        if name not in SCHEDULERS:
-            raise ValueError(f"unknown scheduler {name!r}")
-        sched = SCHEDULERS[name](self.optimizer,
-                                 **(self._config.scheduler_params or {}))
-        log_dist(f"using scheduler {name}", ranks=[0])
+        sched = client_scheduler
+        if sched is None:
+            name = self._config.scheduler_name
+            if name is None:
+                return None
+            if name not in SCHEDULERS:
+                raise ValueError(f"unknown scheduler {name!r}")
+            sched = SCHEDULERS[name](self.optimizer,
+                                     **(self._config.scheduler_params or {}))
+            log_dist(f"using scheduler {name}", ranks=[0])
+        warn_hook = getattr(self.optimizer, "warn_if_rescale_inexact", None)
+        if warn_hook is not None:
+            warn_hook()
         return sched
 
     # ------------------------------------------------------------------
@@ -880,8 +883,11 @@ class DeepSpeedEngine:
     def _model_supports_capture(self) -> bool:
         import inspect
 
+        loss_fn = getattr(self.module, "loss", None)
+        if loss_fn is None:
+            return False
         try:
-            sig = inspect.signature(self.module.loss)
+            sig = inspect.signature(loss_fn)
         except (TypeError, ValueError):
             return False
         return "capture_layers" in sig.parameters

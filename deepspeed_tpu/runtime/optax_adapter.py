@@ -21,6 +21,7 @@ optax optimizers)."""
 
 from __future__ import annotations
 
+import warnings
 from typing import Any, Optional
 
 import jax
@@ -38,6 +39,7 @@ class OptaxOptimizer:
         transform's own internal rate."""
         self.transform = transform
         self.param_groups = [dict(lr=1.0 if lr is None else float(lr))]
+        self._warned_rescale = False
 
     @property
     def lr(self):
@@ -77,6 +79,38 @@ class OptaxOptimizer:
                           u.astype(jnp.float32)).astype(p.dtype),
             params, updates)
         return new_params, {"optax": new_opt, "_base_lr": base_lr}
+
+    def warn_if_rescale_inexact(self) -> None:
+        """Engine hook, called once when an lr scheduler is attached. The
+        scheduler's lr reaches update() as a traced array, so the footgun
+        (scheduler emits absolute lrs while base_lr defaulted to 1.0 and
+        the transform has its own rate baked in — effective lr becomes the
+        PRODUCT) can only be diagnosed here, before tracing."""
+        if self._warned_rescale:
+            return
+        try:  # cheap probe: does init expose inject_hyperparams' dict?
+            state = self.transform.init({"_p": jnp.zeros((1,), jnp.float32)})
+        except Exception:
+            # structure-sensitive transform (multi_transform, masked, ...):
+            # can't tell from a dummy tree whether injection works — stay
+            # silent rather than false-alarm (best-effort diagnostic only)
+            return
+        _, handled = self._inject_lr(state, self.lr)
+        if handled:
+            return  # exact lr injection available; no rescale fallback
+        if self.param_groups[0]["lr"] == 1.0:
+            warnings.warn(
+                "OptaxOptimizer: an lr scheduler is attached but the "
+                "transform was not built with optax.inject_hyperparams, so "
+                "scheduler values are applied by multiplicative rescale "
+                "against base_lr=1.0. If the transform has its own learning "
+                "rate baked in, the scheduler value MULTIPLIES it (e.g. "
+                "1e-3 x 1e-3 = 1e-6 effective). Pass lr=<the transform's "
+                "rate> to OptaxOptimizer, or build it with "
+                "optax.inject_hyperparams for exact injection. The rescale "
+                "is only exact for transforms ending in "
+                "scale_by_learning_rate.", stacklevel=2)
+            self._warned_rescale = True
 
     # torch-parity niceties used by checkpoint/save paths
     def state_dict(self) -> Any:

@@ -72,6 +72,15 @@ class CrossProcessGradReducer:
 
         self.nprocs = jax.process_count()
         devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        counts = {}
+        for d in devs:
+            counts[d.process_index] = counts.get(d.process_index, 0) + 1
+        if len(set(counts.values())) > 1 or len(counts) != self.nprocs:
+            raise ValueError(
+                f"CrossProcessGradReducer needs a uniform device count per "
+                f"process; got per-process counts {counts}. Heterogeneous "
+                f"hosts are unsupported — exclude the uneven host or pin "
+                f"JAX to an equal device subset.")
         per_proc = len(devs) // self.nprocs
         grid = np.array(devs).reshape(self.nprocs, per_proc)
         self.mesh = Mesh(grid, ("proc", "dev"))

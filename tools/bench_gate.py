@@ -1,0 +1,28 @@
+"""Shared gate for TPU measurement artifacts.
+
+Exit 0 iff the given bench JSON file's last JSON line reports a run on
+real hardware (platform present and not the cpu-smoke fallback).  Used by
+tools/tpu_session.sh (fail-fast after the headline bench) and anything
+else that needs to decide whether an artifact is trustworthy."""
+
+import json
+import sys
+
+
+def main() -> int:
+    path = sys.argv[1] if len(sys.argv) > 1 else "/tmp/tpu_bench.json"
+    try:
+        lines = [l for l in open(path) if l.strip().startswith("{")]
+        d = json.loads(lines[-1])
+    except Exception as e:  # missing/empty/unparseable artifact
+        print(f"gate: no parseable bench line in {path}: {e}")
+        return 1
+    if d.get("platform") in (None, "cpu-smoke"):
+        print("gate: bench did not run on TPU:", d.get("platform"))
+        return 1
+    print("gate: valid:", d.get("metric"), d.get("value"), d.get("platform"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

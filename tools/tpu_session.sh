@@ -1,14 +1,39 @@
 #!/bin/bash
 # One-shot TPU measurement session — run when the axon tunnel is back.
-# Produces: /tmp/tpu_bench.json, /tmp/tpu_sweep_{ce,flash,batch}.txt
+# Produces: /tmp/tpu_bench.json, /tmp/tpu_sweep_{ce,flash,batch,sparse}.txt,
+#           /tmp/tpu_bert{128,512}.json, /tmp/tpu_session_status (one
+#           "name rc" line per command so consumers can tell which
+#           artifacts are trustworthy).
+# Exit: 0 iff the headline bench produced a valid on-TPU JSON line
+# (tools/bench_gate.py). Sweep failures don't fail the session (their rc
+# is in the status file).
 set -x
 cd "$(dirname "$0")/.."
-timeout 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
-timeout 2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
-timeout 2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
-timeout 3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
-timeout 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
-timeout 1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
-timeout 1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
-timeout 1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
+STATUS=/tmp/tpu_session_status
+: > "$STATUS"
+
+run() { # run <name> <timeout> <cmd...> — record rc, never abort the session
+  local name=$1 tmo=$2; shift 2
+  timeout "$tmo" "$@"
+  echo "$name $?" >> "$STATUS"
+}
+
+run bench 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
+# gate FIRST: if the headline bench failed or fell back to cpu-smoke, don't
+# spend hours sweeping a dead/CPU backend — fail fast so the watcher re-probes.
+# The gate verdict (not bench's rc — bench.py never exits nonzero) is the
+# trust signal for the headline artifact.
+if ! python tools/bench_gate.py /tmp/tpu_bench.json; then
+  echo "gate 1" >> "$STATUS"
+  exit 1
+fi
+echo "gate 0" >> "$STATUS"
+run sweep_ce     2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
+run sweep_flash  2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
+run sweep_batch  3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
+run sweep_sparse 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
+run bert128      1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
+run bert512      1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
+run profile      1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
+cat "$STATUS"
 echo done
