@@ -39,6 +39,10 @@ class OptaxOptimizer:
         transform's own internal rate."""
         self.transform = transform
         self.param_groups = [dict(lr=1.0 if lr is None else float(lr))]
+        # schedulers may overwrite param_groups lr in their ctor (e.g.
+        # LRRangeTest), so whether the user left lr defaulted must be
+        # recorded now for warn_if_rescale_inexact
+        self._lr_was_default = lr is None
         self._warned_rescale = False
 
     @property
@@ -98,7 +102,7 @@ class OptaxOptimizer:
         _, handled = self._inject_lr(state, self.lr)
         if handled:
             return  # exact lr injection available; no rescale fallback
-        if self.param_groups[0]["lr"] == 1.0:
+        if self._lr_was_default:
             warnings.warn(
                 "OptaxOptimizer: an lr scheduler is attached but the "
                 "transform was not built with optax.inject_hyperparams, so "

@@ -10,7 +10,12 @@
 cd "$(dirname "$0")/.."
 rm -f /tmp/tpu_ready /tmp/tpu_done /tmp/tpu_failed
 MAX_ATTEMPTS=${MAX_ATTEMPTS:-5}
+# hard cap on failed sessions of ANY classification, so a failure mode that
+# also kills the post-failure probe (e.g. a bench that wedges the backend)
+# can't loop forever while looking "transient" every time
+MAX_FAILED_SESSIONS=${MAX_FAILED_SESSIONS:-12}
 attempts=0
+failed_sessions=0
 
 probe() { # same liveness check bench.py uses: any non-cpu default backend
   timeout 120 python -c "import jax; b=jax.default_backend(); assert b != 'cpu', b; print('TPU up, backend:', b, jax.devices())" >> /tmp/tpu_watch.log 2>&1
@@ -27,6 +32,12 @@ while true; do
       exit 0
     fi
     rm -f /tmp/tpu_ready
+    failed_sessions=$((failed_sessions+1))
+    if [ "$failed_sessions" -ge "$MAX_FAILED_SESSIONS" ]; then
+      touch /tmp/tpu_failed
+      echo "[$(date +%F_%T)] giving up: $MAX_FAILED_SESSIONS failed sessions total" >> /tmp/tpu_watch.log
+      exit 1
+    fi
     # Transient vs deterministic: re-probe immediately after the failure.
     # Tunnel gone -> the session died because the TPU vanished mid-run (the
     # start-of-session probe saw it up) — don't count. Tunnel still up ->
