@@ -174,6 +174,12 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         self.zero_optimization_stage = self.zero_config.stage
         self.zero_enabled = self.zero_optimization_stage > 0
 
+        # pipeline: use_p2p_channels forces the multi-host channel
+        # executor even single-process (the driver's virtual-multichip
+        # dryrun runs the real cross-process code path this way)
+        self.pipe_use_p2p_channels = bool(
+            (pd.get("pipeline") or {}).get("use_p2p_channels", False))
+
         self.activation_checkpointing_config = \
             DeepSpeedActivationCheckpointingConfig(pd)
         self.flops_profiler_config = DeepSpeedFlopsProfilerConfig(pd)

@@ -46,6 +46,7 @@ from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
 from ..utils import has_overflow
 from .module import PipelineModule, TiedLayerSpec
+from .p2p import Channel, GlobalScalars
 from .schedule import (BackwardPass, ForwardPass, InterleavedTrainSchedule,
                        LoadMicroBatch, OptimizerStep, RecvActivation,
                        RecvGrad, ReduceGrads, ReduceTiedGrads,
@@ -168,26 +169,32 @@ class _StageRuntime:
             self.bwd_j = jax.jit(bwd_mid, donate_argnums=(5, 6))
 
     def build_apply(self, optimizer, clip):
-        def sq_norm(acc, denom):
-            return sum(jnp.sum(jnp.square(g / denom))
-                       for g in jax.tree_util.tree_leaves(acc))
+        def detect(acc, denom):
+            sq = sum(jnp.sum(jnp.square(g / denom))
+                     for g in jax.tree_util.tree_leaves(acc))
+            return sq, has_overflow(acc)
 
-        self.sq_norm_j = jax.jit(sq_norm)
+        # one fused pass: squared grad norm (for global clipping) + local
+        # overflow flag. The engine ORs the flags across stages BEFORE
+        # apply, so an overflow anywhere skips the step everywhere —
+        # per-stage skipping would desynchronize the stages' parameters
+        # from the non-pipelined run (reference fp16 semantics: the whole
+        # step is skipped)
+        self.detect_j = jax.jit(detect)
 
-        def apply_step(own, opt_state, acc, lr, denom, clip_coef):
+        def apply_step(own, opt_state, acc, lr, denom, clip_coef, overflow):
             # clip_coef carries the GLOBAL-norm clipping factor (computed
             # across all stages by the engine) — per-stage local clipping
             # would change the update direction vs the non-pipelined run
             grads = jax.tree_util.tree_map(
                 lambda g: g * (clip_coef / denom), acc)
-            overflow = has_overflow(grads)
             new_own, new_opt = optimizer.update(grads, opt_state, own, lr=lr)
             sel = lambda new, old: jax.tree_util.tree_map(
                 lambda n, o: jnp.where(overflow, o, n), new, old)
             new_own = sel(new_own, own)
             new_opt = sel(new_opt, opt_state)
             zero = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return new_own, new_opt, zero, overflow
+            return new_own, new_opt, zero
 
         self.apply_j = jax.jit(apply_step, donate_argnums=(0, 1, 2))
 
@@ -231,8 +238,19 @@ class PipelineEngine(DeepSpeedEngine):
                 f"PipelineModule wants {module.num_stages} stages but only "
                 f"{len(jax.devices())} devices are visible; running "
                 f"single-stage through the base engine")
+        # multi-host: each process owns one physical stage and executes
+        # only its own chunks; handoffs ride p2p.Channel collectives
+        # (reference pipe/p2p.py:31-75). Also selectable single-process
+        # via pipeline.use_p2p_channels for the driver's virtual-multichip
+        # dryrun, which then exercises the multi-host code path verbatim.
+        self._mh = bool(self._staged and (
+            jax.process_count() > 1
+            or self._config.pipe_use_p2p_channels))
         if self._staged:
-            self._build_stages()
+            if self._mh:
+                self._build_stages_mh()
+            else:
+                self._build_stages()
 
     # ------------------------------------------------------------------
     # staged construction
@@ -245,26 +263,14 @@ class PipelineEngine(DeepSpeedEngine):
         self._n_phys = P
         self._v = v
         n_mc = P * v  # model chunks; chunk index mc = chunk_id * P + stage
+        self._n_mc = n_mc
         devices = jax.devices()
         G = len(devices) // P
         clip = float(self._config.gradient_clipping or 0.0)
 
         # tied ownership: first MODEL CHUNK containing each tied key
-        def chunk_of_layer(i):
-            for mc in range(n_mc):
-                if module.parts[mc] <= i < module.parts[mc + 1]:
-                    return mc
-            return n_mc - 1
-
-        tied_owner: Dict[str, int] = {}
-        tied_users: Dict[str, set] = {}
-        for i, spec in enumerate(module.layer_specs):
-            if isinstance(spec, TiedLayerSpec):
-                mc = chunk_of_layer(i)
-                tied_owner.setdefault(spec.key, mc)
-                tied_users.setdefault(spec.key, set()).add(mc)
-        self._tied_owner = tied_owner
-        self._tied_users = tied_users
+        self._tied_owner, self._tied_users = self._tied_maps(module, n_mc)
+        tied_owner, tied_users = self._tied_owner, self._tied_users
 
         # whole-model params were built by the base engine; redistribute.
         # self.stages is in MODEL-CHUNK order (= model order), so every
@@ -310,6 +316,476 @@ class PipelineEngine(DeepSpeedEngine):
             ranks=[0])
 
     # ------------------------------------------------------------------
+    # multi-host construction (one physical stage per process)
+    # ------------------------------------------------------------------
+
+    def _tied_maps(self, module, n_mc):
+        def chunk_of_layer(i):
+            for mc in range(n_mc):
+                if module.parts[mc] <= i < module.parts[mc + 1]:
+                    return mc
+            return n_mc - 1
+
+        tied_owner: Dict[str, int] = {}
+        tied_users: Dict[str, set] = {}
+        for i, spec in enumerate(module.layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                mc = chunk_of_layer(i)
+                tied_owner.setdefault(spec.key, mc)
+                tied_users.setdefault(spec.key, set()).add(mc)
+        return tied_owner, tied_users
+
+    def _build_stages_mh(self):
+        """Per-process stage build: this process materializes ONLY its own
+        model chunks; adjacent chunks on other processes are reached
+        through p2p.Channel collectives. Single-process (the dryrun), all
+        chunks are local and the channels are purely local collectives —
+        the code path is identical."""
+        module: PipelineModule = self.module
+        P = module.num_stages
+        v = getattr(module, "interleave", 1)
+        self._n_phys = P
+        self._v = v
+        n_mc = P * v
+        self._n_mc = n_mc
+        nprocs = jax.process_count()
+        me = jax.process_index()
+        if nprocs > 1 and P != nprocs:
+            raise ValueError(
+                f"multi-host pipeline runs one physical stage per process: "
+                f"num_stages={P} but process_count={nprocs}")
+        clip = float(self._config.gradient_clipping or 0.0)
+
+        # device group of each physical stage: the owning process's local
+        # devices multi-host; equal slices of the local devices otherwise
+        groups: Dict[int, list] = {}
+        if nprocs > 1:
+            for d in jax.devices():
+                groups.setdefault(d.process_index, []).append(d)
+            for q in groups:
+                groups[q] = sorted(groups[q], key=lambda d: d.id)
+            sizes = {len(g) for g in groups.values()}
+            if len(sizes) != 1:
+                raise ValueError(
+                    f"uniform devices-per-process required, got "
+                    f"{ {q: len(g) for q, g in groups.items()} }")
+        else:
+            devs = jax.devices()
+            G = len(devs) // P
+            groups = {q: devs[q * G:(q + 1) * G] for q in range(P)}
+        self._groups = groups
+
+        self._tied_owner, self._tied_users = self._tied_maps(module, n_mc)
+
+        full = jax.tree_util.tree_map(np.asarray, self._params)
+        abst = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+        self._abs_layers = [abst(lp) for lp in full["layers"]]
+        self._abs_tied = {k: abst(t) for k, t in full["tied"].items()}
+
+        def mine(mc):
+            return nprocs == 1 or mc % P == me
+
+        self._local: Dict[int, _StageRuntime] = {}
+        for mc in range(n_mc):
+            if not mine(mc):
+                continue
+            lo, hi = module.parts[mc], module.parts[mc + 1]
+            rt = _StageRuntime(
+                stage_id=mc,
+                layers=module._layers[lo:hi],
+                specs=module.layer_specs[lo:hi],
+                devices=groups[mc % P],
+                is_last=(mc == n_mc - 1),
+                loss_fn=module.loss_fn,
+                compute_dtype=self.compute_dtype)
+            own_tied = {k: full["tied"][k]
+                        for k, o in self._tied_owner.items() if o == mc}
+            ro_tied = {k: full["tied"][k]
+                       for k, users in self._tied_users.items()
+                       if mc in users and self._tied_owner[k] != mc}
+            rt.own = rt.place_replicated(
+                {"layers": full["layers"][lo:hi], "tied": own_tied})
+            rt.ro_tied = rt.place_replicated(ro_tied)
+            rt.opt_state = rt.place_replicated(self.optimizer.init(rt.own))
+            rt.build_apply(self.optimizer, clip)
+            rt.zero_acc()
+            self._local[mc] = rt
+
+        self._params = None
+        self._opt_state = None
+        self._grad_acc = None
+
+        # channels this process participates in (all of them when
+        # single-process). Keyed by the SENDING chunk.
+        def endpoint(a, b):
+            return nprocs == 1 or me in (a % P, b % P)
+
+        self._chan_act: Dict[int, Channel] = {}
+        self._chan_grad: Dict[int, Channel] = {}
+        for mc in range(n_mc - 1):
+            if endpoint(mc, mc + 1):
+                self._chan_act[mc] = Channel(groups[mc % P],
+                                             groups[(mc + 1) % P])
+        for mc in range(1, n_mc):
+            if endpoint(mc, mc - 1):
+                self._chan_grad[mc] = Channel(groups[mc % P],
+                                              groups[(mc - 1) % P])
+        self._chan_tied_grad: Dict[Any, Channel] = {}
+        self._chan_tied_param: Dict[Any, Channel] = {}
+        for key, users in self._tied_users.items():
+            o = self._tied_owner[key]
+            for u in sorted(users):
+                if u == o or u % P == o % P:
+                    continue
+                if endpoint(u, o):
+                    self._chan_tied_grad[(key, u)] = Channel(
+                        groups[u % P], groups[o % P], replicate=True)
+                    self._chan_tied_param[(key, u)] = Channel(
+                        groups[o % P], groups[u % P], replicate=True)
+        self._gscal = GlobalScalars()
+        self._aval_cache: Dict[Any, Any] = {}
+        log_dist(
+            f"pipeline (p2p channels): {P} stages over {nprocs} "
+            f"process(es), local chunks {sorted(self._local)}, "
+            f"partitions {module.parts}", ranks=[0])
+
+    def _chunk_out_avals(self, x_aval):
+        """Output aval of every model chunk, derived locally by abstract
+        evaluation over the full layer stack — every process has the
+        module description and the init-param shapes, so no shape
+        handshake is needed (the reference sends meta tensors first,
+        p2p.py:88-120)."""
+        key = (tuple(x_aval.shape), str(x_aval.dtype))
+        if key in self._aval_cache:
+            return self._aval_cache[key]
+        module: PipelineModule = self.module
+        dtype = self.compute_dtype
+        outs = []
+        x = x_aval
+        for mc in range(self._n_mc):
+            lo, hi = module.parts[mc], module.parts[mc + 1]
+            layers = module._layers[lo:hi]
+            specs = module.layer_specs[lo:hi]
+
+            def fwd(lparams, tied, xx, layers=layers, specs=specs):
+                cast = lambda t: jax.tree_util.tree_map(
+                    lambda a: a.astype(dtype)
+                    if jnp.issubdtype(a.dtype, jnp.floating) else a, t)
+                lparams = cast(lparams)
+                tied = cast(tied)
+                for layer, spec, p in zip(layers, specs, lparams):
+                    if isinstance(spec, TiedLayerSpec):
+                        p = tied[spec.key]
+                        if spec.forward_fn is not None:
+                            xx = spec.forward_fn(layer, p, xx)
+                            continue
+                    xx = layer.apply(p, xx, rng=None, train=False)
+                return xx
+
+            x = jax.eval_shape(fwd, self._abs_layers[lo:hi],
+                               self._abs_tied, x)
+            outs.append(x)
+        self._aval_cache[key] = outs
+        return outs
+
+    def _simulate_order(self, streams):
+        """Canonical global event order: replay the dependency-driven
+        executor symbolically. Every process derives the SAME list, so all
+        processes enter their common collectives in one global total
+        order — the property that makes the channel handoffs deadlock-free
+        regardless of how the 1F1B streams interleave."""
+        P = len(streams)
+        n = self._n_mc
+        sent_act = [0] * n
+        sent_grad = [0] * n
+        recv_act = [0] * n
+        recv_grad = [0] * n
+        mail_act, mail_grad = set(), set()
+        events, pos = [], [0] * P
+
+        def ready(s, tick):
+            for cmd in tick:
+                if isinstance(cmd, RecvActivation):
+                    mc = self._mc(s, cmd)
+                    if (mc, recv_act[mc]) not in mail_act:
+                        return False
+                if isinstance(cmd, RecvGrad):
+                    mc = self._mc(s, cmd)
+                    if (mc, recv_grad[mc]) not in mail_grad:
+                        return False
+            return True
+
+        while True:
+            progressed = False
+            done = True
+            for s in range(P):
+                while pos[s] < len(streams[s]):
+                    tick = streams[s][pos[s]]
+                    if not ready(s, tick):
+                        break
+                    for cmd in tick:
+                        mc = self._mc(s, cmd)
+                        if isinstance(cmd, SendActivation):
+                            mail_act.add((mc + 1, sent_act[mc]))
+                            sent_act[mc] += 1
+                        elif isinstance(cmd, RecvActivation):
+                            recv_act[mc] += 1
+                        elif isinstance(cmd, SendGrad):
+                            mail_grad.add((mc - 1, sent_grad[mc]))
+                            sent_grad[mc] += 1
+                        elif isinstance(cmd, RecvGrad):
+                            recv_grad[mc] += 1
+                        events.append((s, cmd))
+                    pos[s] += 1
+                    progressed = True
+                if pos[s] < len(streams[s]):
+                    done = False
+            if done:
+                return events
+            if not progressed:
+                raise RuntimeError(
+                    f"pipeline schedule deadlock in simulation at {pos}")
+
+    def _train_batch_mh(self, data_iter):
+        self.tput_timer.start()
+        M = self.micro_batches
+        # the multi-host data contract (same as the DP engines'): every
+        # process's iterator yields the identical micro-batch stream; the
+        # first chunk consumes inputs, the last consumes labels
+        self._mb_cache = [self._next_micro_batch_from(data_iter)
+                          for _ in range(M)]
+        x0 = np.asarray(self._mb_cache[0][0])
+        self._aval_out = self._chunk_out_avals(
+            jax.ShapeDtypeStruct(x0.shape, x0.dtype))
+        n = self._n_mc
+        P = self._n_phys
+        self._mail_act = {}
+        self._mail_grad = {}
+        self._sent_act_cnt = [0] * n
+        self._sent_grad_cnt = [0] * n
+        self._recv_act_cnt = [0] * n
+        self._recv_grad_cnt = [0] * n
+        self._load_cnt = 0
+        self._batch_key = self._next_rng()
+        self._step_applied = False
+        self._tied_reduced = False
+        for rt in self._local.values():
+            rt.losses = []
+            rt.fwd_count = 0
+            rt.bwd_count = 0
+        if self._v > 1:
+            streams = [list(InterleavedTrainSchedule(
+                M, P, s, self._v).steps()) for s in range(P)]
+        else:
+            streams = [list(TrainSchedule(M, P, s).steps())
+                       for s in range(P)]
+        for s, cmd in self._simulate_order(streams):
+            self._dispatch_mh(s, cmd)
+        self.micro_steps += M
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(report_speed=False)
+        if self.steps_per_print() and \
+                self.global_steps % self.steps_per_print() == 0:
+            log_dist(f"pipe step={self.global_steps} "
+                     f"loss={float(self._last_loss):.4f}", ranks=[0])
+        return self._last_loss
+
+    def _dispatch_mh(self, s: int, cmd):
+        mc = self._mc(s, cmd)
+        rt = self._local.get(mc)
+        b = getattr(cmd, "buffer_id", None)
+        if isinstance(cmd, LoadMicroBatch):
+            mb = self._load_cnt
+            self._load_cnt += 1
+            if rt is not None:
+                rt.x_in[b] = rt.place_batch(self._mb_cache[mb][0])
+        elif isinstance(cmd, RecvActivation):
+            mb = self._recv_act_cnt[mc]
+            self._recv_act_cnt[mc] += 1
+            if rt is not None:
+                rt.x_in[b] = self._mail_act.pop((mc, mb))
+        elif isinstance(cmd, ForwardPass):
+            if rt is None:
+                return
+            mb = rt.fwd_count
+            rt.fwd_count += 1
+            rng = jax.random.fold_in(self._batch_key, mb * self._n_mc + mc)
+            rt.rng_in[b] = rng
+            if rt.is_last:
+                labels = rt.place_batch(np.asarray(self._mb_cache[mb][1]))
+                rt.labels[mb] = labels
+                rt.y_out[b] = None
+                rt.losses.append(rt.loss_j(rt.own, rt.ro_tied, rt.x_in[b],
+                                           labels, rng))
+            else:
+                rt.y_out[b] = rt.fwd_j(rt.own, rt.ro_tied, rt.x_in[b], rng)
+        elif isinstance(cmd, SendActivation):
+            mb = self._sent_act_cnt[mc]
+            self._sent_act_cnt[mc] += 1
+            chan = self._chan_act.get(mc)
+            if chan is None:
+                return
+            y = rt.y_out.pop(b) if rt is not None else None
+            res = chan.transfer(self._aval_out[mc], y)
+            if res is not None:
+                self._mail_act[(mc + 1, mb)] = res
+        elif isinstance(cmd, RecvGrad):
+            mb = self._recv_grad_cnt[mc]
+            self._recv_grad_cnt[mc] += 1
+            if rt is not None:
+                rt.dy_in = getattr(rt, "dy_in", {})
+                rt.dy_in[b] = self._mail_grad.pop((mc, mb))
+        elif isinstance(cmd, BackwardPass):
+            if rt is None:
+                return
+            mb = rt.bwd_count
+            rt.bwd_count += 1
+            x = rt.x_in.pop(b)
+            rng = rt.rng_in.pop(b)
+            if rt.is_last:
+                scale = self._scaler_state["cur_scale"]
+                labels = rt.labels.pop(mb)
+                dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                    rt.own, rt.ro_tied, x, labels, rng, scale,
+                    rt.acc, rt.acc_ro)
+            else:
+                dy = rt.dy_in.pop(b)
+                dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                    rt.own, rt.ro_tied, x, rng, dy, rt.acc, rt.acc_ro)
+            rt.dx_out[b] = dx
+        elif isinstance(cmd, SendGrad):
+            mb = self._sent_grad_cnt[mc]
+            self._sent_grad_cnt[mc] += 1
+            chan = self._chan_grad.get(mc)
+            if chan is None:
+                return
+            dx = rt.dx_out.pop(b) if rt is not None else None
+            # dx has the aval of this chunk's INPUT = previous chunk's out
+            res = chan.transfer(self._aval_out[mc - 1], dx)
+            if res is not None:
+                self._mail_grad[(mc - 1, mb)] = res
+        elif isinstance(cmd, ReduceTiedGrads):
+            self._reduce_tied_grads_mh()
+        elif isinstance(cmd, ReduceGrads):
+            pass  # within-stage dp reduction is implicit in the jitted loss
+        elif isinstance(cmd, OptimizerStep):
+            self._pipe_optimizer_step_mh()
+        else:
+            raise NotImplementedError(f"instruction {cmd!r}")
+
+    def _next_micro_batch_from(self, data_iter):
+        batch = next(data_iter)
+        if isinstance(batch, dict):
+            return batch["input_ids"], batch.get("labels")
+        return batch[0], batch[1]
+
+    def _reduce_tied_grads_mh(self):
+        """Ship tied grads to the owner chunk: local pairs by direct add,
+        cross-process pairs through their dedicated channel, all walked in
+        the same sorted order on every process."""
+        if self._tied_reduced:
+            return
+        self._tied_reduced = True
+        f32 = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, jnp.float32), t)
+        for key in sorted(self._tied_users):
+            users = self._tied_users[key]
+            o = self._tied_owner[key]
+            ort = self._local.get(o)
+            for u in sorted(users):
+                if u == o:
+                    continue
+                if u % self._n_phys == o % self._n_phys:
+                    # same process (interleave): direct add
+                    if ort is not None:
+                        urt = self._local[u]
+                        g = jax.device_put(urt.acc_ro[key], ort.replicated)
+                        ort.acc["tied"][key] = jax.tree_util.tree_map(
+                            jnp.add, ort.acc["tied"][key], g)
+                    continue
+                chan = self._chan_tied_grad.get((key, u))
+                if chan is None:
+                    continue
+                val = (self._local[u].acc_ro[key]
+                       if chan.is_src and u in self._local else None)
+                res = chan.transfer(f32(self._abs_tied[key]), val)
+                if res is not None and ort is not None:
+                    ort.acc["tied"][key] = jax.tree_util.tree_map(
+                        jnp.add, ort.acc["tied"][key], res)
+
+    def _pipe_optimizer_step_mh(self):
+        if self._step_applied:
+            return
+        self._step_applied = True
+        self._tied_reduced = False
+        M = self.micro_batches
+        denom = jnp.asarray(self._scaler_state["cur_scale"] * M,
+                            jnp.float32)
+        cur_lr = self._current_lr()
+        lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        clip = float(self._config.gradient_clipping or 0.0)
+        loss_sum = total_sq = ov = 0.0
+        for mc in sorted(self._local):
+            rt = self._local[mc]
+            sq, o = rt.detect_j(rt.acc, denom)
+            total_sq += float(sq)
+            ov += float(np.asarray(o))
+            if rt.is_last and rt.losses:
+                loss_sum = float(jnp.sum(jnp.stack(rt.losses)))
+        red = self._gscal.sum([loss_sum, total_sq, ov])
+        loss = red[0] / M
+        overflow = red[2] > 0
+        clip_coef = 1.0
+        if clip > 0.0:
+            norm = float(np.sqrt(red[1]))
+            if np.isfinite(norm) and norm > clip:
+                clip_coef = clip / (norm + 1e-6)
+        ovf = jnp.asarray(bool(overflow))
+        for mc in sorted(self._local):
+            rt = self._local[mc]
+            rt.own, rt.opt_state, rt.acc = rt.apply_j(
+                rt.own, rt.opt_state, rt.acc,
+                lr, denom, jnp.asarray(clip_coef, jnp.float32), ovf)
+            rt.acc_ro = jax.tree_util.tree_map(jnp.zeros_like, rt.acc_ro)
+        self._scaler_state = self.loss_scaler.jit_update(
+            self._scaler_state, jnp.asarray(bool(overflow)))
+        self.global_steps += 1
+        if overflow:
+            self._skipped_steps += 1
+            log_dist(f"pipeline overflow: skipped step, new loss scale "
+                     f"{float(self._scaler_state['cur_scale'])}", ranks=[0])
+        else:
+            if self.lr_scheduler is not None:
+                self.lr_scheduler.step()
+            self._refresh_tied_copies_mh()
+        self._last_loss = jnp.asarray(loss, jnp.float32)
+        self._emit_monitor_scalars()
+
+    def _refresh_tied_copies_mh(self):
+        for key in sorted(self._tied_users):
+            users = self._tied_users[key]
+            o = self._tied_owner[key]
+            ort = self._local.get(o)
+            for u in sorted(users):
+                if u == o:
+                    continue
+                if u % self._n_phys == o % self._n_phys:
+                    if ort is not None:
+                        self._local[u].ro_tied[key] = jax.device_put(
+                            ort.own["tied"][key],
+                            self._local[u].replicated)
+                    continue
+                chan = self._chan_tied_param.get((key, u))
+                if chan is None:
+                    continue
+                val = (ort.own["tied"][key]
+                       if chan.is_src and ort is not None else None)
+                res = chan.transfer(self._abs_tied[key], val)
+                if res is not None and u in self._local:
+                    self._local[u].ro_tied[key] = res
+
+    # ------------------------------------------------------------------
     # schedule execution
     # ------------------------------------------------------------------
 
@@ -318,45 +794,6 @@ class PipelineEngine(DeepSpeedEngine):
         carry chunk_id (chunk c of physical stage s is model chunk
         c * n_phys + s); plain 1F1B instructions default to chunk 0."""
         return getattr(cmd, "chunk_id", 0) * self._n_phys + s
-
-    def _deps_ready(self, s: int, tick) -> bool:
-        # mailboxes are keyed by (model_chunk, micro_batch): buffer ids
-        # are stage-LOCAL (num_pipe_buffers differs per stage), while
-        # sends and recvs both occur in micro-batch order per model chunk
-        # — the counters recover the mb each pending Recv is waiting for
-        for cmd in tick:
-            if isinstance(cmd, RecvActivation):
-                mc = self._mc(s, cmd)
-                if (mc, self._recv_act_cnt[mc]) not in self._mail_act:
-                    return False
-            if isinstance(cmd, RecvGrad):
-                mc = self._mc(s, cmd)
-                if (mc, self._recv_grad_cnt[mc]) not in self._mail_grad:
-                    return False
-        return True
-
-    def _run_schedule(self, streams, dispatch):
-        P = len(streams)
-        pos = [0] * P
-        while True:
-            progressed = False
-            done = True
-            for s in range(P):
-                while pos[s] < len(streams[s]):
-                    tick = streams[s][pos[s]]
-                    if not self._deps_ready(s, tick):
-                        break
-                    for cmd in tick:
-                        dispatch(s, cmd)
-                    pos[s] += 1
-                    progressed = True
-                if pos[s] < len(streams[s]):
-                    done = False
-            if done:
-                return
-            if not progressed:
-                raise RuntimeError(
-                    f"pipeline schedule deadlock at positions {pos}")
 
     def train_batch(self, data_iter=None):
         if not self._staged:
@@ -368,6 +805,8 @@ class PipelineEngine(DeepSpeedEngine):
                 from ..dataloader import RepeatingLoader
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._train_iter
+        if self._mh:
+            return self._train_batch_mh(data_iter)
 
         self.tput_timer.start()
         M = self.micro_batches
@@ -393,7 +832,11 @@ class PipelineEngine(DeepSpeedEngine):
         else:
             streams = [list(TrainSchedule(M, P, s).steps())
                        for s in range(P)]
-        self._run_schedule(streams, self._dispatch_train)
+        # the single-controller executor consumes the same canonical
+        # event order the multi-host executor derives — one dependency
+        # resolver for both (see _simulate_order)
+        for s, cmd in self._simulate_order(streams):
+            self._dispatch_train(s, cmd)
 
         last = self.stages[-1]
         loss = jnp.mean(jnp.stack(last.losses)) if last.losses else None
@@ -486,10 +929,7 @@ class PipelineEngine(DeepSpeedEngine):
             raise NotImplementedError(f"instruction {cmd!r}")
 
     def _next_micro_batch(self):
-        batch = next(self._data_iter)
-        if isinstance(batch, dict):
-            return batch["input_ids"], batch.get("labels")
-        return batch[0], batch[1]
+        return self._next_micro_batch_from(self._data_iter)
 
     def _reduce_tied_grads(self):
         """Ship non-owner tied grads to the owner stage and sum (the
@@ -520,24 +960,24 @@ class PipelineEngine(DeepSpeedEngine):
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         clip = float(self._config.gradient_clipping or 0.0)
+        # detect BEFORE apply: global norm for clipping + global overflow,
+        # so every stage applies (or skips) the step together (reference
+        # pipe engine all-reduces both over pipeline ranks)
+        detects = [rt.detect_j(rt.acc, denom) for rt in self.stages]
+        total_sq = sum(float(sq) for sq, _ in detects)
+        overflow = bool(np.any([np.asarray(ov) for _, ov in detects]))
         clip_coef = 1.0
         if clip > 0.0:
-            # global grad norm across ALL stages (reference pipe engine
-            # all-reduces the norm over pipeline ranks before clipping)
-            total_sq = sum(float(rt.sq_norm_j(rt.acc, denom))
-                           for rt in self.stages)
             norm = float(np.sqrt(total_sq))
             if np.isfinite(norm) and norm > clip:
                 clip_coef = clip / (norm + 1e-6)
-        flags = []
+        ovf = jnp.asarray(overflow)
         for rt in self.stages:
-            rt.own, rt.opt_state, rt.acc, ov = rt.apply_j(
+            rt.own, rt.opt_state, rt.acc = rt.apply_j(
                 rt.own, rt.opt_state, rt.acc,
-                lr, denom, jnp.asarray(clip_coef, jnp.float32))
+                lr, denom, jnp.asarray(clip_coef, jnp.float32), ovf)
             rt.acc_ro = jax.tree_util.tree_map(
                 jnp.zeros_like, rt.acc_ro)
-            flags.append(ov)
-        overflow = bool(np.any([np.asarray(f) for f in flags]))
         self._scaler_state = self.loss_scaler.jit_update(
             self._scaler_state, jnp.asarray(overflow))
         self.global_steps += 1
@@ -573,12 +1013,28 @@ class PipelineEngine(DeepSpeedEngine):
         module: PipelineModule = self.module
         layers = [None] * module.num_layers()
         tied = {}
+        if self._mh:
+            # process-local view: layers this process does not own stay
+            # None (multi-host processes cannot address remote params)
+            for mc, rt in self._local.items():
+                lo = module.parts[mc]
+                for j, lp in enumerate(rt.own["layers"]):
+                    layers[lo + j] = lp
+                tied.update(rt.own["tied"])
+            return {"layers": layers, "tied": tied}
         for s, rt in enumerate(self.stages):
             lo = module.parts[s]
             for j, lp in enumerate(rt.own["layers"]):
                 layers[lo + j] = lp
             tied.update(rt.own["tied"])
         return {"layers": layers, "tied": tied}
+
+    def _runtimes(self) -> List[_StageRuntime]:
+        """Stage runtimes in model-chunk order. In channel (mh) mode this
+        is only valid when every chunk is local (single process)."""
+        if not self._mh:
+            return self.stages
+        return [self._local[mc] for mc in sorted(self._local)]
 
     def memory_status(self, tag: str = ""):
         """Per-stage device-memory report (reference pipe/engine.py:
@@ -591,7 +1047,7 @@ class PipelineEngine(DeepSpeedEngine):
                      f"{SynchronizedWallClockTimer.memory_usage()}",
                      ranks=[0])
             return
-        for rt in self.stages:
+        for rt in (self._local.values() if self._mh else self.stages):
             used = peak = 0
             for d in rt.devices:
                 stats = (d.memory_stats() or {}) \
@@ -615,6 +1071,8 @@ class PipelineEngine(DeepSpeedEngine):
             return super().eval_batch(batch)
         if not hasattr(data_iter, "__next__"):
             data_iter = iter([data_iter])
+        if self._mh:
+            return self._eval_batch_mh(data_iter)
         self._mail_act = {}
         self._mail_grad = {}
         self._data_iter = data_iter
@@ -643,12 +1101,71 @@ class PipelineEngine(DeepSpeedEngine):
                 last.own, last.ro_tied, x, last.place_batch(labels), None))
         return jnp.mean(jnp.stack(losses)) if losses else None
 
+    def _eval_batch_mh(self, data_iter):
+        """Forward-only walk in model-chunk order; every process enters
+        the activation channels in the same (mc, mb) order, the loss is
+        summed globally at the end."""
+        M = self.micro_batches
+        loss_sum = 0.0
+        count = 0
+        for _ in range(M):
+            try:
+                inputs, labels = self._next_micro_batch_from(data_iter)
+            except StopIteration:
+                break
+            count += 1
+            avals = self._chunk_out_avals(jax.ShapeDtypeStruct(
+                np.asarray(inputs).shape, np.asarray(inputs).dtype))
+            x = None
+            first = self._local.get(0)
+            if first is not None:
+                x = first.place_batch(inputs)
+            for mc in range(self._n_mc):
+                rt = self._local.get(mc)
+                if rt is not None:
+                    if rt.is_last:
+                        loss_sum += float(rt.eval_loss_j(
+                            rt.own, rt.ro_tied, x,
+                            rt.place_batch(np.asarray(labels)), None))
+                        continue
+                    x = rt.fwd_eval_j(rt.own, rt.ro_tied, x, None)
+                if mc < self._n_mc - 1:
+                    chan = self._chan_act.get(mc)
+                    if chan is not None:
+                        res = chan.transfer(
+                            avals[mc], x if rt is not None else None)
+                        if res is not None:
+                            x = res
+        red = self._gscal.sum([loss_sum])
+        return (jnp.asarray(red[0] / count, jnp.float32)
+                if count else None)
+
     def inference_batch(self, data_iter):
         """EleutherAI addition (reference pipe/engine.py:422)."""
         batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
         inputs = batch[0] if isinstance(batch, (tuple, list)) else batch
         if not self._staged:
             return self.module.apply(self._params, inputs, train=False)
+        if self._mh:
+            avals = self._chunk_out_avals(jax.ShapeDtypeStruct(
+                np.asarray(inputs).shape, np.asarray(inputs).dtype))
+            x = None
+            if 0 in self._local:
+                x = self._local[0].place_batch(inputs)
+            for mc in range(self._n_mc):
+                rt = self._local.get(mc)
+                if rt is not None:
+                    x = rt.fwd_eval_j(rt.own, rt.ro_tied, x, None)
+                if mc < self._n_mc - 1:
+                    chan = self._chan_act.get(mc)
+                    if chan is not None:
+                        res = chan.transfer(
+                            avals[mc], x if rt is not None else None)
+                        if res is not None:
+                            x = res
+            # the final output lives on the last chunk's owner; other
+            # processes return None (the reference's last-rank-only output)
+            return x if (self._n_mc - 1) in self._local else None
         x = self.stages[0].place_batch(inputs)
         for rt in self.stages:
             x = rt.fwd_eval_j(rt.own, rt.ro_tied, rt.place_batch(x), None)
@@ -663,12 +1180,19 @@ class PipelineEngine(DeepSpeedEngine):
         if not self._staged:
             return super().save_checkpoint(save_dir, tag, client_state,
                                            save_latest)
+        if self._mh and jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-host pipeline checkpointing is not wired up yet: "
+                "each process holds only its own stage, and the per-layer "
+                "writer currently assumes a full local view. Save from a "
+                "single-process reload, or use the per-stage params "
+                "property to export this process's shard.")
         if tag is None:
             tag = f"global_step{self.global_steps}"
         module: PipelineModule = self.module
         layer_states = {}
         tied_states = {}
-        for s, rt in enumerate(self.stages):
+        for s, rt in enumerate(self._runtimes()):
             lo = module.parts[s]
             own_np = jax.tree_util.tree_map(np.asarray, rt.own)
             for j, lp in enumerate(own_np["layers"]):
@@ -693,7 +1217,7 @@ class PipelineEngine(DeepSpeedEngine):
             return jax.tree_util.tree_map(np.asarray, state)
 
         optim_state = {
-            "optimizer_state": [pack_opt(rt) for rt in self.stages],
+            "optimizer_state": [pack_opt(rt) for rt in self._runtimes()],
             "pipeline_parts": list(module.parts),
             "zero_stage": self.zero_optimization_stage(),
             "offload": False,
@@ -710,6 +1234,10 @@ class PipelineEngine(DeepSpeedEngine):
             return super().load_checkpoint(load_dir, tag, load_module_strict,
                                            load_optimizer_states,
                                            load_lr_scheduler_states)
+        if self._mh and jax.process_count() > 1:
+            raise NotImplementedError(
+                "multi-host pipeline checkpointing is not wired up yet "
+                "(see save_checkpoint)")
         try:
             ckpt_dir, model_state, optim_state = \
                 ckpt_io.load_checkpoint_state(load_dir, tag)
@@ -719,7 +1247,7 @@ class PipelineEngine(DeepSpeedEngine):
         module: PipelineModule = self.module
         layers = model_state["module"]["layers"]
         tied = model_state["module"]["tied"]
-        for s, rt in enumerate(self.stages):
+        for s, rt in enumerate(self._runtimes()):
             lo, hi = module.parts[s], module.parts[s + 1]
             own_tied = {k: tied[k] for k, o in self._tied_owner.items()
                         if o == s}
@@ -736,7 +1264,10 @@ class PipelineEngine(DeepSpeedEngine):
                 rt.opt_state = rt.place_replicated(
                     jax.tree_util.tree_map(jnp.asarray, restored))
             rt.zero_acc()
-        self._refresh_tied_copies()
+        if self._mh:
+            self._refresh_tied_copies_mh()
+        else:
+            self._refresh_tied_copies()
         if model_state.get("loss_scaler") is not None:
             self._scaler_state = {
                 k: jnp.asarray(v)

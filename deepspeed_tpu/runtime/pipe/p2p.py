@@ -1,0 +1,172 @@
+"""Cross-process pipeline point-to-point over XLA collectives.
+
+Reference capability: deepspeed/runtime/pipe/p2p.py:31-75 — NCCL
+send/recv between adjacent pipeline ranks across nodes.  JAX has no raw
+p2p between processes, but any computation on a mesh spanning exactly the
+two endpoint processes is executed only by them; a transfer is therefore
+a tiny jitted reduction on a 2-row pair mesh:
+
+    row 0 = payload (sender's devices)     row 1 = zeros (receiver's)
+    out   = sum over rows, replicated over the row axis
+
+XLA lowers the row-sum to a pairwise exchange riding ICI/DCN — the
+collective IS the send/recv.  The sum is exact (payload + 0).  Non-
+endpoint processes never construct or enter the program, so independent
+stage pairs need no global ordering — the NCCL-p2p property that makes
+pipeline schedules composable.
+
+The same construction works single-process (all devices addressable),
+which is how the driver's virtual multichip dryrun executes the
+multi-host code path verbatim.
+
+Endpoint ordering contract: both endpoint processes must enter a
+channel's transfers in the same relative order, and any two processes
+must order their COMMON collectives identically.  The pipeline engine
+guarantees this by deriving one canonical global event order from the
+schedule (engine._simulate_order) and having every process walk it.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Channel:
+    """One-directional transfer: src device group -> dst device group.
+
+    Both endpoint processes call transfer() at matched times (receiver
+    passes values=None); the return value is the tree placed on the dst
+    group (None on a pure-sender process).  Group sizes must match —
+    uniform devices-per-process, the same contract the rest of the
+    runtime assumes."""
+
+    def __init__(self, src_devices, dst_devices, replicate: bool = False):
+        """replicate=True forces every transfer to land replicated over
+        the dst group (parameter/grad channels — tied weights are placed
+        replicated on their stage, and a batch-sharded copy would force
+        stage-program recompiles + re-gathers)."""
+        if len(src_devices) != len(dst_devices):
+            raise ValueError(
+                f"channel endpoints need equal device counts, got "
+                f"{len(src_devices)} -> {len(dst_devices)}")
+        self.replicate = replicate
+        self.G = len(src_devices)
+        self.src = list(src_devices)
+        self.dst = list(dst_devices)
+        me = jax.process_index()
+        self.is_src = any(d.process_index == me for d in self.src)
+        self.is_dst = any(d.process_index == me for d in self.dst)
+        self.mesh = Mesh(np.array([self.src, self.dst]), ("side", "dev"))
+        self.src_mesh = Mesh(np.array(self.src), ("data",))
+        self.dst_mesh = Mesh(np.array(self.dst), ("data",))
+        self._progs: Dict[Any, Any] = {}
+        self._zeros: Dict[Any, Any] = {}
+
+    def _plan(self, aval):
+        """Batch-shard over the group when the leading dim divides evenly
+        (must mirror _StageRuntime.place_batch so both endpoints agree
+        from the aval alone); always replicated on parameter channels."""
+        if self.replicate:
+            return False
+        return bool(aval.ndim) and aval.shape[0] % self.G == 0
+
+    def _zero_shard(self, shape, dtype, device):
+        key = (shape, str(dtype), device.id)
+        z = self._zeros.get(key)
+        if z is None:
+            z = jax.device_put(jnp.zeros(shape, dtype), device)
+            self._zeros[key] = z
+        return z
+
+    def _leaf(self, aval, val) -> Optional[jax.Array]:
+        shard = self._plan(aval)
+        gshape = (2, *aval.shape)
+        in_spec = P("side", "dev") if shard else P("side")
+        in_sh = NamedSharding(self.mesh, in_spec)
+        shards = []
+        if self.is_src:
+            if val is None:
+                raise ValueError("sender process got no value to transfer")
+            local_spec = P("data") if shard else P()
+            val = jax.device_put(
+                jnp.asarray(val),
+                NamedSharding(self.src_mesh, local_spec))
+            # [B/G, ...] (or full) per-device blocks -> [1, B/G, ...] rows
+            shards += [s.data[None] for s in val.addressable_shards]
+        if self.is_dst:
+            row = ((aval.shape[0] // self.G, *aval.shape[1:])
+                   if shard else tuple(aval.shape))
+            shards += [self._zero_shard((1, *row), aval.dtype, d)
+                       for d in self.dst if d.process_index ==
+                       jax.process_index()]
+        garr = jax.make_array_from_single_device_arrays(gshape, in_sh,
+                                                        shards)
+        key = (gshape, str(aval.dtype), shard)
+        prog = self._progs.get(key)
+        if prog is None:
+            out_spec = P("dev") if shard else P()
+            dt = aval.dtype
+            prog = jax.jit(
+                lambda a: jnp.sum(a, axis=0).astype(dt),
+                out_shardings=NamedSharding(self.mesh, out_spec))
+            self._progs[key] = prog
+        out = prog(garr)
+        if not self.is_dst:
+            return None
+        # rebuild as a dst-group-local array so the receiver's stage jits
+        # (compiled over the local mesh) consume it without resharding
+        local_spec = P("data") if shard else P()
+        dst_set = {d.id for d in self.dst}
+        mine = [s.data for s in out.addressable_shards
+                if s.device.id in dst_set]
+        return jax.make_array_from_single_device_arrays(
+            tuple(aval.shape), NamedSharding(self.dst_mesh, local_spec),
+            mine)
+
+    def transfer(self, avals, values=None):
+        """avals: pytree of ShapeDtypeStructs (both endpoints know it);
+        values: matching pytree of arrays on the sender, None on the
+        receiver.  Returns the tree on the dst group, or None if this
+        process is not a receiver."""
+        if not (self.is_src or self.is_dst):
+            return None
+        leaves, treedef = jax.tree_util.tree_flatten(avals)
+        vleaves = (treedef.flatten_up_to(values)
+                   if self.is_src else [None] * len(leaves))
+        out = [self._leaf(a, v) for a, v in zip(leaves, vleaves)]
+        if not self.is_dst:
+            return None
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+
+class GlobalScalars:
+    """Sum-reduce a small fp32 vector across ALL processes (pipeline step
+    bookkeeping: loss, global grad-norm, overflow count).  Single global
+    collective per call; every process must call in the same order.
+    Identity when process_count == 1."""
+
+    def __init__(self):
+        self.nprocs = jax.process_count()
+        if self.nprocs == 1:
+            return
+        devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+        per = len(devs) // self.nprocs
+        self.mesh = Mesh(np.array(devs).reshape(self.nprocs, per),
+                         ("proc", "dev"))
+        self._row = NamedSharding(self.mesh, P("proc"))
+        self._sum = jax.jit(lambda x: jnp.sum(x, axis=0),
+                            out_shardings=NamedSharding(self.mesh, P()))
+
+    def sum(self, vec) -> np.ndarray:
+        vec = np.asarray(vec, np.float32)
+        if self.nprocs == 1:
+            return vec
+        garr = jax.make_array_from_process_local_data(
+            self._row, vec[None, :], (self.nprocs, vec.size))
+        return np.asarray(self._sum(garr).addressable_data(0))

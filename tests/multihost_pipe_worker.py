@@ -1,0 +1,52 @@
+"""Worker for test_pipe_multihost.py: one of two jax.distributed
+processes (2 CPU devices each) running a heterogeneous TiedLayerSpec
+pipeline with one physical stage per process. Cross-process activations,
+grads, tied-grad reduction and tied-param refresh all ride
+runtime/pipe/p2p.Channel collectives. Prints per-step losses so the
+parent can assert parity against a single-process run of the same
+pipeline (reference capability: deepspeed/runtime/pipe/p2p.py:31-75)."""
+
+import os
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    steps = int(sys.argv[4])
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_threefry_partitionable", True)
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=proc_id)
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    import deepspeed_tpu
+    from pipe_parity_common import MICRO, M, build_module, config, data
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=nprocs),
+        dist_init_required=False,
+        config_params=config())
+    assert engine._mh and engine._staged, "multi-host pipe mode not active"
+    assert sorted(engine._local) == [proc_id], engine._local.keys()
+
+    for step in range(steps):
+        mbs = data(100 + step, M)  # identical stream on every process
+        loss = engine.train_batch(iter(mbs))
+        print(f"MHPIPE step={step} loss={float(loss):.6f}", flush=True)
+    ev = engine.eval_batch(iter(data(999, M)))
+    print(f"MHPIPE eval={float(ev):.6f}", flush=True)
+    print("MHPIPE done", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Shared tiny heterogeneous TiedLayerSpec pipeline for the multi-host
+pipe parity tests (worker + single-process oracle must build the exact
+same model, config, and data stream)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.runtime.pipe.module import (LayerSpec, PipelineModule,
+                                               TiedLayerSpec)
+
+VOCAB, D = 64, 32
+MICRO, M = 8, 4
+
+
+class Embed:
+    def __init__(self, vocab, d):
+        self.vocab, self.d = vocab, d
+
+    def init(self, rng):
+        return {"weight": jax.random.normal(rng, (self.vocab, self.d)) * 0.05}
+
+    def apply(self, p, x, rng=None, train=True):
+        return p["weight"][x]
+
+
+class Block:
+    def __init__(self, d, ff):
+        self.d, self.ff = d, ff
+
+    def init(self, rng):
+        k1, k2 = jax.random.split(rng)
+        return {"w1": jax.random.normal(k1, (self.d, self.ff)) * 0.05,
+                "w2": jax.random.normal(k2, (self.ff, self.d)) * 0.05}
+
+    def apply(self, p, x, rng=None, train=True):
+        return x + jnp.tanh(x @ p["w1"]) @ p["w2"]
+
+
+def head_forward(layer, p, x):
+    return x @ p["weight"].T
+
+
+def ce_loss(logits, labels):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+
+def build_module(num_stages, interleave=1):
+    layers = [TiedLayerSpec("embed", Embed, VOCAB, D)]
+    layers += [LayerSpec(Block, D, ff) for ff in (48, 64, 32)]
+    layers += [TiedLayerSpec("embed", Embed, VOCAB, D,
+                             forward_fn=head_forward)]
+    return PipelineModule(layers, num_stages=num_stages, loss_fn=ce_loss,
+                          interleave=interleave)
+
+
+def config(use_channels=False):
+    c = {"train_batch_size": MICRO * M,
+         "train_micro_batch_size_per_gpu": MICRO,
+         "gradient_accumulation_steps": M,
+         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+         "gradient_clipping": 1.0,
+         "mesh": {"data": 1, "pipe": -1},
+         "steps_per_print": 0}
+    if use_channels:
+        c["pipeline"] = {"use_p2p_channels": True}
+    return c
+
+
+def data(seed, n):
+    rng = np.random.RandomState(seed)
+    return [(rng.randint(0, VOCAB, (MICRO, 6)).astype(np.int32),
+             rng.randint(0, VOCAB, (MICRO, 6)).astype(np.int32))
+            for _ in range(n)]

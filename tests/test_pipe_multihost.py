@@ -1,0 +1,107 @@
+"""Multi-host heterogeneous pipeline: 2 jax.distributed processes (one
+physical stage each, 2 CPU devices per stage for within-stage dp) train a
+TiedLayerSpec pipeline through p2p.Channel collectives; per-step losses
+must agree across processes and match a single-process run of the same
+model/data. Reference capability: deepspeed/runtime/pipe/p2p.py:31-75
+(NCCL p2p between pipeline ranks across nodes).
+
+The single-process channel executor (pipeline.use_p2p_channels) is
+covered by the fast tests below; the 2-process run is slow-marked."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _single_process_losses(steps, use_channels, interleave=1,
+                           num_stages=2):
+    import deepspeed_tpu
+    from pipe_parity_common import M, build_module, config, data
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=num_stages, interleave=interleave),
+        config_params=config(use_channels))
+    assert engine._staged
+    assert engine._mh == use_channels
+    losses = [float(engine.train_batch(iter(data(100 + i, M))))
+              for i in range(steps)]
+    ev = float(engine.eval_batch(iter(data(999, M))))
+    return losses, ev
+
+
+def test_channel_executor_matches_single_controller():
+    """The p2p-channel executor (the exact multi-host code path, run
+    single-process) trains identically to the proven single-controller
+    1F1B executor."""
+    ref_l, ref_e = _single_process_losses(3, use_channels=False)
+    ch_l, ch_e = _single_process_losses(3, use_channels=True)
+    np.testing.assert_allclose(ch_l, ref_l, rtol=1e-4)
+    np.testing.assert_allclose(ch_e, ref_e, rtol=1e-4)
+
+
+def test_channel_executor_interleaved():
+    """Interleaved virtual stages through the channel executor: chunk
+    wrap-around channels (stage P-1 chunk c -> stage 0 chunk c+1)."""
+    ref_l, _ = _single_process_losses(2, use_channels=False, interleave=2)
+    ch_l, _ = _single_process_losses(2, use_channels=True, interleave=2)
+    np.testing.assert_allclose(ch_l, ref_l, rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_two_process_pipeline_parity():
+    steps = 3
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    worker = os.path.join(os.path.dirname(__file__),
+                          "multihost_pipe_worker.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(i), str(nprocs), coord,
+             str(steps)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=900)
+            outs.append(out)
+            assert p.returncode == 0, out[-3000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    # both processes completed and report identical losses
+    curves = []
+    for out in outs:
+        assert "MHPIPE done" in out, out[-2000:]
+        losses = [float(ln.split("loss=")[1])
+                  for ln in out.splitlines() if "loss=" in ln]
+        evals = [float(ln.split("eval=")[1])
+                 for ln in out.splitlines() if "eval=" in ln]
+        assert len(losses) == steps and len(evals) == 1, out[-2000:]
+        curves.append(losses + evals)
+    np.testing.assert_allclose(curves[0], curves[1], rtol=1e-6)
+
+    # and the multi-host curve matches the single-process oracle
+    # (2 devices per process over 2 processes vs 8 local devices — use
+    # the same per-stage device count by building the oracle fresh here)
+    ref_l, ref_e = _single_process_losses(steps, use_channels=False)
+    np.testing.assert_allclose(curves[0][:steps], ref_l, rtol=1e-3)
+    np.testing.assert_allclose(curves[0][steps], ref_e, rtol=1e-3)
