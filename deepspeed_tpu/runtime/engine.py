@@ -211,19 +211,24 @@ class DeepSpeedEngine:
                 and zc.offload_param is not None
                 and hasattr(self.module, "stream_init")):
             return None
-        if self.gradient_accumulation_steps() != 1:
-            raise ValueError("ZeRO-Infinity streaming requires "
-                             "gradient_accumulation_steps == 1")
         from .zero.infinity import InfinityRuntime
 
         hparams = dict(self._config.optimizer_params or {})
         adam_w = bool(hparams.pop(const.ADAM_W_MODE, True))
-        nvme = (zc.offload_param.nvme_path
-                if zc.offload_param.device == "nvme" else None)
+        # offload_param nvme -> masters page through the aio engine
+        # (reference partitioned_param_swapper.py:223-277); any nvme path
+        # also pages the Adam moments (offload_optimizer nvme covers the
+        # moments-only configuration)
+        on_nvme = zc.offload_param.device == "nvme"
+        opt = zc.offload_optimizer
+        opt_nvme = opt is not None and opt.device == "nvme"
+        nvme = (zc.offload_param.nvme_path if on_nvme
+                else opt.nvme_path if opt_nvme else None)
         return InfinityRuntime(self.module, init_key, hparams,
                                adam_w_mode=adam_w,
                                compute_dtype=self.compute_dtype,
-                               nvme_path=nvme)
+                               nvme_path=nvme,
+                               params_on_nvme=on_nvme)
 
     def _finish_infinity_init(self, lr_scheduler, training_data=None):
         """Minimal engine state for the streamed path (no device param
@@ -743,17 +748,25 @@ class DeepSpeedEngine:
         return loss
 
     def _infinity_forward(self, batch):
-        """Streamed whole-step (fwd+bwd+host update); step() bookkeeps.
+        """Streamed micro step; the host master update runs at the
+        accumulation boundary over the summed fp32 grads (gas > 1 costs
+        no extra device memory — the sink lives on the host). step()
+        bookkeeps via _pending_full at the boundary.
         Multi-host: `batch` is this process's LOCAL shard of the global
         batch (the dataloader already strides per process); grads/loss are
         averaged across processes inside the runtime."""
-        self._resolve_pending_overflow()  # settle the PREVIOUS step first
-        self.tput_timer.start()
-        loss, overflow = self._infinity.train_step(
-            batch, lr=self._current_lr(),
-            clip=float(self._config.gradient_clipping or 0.0))
-        self._pending_full = (self._scaler_state, bool(overflow),
-                              jnp.zeros((), jnp.float32))
+        gas = self.gradient_accumulation_steps()
+        boundary_micro = (self.micro_steps % gas) == gas - 1
+        if self.micro_steps % gas == 0:
+            self._resolve_pending_overflow()  # settle the PREVIOUS step
+            self.tput_timer.start()
+        loss = self._infinity.micro_step(batch)
+        if boundary_micro:
+            overflow = self._infinity.apply_accumulated(
+                lr=self._current_lr(),
+                clip=float(self._config.gradient_clipping or 0.0))
+            self._pending_full = (self._scaler_state, bool(overflow),
+                                  jnp.zeros((), jnp.float32))
         self._cached = loss
         self._last_loss = loss
         return loss

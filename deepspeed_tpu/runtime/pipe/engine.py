@@ -340,7 +340,15 @@ class PipelineEngine(DeepSpeedEngine):
         model chunks; adjacent chunks on other processes are reached
         through p2p.Channel collectives. Single-process (the dryrun), all
         chunks are local and the channels are purely local collectives —
-        the code path is identical."""
+        the code path is identical.
+
+        Deliberate duplication note: the *_mh methods mirror the
+        single-controller executor with channel transfers in place of
+        direct device_put reshards. The channel path functionally
+        subsumes the local one, but device_put is the cheaper transport
+        within one process (no collective, no zero-row add), so both are
+        kept; test_pipe_multihost.py pins them to identical losses, which
+        is the guard against semantic drift between the copies."""
         module: PipelineModule = self.module
         P = module.num_stages
         v = getattr(module, "interleave", 1)

@@ -147,9 +147,76 @@ class CrossProcessGradReducer:
                 np.asarray([value], np.float32))[0], jnp.float32)
 
 
+class NvmeMasterPager:
+    """fp32 master parameters on NVMe — one file per leaf, group-granular
+    load/store through the native aio engine with one-group read-ahead.
+
+    Reference: swap_tensor/partitioned_param_swapper.py:223-277 (param
+    swap-in/swap-out around each submodule). Masters are read for the
+    H2D upload of each streamed group and written back after the host
+    Adam update; host RAM holds only the group in flight plus one
+    prefetched group, so max model size is bounded by NVMe, not RAM."""
+
+    def __init__(self, nvme_path: str, n_threads: int = 4):
+        import os
+        import shutil
+        import uuid
+        import weakref
+
+        from ...ops.aio import AsyncIOHandle
+
+        # instance-unique (not just pid-scoped): two runtimes in one
+        # process (e.g. checkpoint save + fresh reload) must not clobber
+        # each other's master files. The directory holds a full fp32
+        # model image, so it is removed when the pager is collected.
+        self.dir = os.path.join(
+            nvme_path,
+            f"dstpu_masters_{os.getpid()}_{uuid.uuid4().hex[:8]}")
+        os.makedirs(self.dir, exist_ok=True)
+        self._cleanup = weakref.finalize(
+            self, shutil.rmtree, self.dir, True)
+        self._h_main = AsyncIOHandle(n_threads=n_threads)
+        self._h_pre = AsyncIOHandle(n_threads=2)
+        self._pending: Dict[str, List[np.ndarray]] = {}
+
+    def _path(self, name: str, j: int) -> str:
+        import os
+
+        safe = name.replace(":", "_").replace("/", "_")
+        return os.path.join(self.dir, f"{safe}.leaf{j}.f32")
+
+    def write_group(self, name: str, flat: List[np.ndarray]) -> None:
+        for j, arr in enumerate(flat):
+            self._h_main.async_pwrite(np.ascontiguousarray(arr),
+                                      self._path(name, j))
+        self._h_main.wait()
+
+    def prefetch(self, name: str, sizes: List[int]) -> None:
+        """Issue async reads for a group; read_group() collects them.
+        One prefetch in flight at a time (the handle waits all)."""
+        if name in self._pending:
+            return
+        bufs = [np.empty(n, np.float32) for n in sizes]
+        for j, buf in enumerate(bufs):
+            self._h_pre.async_pread(buf, self._path(name, j))
+        self._pending[name] = bufs
+
+    def read_group(self, name: str, sizes: List[int]) -> List[np.ndarray]:
+        bufs = self._pending.pop(name, None)
+        if bufs is not None:
+            self._h_pre.wait()
+            return bufs
+        bufs = [np.empty(n, np.float32) for n in sizes]
+        for j, buf in enumerate(bufs):
+            self._h_main.async_pread(buf, self._path(name, j))
+        self._h_main.wait()
+        return bufs
+
+
 class InfinityRuntime:
     def __init__(self, model, rng, hparams: dict, adam_w_mode: bool = True,
-                 compute_dtype=jnp.bfloat16, nvme_path: Optional[str] = None):
+                 compute_dtype=jnp.bfloat16, nvme_path: Optional[str] = None,
+                 params_on_nvme: bool = False):
         from ...ops.adam.cpu_adam import HostAdam
         from .offload import NvmeStateStore
 
@@ -163,16 +230,27 @@ class InfinityRuntime:
 
         self._wire_dtype = np.dtype(compute_dtype)
 
-        # host fp32 masters, one group at a time on device during init
-        self.masters: Dict[str, Tuple[List[np.ndarray], Any, List]] = {}
+        # host fp32 masters, one group at a time on device during init.
+        # params_on_nvme: the flat arrays page through NvmeMasterPager and
+        # the in-RAM slot holds None — only the group in flight (plus one
+        # prefetched) is resident, so capacity is NVMe-bounded.
+        if params_on_nvme and not nvme_path:
+            raise ValueError("params_on_nvme requires an nvme_path")
+        self.pager = NvmeMasterPager(nvme_path) if params_on_nvme else None
+        self.masters: Dict[str, Tuple[Any, Any, List]] = {}
         self.group_order: List[str] = []
         n_elem = 0
         for name, host_tree in model.stream_init(rng):
             leaves, treedef = jax.tree_util.tree_flatten(host_tree)
             flat = [np.asarray(l, np.float32).ravel() for l in leaves]
-            self.masters[name] = (flat, treedef, [l.shape for l in leaves])
+            shapes = [l.shape for l in leaves]
+            if self.pager is not None:
+                self.pager.write_group(name, flat)
+                self.masters[name] = (None, treedef, shapes)
+            else:
+                self.masters[name] = (flat, treedef, shapes)
             self.group_order.append(name)
-            n_elem += sum(l.size for l in flat)
+            n_elem += sum(int(np.prod(s)) if s else 1 for s in shapes)
         self.n_elements = n_elem
 
         self.adam = HostAdam(
@@ -186,23 +264,58 @@ class InfinityRuntime:
         base = 0
         for name in self.group_order:
             self._leaf_base[name] = base
-            base += len(self.masters[name][0])
+            base += len(self.masters[name][2])  # leaf count = len(shapes)
         self._jits: Dict[str, Any] = {}
         # multi-host DP: each process streams on its shard of the global
         # batch; grads are averaged across processes before the (replicated)
         # host master update
         self.reducer = (CrossProcessGradReducer()
                         if jax.process_count() > 1 else None)
+        # gradient accumulation: micro_step() adds into this sink until
+        # apply_accumulated() consumes it (lifts the old gas==1 limit)
+        self._acc_sink: Dict[int, np.ndarray] = {}
+        self._acc_count = 0
         log_dist(f"ZeRO-Infinity: {n_elem / 1e6:.1f}M params streamed from "
-                 f"host ({'moments on NVMe' if nvme_path else 'RAM'}"
+                 f"{'NVMe' if self.pager is not None else 'host RAM'} "
+                 f"({'moments on NVMe' if nvme_path else 'moments in RAM'}"
                  f"{', dp=' + str(jax.process_count()) if self.reducer else ''})",
                  ranks=[0])
 
-    # -- host <-> device -----------------------------------------------
+    # -- host <-> device / NVMe ----------------------------------------
 
-    def _to_device(self, name: str):
-        """Async H2D of a group's working weights in compute dtype."""
+    def _group_sizes(self, name: str) -> List[int]:
+        _, _, shapes = self.masters[name]
+        return [int(np.prod(s)) if s else 1 for s in shapes]
+
+    def _masters_flat(self, name: str) -> List[np.ndarray]:
+        flat, _, _ = self.masters[name]
+        if flat is not None:
+            return flat
+        return self.pager.read_group(name, self._group_sizes(name))
+
+    def _commit_masters(self, name: str, flat: List[np.ndarray]) -> None:
+        if self.pager is not None:
+            self.pager.write_group(name, flat)
+        else:
+            treedef, shapes = self.masters[name][1:]
+            self.masters[name] = (flat, treedef, shapes)
+
+    def _prefetch_masters(self, name: Optional[str]) -> None:
+        if name is not None and self.pager is not None:
+            self.pager.prefetch(name, self._group_sizes(name))
+
+    def _to_device(self, name: str, prefetch: Optional[str] = None):
+        """Async H2D of a group's working weights in compute dtype; with
+        NVMe-paged masters, also kick off the read-ahead of the NEXT group
+        so disk latency hides behind this group's upload + compute."""
+        # collect this group's in-flight read FIRST (h_pre.wait() waits on
+        # everything queued, so only one prefetch may be outstanding),
+        # then kick off the next group's read-ahead to overlap with this
+        # group's cast + H2D + compute
         flat, treedef, shapes = self.masters[name]
+        if flat is None:
+            flat = self.pager.read_group(name, self._group_sizes(name))
+        self._prefetch_masters(prefetch)
         leaves = [jax.device_put(m.reshape(s).astype(self._wire_dtype))
                   for m, s in zip(flat, shapes)]
         return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -264,9 +377,11 @@ class InfinityRuntime:
 
     # -- training step ---------------------------------------------------
 
-    def train_step(self, batch, lr: Optional[float] = None,
-                   clip: float = 0.0):
-        """One streamed fwd+bwd+update. Returns (loss, overflow)."""
+    def micro_step(self, batch):
+        """Streamed fwd+bwd for ONE micro batch; fp32 grads accumulate
+        into the host sink until apply_accumulated() consumes them
+        (gradient accumulation without any extra device memory — the
+        reference has no gas restriction either, stage3.py:2058)."""
         model = self.model
         cfg = model.config
         tokens, labels = _tokens_labels(batch)
@@ -278,14 +393,23 @@ class InfinityRuntime:
         block_fwd, block_bwd, head, embed_bwd, embed_fwd = self._programs()
 
         # ---- forward: stream blocks, double-buffered --------------------
-        embed_dev = self._to_device("embed")  # resident (tied head needs wte)
-        head_dev = self._to_device("head")
+        # resident (tied head needs wte); prefetch chains the NVMe reads
+        # one group ahead of each use
+        embed_dev = self._to_device("embed", prefetch="head")
+        head_dev = self._to_device("head",
+                                   prefetch="block:0" if L else None)
         x = embed_fwd(embed_dev, tokens)
         acts = [x]
-        nxt = self._to_device("block:0")
+        nxt = self._to_device("block:0",
+                              prefetch="block:1" if L > 1 else None) \
+            if L else None
         for i in range(L):
-            cur, nxt = nxt, (self._to_device(f"block:{i + 1}")
-                             if i + 1 < L else None)
+            if i + 1 < L:
+                pre = f"block:{i + 2}" if i + 2 < L else f"block:{L - 1}"
+                cur, nxt = nxt, self._to_device(f"block:{i + 1}",
+                                                prefetch=pre)
+            else:
+                cur, nxt = nxt, None
             x = block_fwd(cur, x)
             acts.append(x)
         proj = (embed_dev["wte"] if cfg.tie_embeddings
@@ -294,7 +418,7 @@ class InfinityRuntime:
         loss, dhead, dproj, dx = head(head_in, proj, acts[-1], labels, valid)
 
         # ---- backward: re-stream blocks in reverse ----------------------
-        sink: Dict[int, np.ndarray] = {}
+        sink = self._acc_sink
         if cfg.tie_embeddings:
             # head group tree is exactly {"ln_f": ...}
             self._grads_to_host("head", dhead, sink)
@@ -303,10 +427,16 @@ class InfinityRuntime:
             # ({"ln_f", "lm_head"}) so flat leaf indices line up
             self._grads_to_host(
                 "head", {"ln_f": dhead["ln_f"], "lm_head": dproj}, sink)
-        nxt = self._to_device(f"block:{L - 1}") if L else None
+        nxt = self._to_device(
+            f"block:{L - 1}",
+            prefetch=f"block:{L - 2}" if L > 1 else None) if L else None
         for i in range(L - 1, -1, -1):
-            cur, nxt = nxt, (self._to_device(f"block:{i - 1}")
-                             if i - 1 >= 0 else None)
+            if i - 1 >= 0:
+                pre = f"block:{i - 2}" if i - 2 >= 0 else None
+                cur, nxt = nxt, self._to_device(f"block:{i - 1}",
+                                                prefetch=pre)
+            else:
+                cur, nxt = nxt, None
             dp, dx = block_bwd(cur, acts[i], dx)
             acts[i + 1] = None  # free
             self._grads_to_host(f"block:{i}", dp, sink)
@@ -318,27 +448,46 @@ class InfinityRuntime:
             dembed = {"wte": dembed["wte"] + dproj.astype(jnp.float32),
                       "wpe": dembed["wpe"]}
         self._grads_to_host("embed", dembed, sink)
+        self._acc_count += 1
 
-        # ---- multi-host DP: average grads + loss across processes -------
+        # micro losses are reported globally under multi-host DP (grads
+        # reduce ONCE at apply time instead — cheaper than per micro)
+        if self.reducer is not None:
+            loss = self.reducer.mean_scalar(loss)
+        return loss
+
+    def apply_accumulated(self, lr: Optional[float] = None,
+                          clip: float = 0.0) -> bool:
+        """Host Adam over the accumulated grad sink (mean over the
+        accumulated micro steps). Returns the overflow flag; the whole
+        step skips on any non-finite grad."""
+        sink = self._acc_sink
+        count = max(1, self._acc_count)
+        self._acc_sink = {}
+        self._acc_count = 0
+
+        # ---- multi-host DP: average accumulated grads across processes --
         if self.reducer is not None:
             self.reducer.mean_inplace(sink)
-            loss = self.reducer.mean_scalar(loss)
 
         # ---- host optimizer over ALL groups (skip-step on any inf) ------
         # (post-reduction: a non-finite grad on ANY process poisons the
         # mean, so every process skips in lockstep)
         overflow = not all(np.isfinite(g).all() for g in sink.values())
         if overflow:
-            return loss, True
-        scale = 1.0
+            return True
+        scale = 1.0 / count  # sum over micro steps -> mean
         if clip > 0.0:
             norm = float(np.sqrt(sum(float(np.dot(g, g))
-                                     for g in sink.values())))
+                                     for g in sink.values()))) / count
             if norm > clip:
-                scale = clip / (norm + 1e-6)
+                scale *= clip / (norm + 1e-6)
         self.adam.begin_step()
-        for name in self.group_order:
-            flat, _, _ = self.masters[name]
+        order = self.group_order
+        for idx, name in enumerate(order):
+            flat = self._masters_flat(name)
+            self._prefetch_masters(order[idx + 1]
+                                   if idx + 1 < len(order) else None)
             base = self._leaf_base[name]
             for j, master in enumerate(flat):
                 g = sink.get(base + j)
@@ -353,7 +502,15 @@ class InfinityRuntime:
                                       lr=lr)
                 if self.nvme is not None:
                     self.nvme.store(key, self.adam._state.pop(key))
-        return loss, False
+            self._commit_masters(name, flat)
+        return False
+
+    def train_step(self, batch, lr: Optional[float] = None,
+                   clip: float = 0.0):
+        """One streamed fwd+bwd+update (the gas==1 composition).
+        Returns (loss, overflow)."""
+        loss = self.micro_step(batch)
+        return loss, self.apply_accumulated(lr=lr, clip=clip)
 
     # -- eval -------------------------------------------------------------
 
@@ -379,9 +536,22 @@ class InfinityRuntime:
 
     def masters_tree(self):
         # copies, not views: the masters mutate in place every step, and a
-        # view would alias through zero-copy device_put on CPU backends
+        # view would alias through zero-copy device_put on CPU backends.
+        # NOTE: this materializes the FULL fp32 master set in host RAM —
+        # with NVMe-paged masters, checkpointing a model sized beyond
+        # host RAM needs a streaming writer (not built yet); warn so the
+        # OOM is attributable
+        if self.pager is not None:
+            log_dist(
+                f"checkpoint: materializing {self.n_elements * 4 / 2**30:.1f}"
+                f" GiB of NVMe-paged fp32 masters in host RAM (a streaming "
+                f"checkpoint writer is not implemented; for models beyond "
+                f"host RAM export group-by-group via stream_groups)",
+                ranks=[0])
         groups = {}
-        for name, (flat, treedef, shapes) in self.masters.items():
+        for name in self.group_order:
+            _, treedef, shapes = self.masters[name]
+            flat = self._masters_flat(name)
             groups[name] = jax.tree_util.tree_unflatten(
                 treedef, [m.reshape(s).copy() for m, s in zip(flat, shapes)])
         return self.model.assemble_groups(groups)
@@ -390,9 +560,12 @@ class InfinityRuntime:
         for name, tree in self.model.stream_groups(params):
             leaves = [np.asarray(l, np.float32).ravel()
                       for l in jax.tree_util.tree_leaves(tree)]
-            flat, treedef, shapes = self.masters[name]
-            assert len(leaves) == len(flat)
-            self.masters[name] = (leaves, treedef, shapes)
+            _, treedef, shapes = self.masters[name]
+            assert len(leaves) == len(shapes)
+            if self.pager is not None:
+                self.pager.write_group(name, leaves)
+            else:
+                self.masters[name] = (leaves, treedef, shapes)
 
     def state_dict(self):
         sd = self.adam.state_dict()
@@ -403,12 +576,12 @@ class InfinityRuntime:
             state = {}
             base = 0
             for name in self.group_order:
-                flat, _, _ = self.masters[name]
-                for j, master in enumerate(flat):
-                    st = self.nvme.load(base + j, master.size)
+                sizes = self._group_sizes(name)
+                for j, n in enumerate(sizes):
+                    st = self.nvme.load(base + j, n)
                     state[str(base + j)] = {k: v.copy()
                                             for k, v in st.items()}
-                base += len(flat)
+                base += len(sizes)
             sd["state"] = state
         sd["n_elements"] = self.n_elements
         return sd

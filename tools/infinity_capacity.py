@@ -28,7 +28,8 @@ if os.environ.get("JAX_PLATFORMS") == "cpu":
     jax.config.update("jax_platforms", "cpu")
 
 
-def report(size, seq, micro, hbm_gb, host_gb, run_step=False):
+def report(size, seq, micro, hbm_gb, host_gb, run_step=False,
+           nvme_path=None):
     from deepspeed_tpu.models import GPT, gpt2_config
 
     cfg = gpt2_config(size, max_seq_len=seq)
@@ -59,16 +60,24 @@ def report(size, seq, micro, hbm_gb, host_gb, run_step=False):
     print(f"  max params/chip    : ~{host_cap/1e9:.0f}B with {host_gb} GiB "
           f"host RAM (12 B/param host-side; device holds "
           f"{device/2**30:.2f} GiB << {hbm_gb} GiB HBM)")
+    biggest_group = max(block_params, embed_params) * 4
+    print(f"  with --nvme        : host RAM holds ~2 groups "
+          f"({2 * biggest_group/2**30:.2f} GiB) + grad sink "
+          f"({n*4/2**30:.2f} GiB); masters+moments page to SSD — "
+          f"capacity is NVMe-bounded, not RAM-bounded")
     if run_step:
+        import resource
+
         import numpy as np
 
         import deepspeed_tpu
 
+        dev = ({"device": "nvme", "nvme_path": nvme_path}
+               if nvme_path else {"device": "cpu"})
         engine, *_ = deepspeed_tpu.initialize(model=model, config_params={
             "train_batch_size": micro,
             "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-            "zero_optimization": {"stage": 3,
-                                  "offload_param": {"device": "cpu"}},
+            "zero_optimization": {"stage": 3, "offload_param": dev},
             "bf16": {"enabled": True},
             "mesh": {"data": 1},
             "steps_per_print": 0})
@@ -77,7 +86,11 @@ def report(size, seq, micro, hbm_gb, host_gb, run_step=False):
         loss = engine.forward((tok[:, :-1], tok[:, 1:]))
         engine.backward()
         engine.step()
-        print(f"  one streamed step  : loss={float(loss):.3f} OK")
+        rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        where = "NVMe-paged masters" if nvme_path else "RAM masters"
+        print(f"  one streamed step  : loss={float(loss):.3f} OK "
+              f"({where}); peak RSS {rss/2**30:.2f} GiB vs "
+              f"{host/2**30:.2f} GiB masters+moments")
 
 
 def main():
@@ -88,9 +101,12 @@ def main():
     ap.add_argument("--hbm-gb", type=float, default=16)
     ap.add_argument("--host-gb", type=float, default=256)
     ap.add_argument("--step", action="store_true")
+    ap.add_argument("--nvme", default=None,
+                    help="page fp32 masters+moments to this SSD path "
+                         "(capacity becomes NVMe-bounded)")
     args = ap.parse_args()
     report(args.size, args.seq, args.micro, args.hbm_gb, args.host_gb,
-           run_step=args.step)
+           run_step=args.step, nvme_path=args.nvme)
 
 
 if __name__ == "__main__":
