@@ -275,6 +275,9 @@ class InfinityRuntime:
         # apply_accumulated() consumes it (lifts the old gas==1 limit)
         self._acc_sink: Dict[int, np.ndarray] = {}
         self._acc_count = 0
+        # paged-master stash: the forward's last block read is kept in
+        # RAM so the backward's first read costs no disk I/O
+        self._kept: Dict[str, List[np.ndarray]] = {}
         log_dist(f"ZeRO-Infinity: {n_elem / 1e6:.1f}M params streamed from "
                  f"{'NVMe' if self.pager is not None else 'host RAM'} "
                  f"({'moments on NVMe' if nvme_path else 'moments in RAM'}"
@@ -304,18 +307,25 @@ class InfinityRuntime:
         if name is not None and self.pager is not None:
             self.pager.prefetch(name, self._group_sizes(name))
 
-    def _to_device(self, name: str, prefetch: Optional[str] = None):
+    def _to_device(self, name: str, prefetch: Optional[str] = None,
+                   keep: bool = False):
         """Async H2D of a group's working weights in compute dtype; with
         NVMe-paged masters, also kick off the read-ahead of the NEXT group
-        so disk latency hides behind this group's upload + compute."""
+        so disk latency hides behind this group's upload + compute.
+        keep=True stashes the host buffers for the next read of the same
+        group (fwd's last block == bwd's first — no redundant disk read)."""
         # collect this group's in-flight read FIRST (h_pre.wait() waits on
         # everything queued, so only one prefetch may be outstanding),
         # then kick off the next group's read-ahead to overlap with this
         # group's cast + H2D + compute
         flat, treedef, shapes = self.masters[name]
         if flat is None:
-            flat = self.pager.read_group(name, self._group_sizes(name))
+            flat = self._kept.pop(name, None)
+            if flat is None:
+                flat = self.pager.read_group(name, self._group_sizes(name))
         self._prefetch_masters(prefetch)
+        if keep and self.pager is not None:
+            self._kept[name] = flat
         leaves = [jax.device_put(m.reshape(s).astype(self._wire_dtype))
                   for m, s in zip(flat, shapes)]
         return jax.tree_util.tree_unflatten(treedef, leaves)
@@ -401,13 +411,15 @@ class InfinityRuntime:
         x = embed_fwd(embed_dev, tokens)
         acts = [x]
         nxt = self._to_device("block:0",
-                              prefetch="block:1" if L > 1 else None) \
+                              prefetch="block:1" if L > 1 else None,
+                              keep=L == 1) \
             if L else None
         for i in range(L):
             if i + 1 < L:
-                pre = f"block:{i + 2}" if i + 2 < L else f"block:{L - 1}"
-                cur, nxt = nxt, self._to_device(f"block:{i + 1}",
-                                                prefetch=pre)
+                pre = f"block:{i + 2}" if i + 2 < L else None
+                cur, nxt = nxt, self._to_device(
+                    f"block:{i + 1}", prefetch=pre,
+                    keep=i + 1 == L - 1)  # bwd reads this group first
             else:
                 cur, nxt = nxt, None
             x = block_fwd(cur, x)
@@ -584,10 +596,20 @@ class InfinityRuntime:
                 base += len(sizes)
             sd["state"] = state
         sd["n_elements"] = self.n_elements
+        # mid-accumulation state: without this, a save between micro
+        # steps would silently drop the pre-save grads and the resumed
+        # boundary would apply a partial-batch update
+        if self._acc_count:
+            sd["acc_count"] = self._acc_count
+            sd["acc_sink"] = {str(k): v.copy()
+                              for k, v in self._acc_sink.items()}
         return sd
 
     def load_state_dict(self, sd):
         self.adam.load_state_dict({k: sd[k] for k in ("step", "state")})
+        self._acc_count = int(sd.get("acc_count", 0))
+        self._acc_sink = {int(k): np.asarray(v, np.float32)
+                          for k, v in (sd.get("acc_sink") or {}).items()}
         if self.nvme is not None:
             # write restored moments through to the (fresh, pid-scoped)
             # store; train_step's nvme.load must see them, not zeros

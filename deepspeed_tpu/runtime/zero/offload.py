@@ -49,12 +49,23 @@ class NvmeStateStore:
     """Pages per-leaf Adam moments to local SSD via the native aio engine."""
 
     def __init__(self, nvme_path: str, n_threads: int = 4):
+        import shutil
+        import uuid
+        import weakref
+
         from ...ops.aio import AsyncIOHandle
 
-        self.dir = os.path.join(nvme_path, f"dstpu_offload_{os.getpid()}")
+        # instance-unique, not just pid-scoped: two runtimes in one
+        # process (checkpoint save + fresh reload) must not clobber each
+        # other's moment files; removed when the store is collected
+        self.dir = os.path.join(
+            nvme_path,
+            f"dstpu_offload_{os.getpid()}_{uuid.uuid4().hex[:8]}")
         os.makedirs(self.dir, exist_ok=True)
         self.handle = AsyncIOHandle(n_threads=n_threads)
         self._initialized = set()
+        self._cleanup = weakref.finalize(
+            self, shutil.rmtree, self.dir, True)
 
     def _path(self, key: int, name: str) -> str:
         return os.path.join(self.dir, f"leaf{key}_{name}.bin")
