@@ -228,6 +228,7 @@ def run_headroom(on_tpu: bool) -> dict:
     if best is None:
         raise RuntimeError("no micro batch fit")
     micro, tps = best
+    search_capped = micro == tries[-1]  # never hit OOM: not a true ceiling
     achieved = 6.0 * n_params * tps / 1e12
     peak = _dense_peak_tflops() if on_tpu else 0.0
     out = {
@@ -243,6 +244,8 @@ def run_headroom(on_tpu: bool) -> dict:
     if peak:
         out["chip_dense_tflops"] = round(peak, 1)
         out["mfu_pct"] = round(100 * achieved / peak, 1)
+    if search_capped:
+        out["search_capped"] = True  # largest TRIED batch fit; not an OOM ceiling
     return out
 
 

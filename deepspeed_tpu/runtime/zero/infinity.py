@@ -607,6 +607,7 @@ class InfinityRuntime:
 
     def load_state_dict(self, sd):
         self.adam.load_state_dict({k: sd[k] for k in ("step", "state")})
+        self._kept.clear()  # stash may predate the restored masters
         self._acc_count = int(sd.get("acc_count", 0))
         self._acc_sink = {int(k): np.asarray(v, np.float32)
                           for k, v in (sd.get("acc_sink") or {}).items()}

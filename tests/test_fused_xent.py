@@ -49,6 +49,7 @@ def test_fused_xent_gradient_parity():
                                    rtol=5e-4, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_pallas_loss_impl_through_gpt():
     """loss_impl='pallas' must give the same loss/grads as the XLA path
     through the full model (vocab 50304-style multiple-of-512 shapes)."""

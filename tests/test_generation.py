@@ -51,6 +51,7 @@ def test_sampling_is_reproducible_and_in_range():
     assert not np.array_equal(a, c)  # different seed, different sample
 
 
+@pytest.mark.slow
 def test_greedy_matches_huggingface_generate():
     torch = pytest.importorskip("torch")
     transformers = pytest.importorskip("transformers")

@@ -40,6 +40,7 @@ def test_loss_finite_and_masking():
     assert float(loss0) == 0.0
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     cfg = _tiny_cfg()
     model = GPT(cfg)
@@ -68,7 +69,8 @@ def test_param_specs_tree_matches_params():
     assert pt == st
 
 
-@pytest.mark.parametrize("zero_stage", [0, 2])
+@pytest.mark.parametrize("zero_stage", [
+    pytest.param(0, marks=pytest.mark.slow), 2])
 def test_gpt_trains_through_engine(zero_stage):
     cfg = _tiny_cfg()
     model = GPT(cfg)

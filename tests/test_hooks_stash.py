@@ -39,6 +39,7 @@ def _one_step(engine, gas=1, seed=0):
     return loss
 
 
+@pytest.mark.slow
 def test_forward_hook_fused_path():
     engine, cfg = _gpt_engine(gas=1)
     engine.register_forward_hook(layers_to_hook=[0, 2])
@@ -91,6 +92,7 @@ def test_forward_hook_unsupported_model():
         engine.register_forward_hook(layers_to_hook=[0])
 
 
+@pytest.mark.slow
 def test_store_gradients_fused_path():
     engine, _ = _gpt_engine(gas=1)
     engine.store_gradients = True

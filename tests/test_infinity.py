@@ -63,6 +63,7 @@ def test_streamed_training_decreases_loss():
     assert engine.global_steps == 12
 
 
+@pytest.mark.slow
 def test_streamed_step_matches_resident_engine():
     """fp32 streamed step == fp32 resident fused step (same Adam math,
     same chunked CE) — the streaming is a memory plan, not a numerics
@@ -90,6 +91,7 @@ def test_streamed_step_matches_resident_engine():
         streamed.params, resident.params)
 
 
+@pytest.mark.slow
 def test_streamed_checkpoint_roundtrip(tmp_path):
     engine, *_ = deepspeed_tpu.initialize(model=_model(),
                                           config_params=_config())
@@ -135,6 +137,7 @@ def test_untied_embeddings_stream():
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_nvme_moments_survive_checkpoint(tmp_path):
     """Adam moments paged to NVMe must round-trip through save/load —
     a resume that silently zeroes moments corrupts bias correction."""
@@ -176,6 +179,7 @@ def test_infinity_honors_model_parameters():
         engine.params, pretrained)
 
 
+@pytest.mark.slow
 def test_gas_accumulation_matches_single_step():
     """gas=4 at micro batch B must take the same optimizer step as gas=1
     at batch 4B when the 4 micro batches concatenate to the big batch
@@ -221,6 +225,7 @@ def test_gas_accumulation_matches_single_step():
         jax.tree_util.tree_leaves(acc.params)[0], rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_params_paged_to_nvme_train_and_resume(tmp_path):
     """offload_param nvme: fp32 masters live on disk (RAM slots are None),
     training still converges, and a checkpoint roundtrip restores both

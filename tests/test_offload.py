@@ -52,6 +52,7 @@ def test_cpu_offload_trains():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_cpu_offload_matches_device_adam():
     """Offloaded host Adam must track the device FusedAdam trajectory."""
     losses = {}

@@ -99,6 +99,7 @@ def test_checkpoint_roundtrip_with_optax(tmp_path):
     assert l1 == pytest.approx(l2, rel=1e-6)
 
 
+@pytest.mark.slow
 def test_pipeline_engine_optax_checkpoint(tmp_path):
     """The pipe engine's per-stage optimizer states go through the
     serialize/deserialize hooks too (namedtuple states, msgpack)."""

@@ -182,6 +182,7 @@ def test_onebit_lamb_frozen_stage_state_machine():
     assert 0.5 <= float(state["last_factor"]["w"]) <= 4.0
 
 
+@pytest.mark.slow
 def test_onebit_lamb_converges_quadratic():
     from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
 

@@ -169,6 +169,7 @@ def test_gpt_pipeline_matches_sequential_loss():
     np.testing.assert_allclose(out, ref, rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_gpt_pipeline_trains_through_engine():
     cfg = gpt2_config("nano", num_layers=4, pipeline_stages=2,
                       pipeline_micro_batches=2)

@@ -96,7 +96,8 @@ def train_losses(num_stages, steps=3):
     return losses, engine
 
 
-@pytest.mark.parametrize("stages", [2, 4])
+@pytest.mark.parametrize("stages", [
+    2, pytest.param(4, marks=pytest.mark.slow)])
 def test_pipeline_loss_parity_vs_sequential(stages):
     """PP=N runs the heterogeneous tied model to the same losses as the
     single-stage baseline, step after step (updates included)."""
@@ -230,7 +231,10 @@ def test_interleaved_schedule_invariants():
         InterleavedTrainSchedule(3, 2, 0, 2)
 
 
-@pytest.mark.parametrize("stages,chunks", [(2, 2), (2, 3), (4, 2)])
+@pytest.mark.parametrize("stages,chunks", [
+    (2, 2),
+    pytest.param(2, 3, marks=pytest.mark.slow),
+    pytest.param(4, 2, marks=pytest.mark.slow)])
 def test_interleaved_loss_parity_vs_sequential(stages, chunks):
     """PP x virtual chunks trains the tied model to the same losses
     as the single-stage baseline — the interleaved wrap routing
@@ -262,6 +266,7 @@ def test_interleaved_loss_parity_vs_sequential(stages, chunks):
             np.asarray(owner.own["tied"]["embed"]["weight"]), rtol=1e-6)
 
 
+@pytest.mark.slow
 def test_interleaved_checkpoint_roundtrip(tmp_path):
     engine, *_ = deepspeed_tpu.initialize(
         model=build_interleaved(2, 2), config_params=config(2))
@@ -275,6 +280,7 @@ def test_interleaved_checkpoint_roundtrip(tmp_path):
     np.testing.assert_allclose(l1, l2, rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_gpt_layerspec_pipeline_interleaved():
     """The flagship GPT runs through the 1F1B engine as LayerSpecs with
     tied embeddings and interleave=2, matching the sequential baseline

@@ -51,6 +51,7 @@ def test_channel_executor_matches_single_controller():
     np.testing.assert_allclose(ch_e, ref_e, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_channel_executor_interleaved():
     """Interleaved virtual stages through the channel executor: chunk
     wrap-around channels (stage P-1 chunk c -> stage 0 chunk c+1)."""

@@ -2,6 +2,7 @@
 engine hooks engine.py:972-973,1215-1216, keep gates models/gpt.py)."""
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -58,6 +59,7 @@ def test_schedule_anneals_toward_theta_bar():
     assert abs(thetas[-1] - 0.5) < 0.01  # converges to theta_bar
 
 
+@pytest.mark.slow
 def test_pld_through_engine():
     model = GPT(gpt2_config("nano", vocab_size=128))
     engine, *_ = ds.initialize(model=model, config={

@@ -39,6 +39,7 @@ def _train(engine, n=2):
         engine.step()
 
 
+@pytest.mark.slow
 def test_save_writes_per_rank_shard_files(tmp_path):
     engine = _engine(zero_stage=2)
     _train(engine)
@@ -72,6 +73,7 @@ def test_sharded_roundtrip_restores_state(tmp_path):
         fresh._opt_state, ref_opt)
 
 
+@pytest.mark.slow
 def test_missing_rank_file_fails_loudly(tmp_path):
     engine = _engine(zero_stage=2)
     _train(engine)
@@ -84,6 +86,7 @@ def test_missing_rank_file_fails_loudly(tmp_path):
         ckpt_io.load_checkpoint_state(str(tmp_path), "broken")
 
 
+@pytest.mark.slow
 def test_async_save_then_flush(tmp_path):
     engine = _engine(zero_stage=2, async_save=True)
     _train(engine)

@@ -40,6 +40,7 @@ def test_exhausts_budget_and_reports_last_code(tmp_path):
     assert rc == 23
 
 
+@pytest.mark.slow
 def test_crash_then_checkpoint_resume(tmp_path):
     """The full loop: training crashes mid-run, the supervisor
     relaunches, the fresh process resumes from the latest checkpoint and

@@ -180,6 +180,7 @@ def test_bert_shapes():
     assert nsp.shape == (4, 2)
 
 
+@pytest.mark.slow
 def test_bert_trains_through_engine():
     cfg = _tiny_bert()
     model = Bert(cfg)
@@ -202,6 +203,7 @@ def test_bert_trains_through_engine():
     assert losses[-1] < losses[0], losses
 
 
+@pytest.mark.slow
 def test_bert_tp_sharding():
     cfg = _tiny_bert()
     model = Bert(cfg)

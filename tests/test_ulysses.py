@@ -39,6 +39,7 @@ def test_ulysses_matches_dense_attention():
                                rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_ulysses_gpt_trains_and_matches_ring():
     """GPT with ulysses SP trains on a dp x seq mesh; eval loss agrees
     with the (already parity-tested) ring implementation."""

@@ -78,6 +78,9 @@ def main():
     import jax
 
     if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # NOT a no-op here: sitecustomize imports jax with the TPU plugin
+        # at interpreter start, so the env var alone is too late — the
+        # config update is what actually enforces the CPU pin
         jax.config.update("jax_platforms", "cpu")
     from deepspeed_tpu.models import GPT, gpt2_config
 

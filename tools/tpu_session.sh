@@ -35,7 +35,6 @@ run sweep_sparse 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tm
 run bert128      1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
 run bert512      1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
 run profile      1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
-DSTPU_BENCH_MODE=headroom timeout 2400 python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
-echo "headroom $?" >> "$STATUS"
+run headroom 2400 env DSTPU_BENCH_MODE=headroom python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
 cat "$STATUS"
 echo done
