@@ -451,6 +451,14 @@ class PipelineEngine(DeepSpeedEngine):
                         groups[u % P], groups[o % P], replicate=True)
                     self._chan_tied_param[(key, u)] = Channel(
                         groups[o % P], groups[u % P], replicate=True)
+        # checkpoint-save gather channels (tied owner -> process 0),
+        # built once so periodic saves don't re-jit transfer programs
+        self._chan_tied_save: Dict[str, Channel] = {}
+        for key in sorted(self._tied_owner):
+            o = self._tied_owner[key]
+            if o % P != 0 and endpoint(o, 0):
+                self._chan_tied_save[key] = Channel(
+                    groups[o % P], groups[0], replicate=True)
         self._gscal = GlobalScalars()
         self._aval_cache: Dict[Any, Any] = {}
         log_dist(
@@ -1037,6 +1045,178 @@ class PipelineEngine(DeepSpeedEngine):
             tied.update(rt.own["tied"])
         return {"layers": layers, "tied": tied}
 
+    # ------------------------------------------------------------------
+    # multi-host checkpointing: reference-layout per-layer files, one
+    # writer per owned piece (the sharded-checkpoint rule, engine.py
+    # one-writer-per-piece), reassembled into the SAME on-disk format the
+    # single-process engine writes, so checkpoints are portable between
+    # multi-host and single-host runs
+    # ------------------------------------------------------------------
+
+    def _mh_write(self, path, payload):
+        from flax import serialization
+
+        with open(path, "wb") as f:
+            f.write(serialization.msgpack_serialize(
+                jax.tree_util.tree_map(np.asarray, payload)))
+
+    def _mh_read(self, path):
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            return serialization.msgpack_restore(f.read())
+
+    def _chunk_optim_name(self, ckpt_dir, mc):
+        return os.path.join(ckpt_dir, f"pipe_optim_chunk{mc:02d}.msgpack")
+
+    def _save_checkpoint_mh(self, save_dir, tag=None, client_state=None,
+                            save_latest=True):
+        if tag is None:
+            tag = f"global_step{self.global_steps}"
+        module: PipelineModule = self.module
+        me = jax.process_index()
+        ckpt_dir = os.path.join(save_dir, str(tag))
+        os.makedirs(ckpt_dir, exist_ok=True)
+
+        for mc in sorted(self._local):
+            rt = self._local[mc]
+            lo = module.parts[mc]
+            own_np = jax.tree_util.tree_map(np.asarray, rt.own)
+            for j, lp in enumerate(own_np["layers"]):
+                self._mh_write(ckpt_io.layer_ckpt_name(ckpt_dir, lo + j),
+                               lp)
+            state = rt.opt_state
+            if hasattr(self.optimizer, "serialize_state"):
+                state = self.optimizer.serialize_state(state)
+            self._mh_write(self._chunk_optim_name(ckpt_dir, mc), state)
+
+        # tied params: ship each owner's copy to process 0 so the module
+        # skeleton carries the full tied dict (single-host-loadable);
+        # every process constructs/enters the channels in sorted order
+        tied_full = {}
+        for key in sorted(self._tied_owner):
+            o = self._tied_owner[key]
+            if o % self._n_phys == 0:
+                if me == 0:
+                    tied_full[key] = jax.tree_util.tree_map(
+                        np.asarray, self._local[o].own["tied"][key])
+                continue
+            chan = self._chan_tied_save.get(key)
+            if chan is not None:
+                val = (self._local[o].own["tied"][key]
+                       if o in self._local else None)
+                res = chan.transfer(self._abs_tied[key], val)
+                if me == 0:
+                    tied_full[key] = jax.tree_util.tree_map(np.asarray,
+                                                            res)
+
+        if me == 0:
+            L = module.num_layers()
+            model_state = {
+                "module": {"layers": [None] * L, "tied": tied_full,
+                           "num_layers": L},
+                "lr_scheduler": (self.lr_scheduler.state_dict()
+                                 if self.lr_scheduler is not None else None),
+                "loss_scaler": {k: np.asarray(v)
+                                for k, v in self._scaler_state.items()},
+                "rng_key": np.asarray(self._rng_key),
+                "pipeline_parts": list(module.parts),
+                **self._client_state(client_state),
+            }
+            self._mh_write(ckpt_io.model_ckpt_name(ckpt_dir), model_state)
+        # collective barrier: every process's files are on disk before
+        # rank 0 publishes `latest`
+        self._gscal.sum(np.zeros(1, np.float32))
+        if save_latest and me == 0:
+            with open(os.path.join(save_dir, "latest"), "w") as f:
+                f.write(str(tag))
+        log_dist(f"saved multi-host pipeline checkpoint {tag} to "
+                 f"{ckpt_dir}", ranks=[0])
+        return True
+
+    def _load_checkpoint_mh(self, load_dir, tag=None,
+                            load_optimizer_states=True,
+                            load_lr_scheduler_states=True):
+        module: PipelineModule = self.module
+        if tag is None:
+            tag = ckpt_io.read_latest_tag(load_dir)
+            if tag is None:
+                logger.warning(f"load_checkpoint: no latest in {load_dir}")
+                return None, {}
+        ckpt_dir = os.path.join(load_dir, str(tag))
+        mpath = ckpt_io.model_ckpt_name(ckpt_dir)
+        if not os.path.isfile(mpath):
+            logger.warning(f"load_checkpoint: {mpath} not found")
+            return None, {}
+        model_state = self._mh_read(mpath)
+        tied = (model_state.get("module") or {}).get("tied", {})
+        if model_state.get("pipeline_parts") not in (None,
+                                                     list(module.parts)):
+            raise ValueError(
+                f"checkpoint pipeline_parts "
+                f"{model_state.get('pipeline_parts')} != current "
+                f"{list(module.parts)}; repartitioned multi-host reload "
+                f"is unsupported")
+        single_optim = None  # single-host-written optimizer fallback
+        for mc in sorted(self._local):
+            rt = self._local[mc]
+            lo, hi = module.parts[mc], module.parts[mc + 1]
+            layers = [jax.tree_util.tree_map(
+                jnp.asarray,
+                self._mh_read(ckpt_io.layer_ckpt_name(ckpt_dir, i)))
+                for i in range(lo, hi)]
+            own_tied = {k: jax.tree_util.tree_map(jnp.asarray, tied[k])
+                        for k, o in self._tied_owner.items() if o == mc}
+            rt.own = rt.place_replicated({"layers": layers,
+                                          "tied": own_tied})
+            if load_optimizer_states:
+                cpath = self._chunk_optim_name(ckpt_dir, mc)
+                restored = None
+                if os.path.isfile(cpath):
+                    restored = self._mh_read(cpath)
+                else:  # single-host-written checkpoint: list layout
+                    if single_optim is None:
+                        opath = ckpt_io.optim_ckpt_name(ckpt_dir)
+                        if os.path.isfile(opath):
+                            so = self._mh_read(opath)
+                            if isinstance(so, dict) and \
+                                    so.get("__dstpu_ckpt_v2__"):
+                                # v2 wrapper: payload under "state",
+                                # sharded leaves in rank piece files
+                                pieces = ckpt_io._load_rank_pieces(
+                                    ckpt_dir, 0)
+                                so = so.get("state")
+                                if pieces:
+                                    so = ckpt_io._reassemble(so, pieces)
+                            single_optim = so or {}
+                    if single_optim and single_optim.get(
+                            "pipeline_parts") == list(module.parts):
+                        restored = single_optim["optimizer_state"][mc]
+                if restored is not None:
+                    if hasattr(self.optimizer, "deserialize_state"):
+                        restored = self.optimizer.deserialize_state(
+                            restored, rt.own)
+                    rt.opt_state = rt.place_replicated(
+                        jax.tree_util.tree_map(jnp.asarray, restored))
+            rt.zero_acc()
+        self._refresh_tied_copies_mh()
+        if model_state.get("loss_scaler") is not None:
+            self._scaler_state = {k: jnp.asarray(v) for k, v in
+                                  model_state["loss_scaler"].items()}
+        if load_lr_scheduler_states and self.lr_scheduler is not None and \
+                model_state.get("lr_scheduler") is not None:
+            self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
+        if model_state.get("rng_key") is not None:
+            self._rng_key = jnp.asarray(model_state["rng_key"])
+        self.global_steps = int(model_state.get("global_steps", 0))
+        self.global_samples = int(model_state.get("global_samples", 0))
+        self.micro_steps = int(model_state.get("micro_steps", 0))
+        self.loaded_checkpoint_tag = str(tag)
+        client_state = {k: v for k, v in model_state.items()
+                        if k not in ("module", "lr_scheduler",
+                                     "loss_scaler", "pipeline_parts")}
+        return ckpt_dir, client_state
+
     def _runtimes(self) -> List[_StageRuntime]:
         """Stage runtimes in model-chunk order. In channel (mh) mode this
         is only valid when every chunk is local (single process)."""
@@ -1189,12 +1369,8 @@ class PipelineEngine(DeepSpeedEngine):
             return super().save_checkpoint(save_dir, tag, client_state,
                                            save_latest)
         if self._mh and jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-host pipeline checkpointing is not wired up yet: "
-                "each process holds only its own stage, and the per-layer "
-                "writer currently assumes a full local view. Save from a "
-                "single-process reload, or use the per-stage params "
-                "property to export this process's shard.")
+            return self._save_checkpoint_mh(save_dir, tag, client_state,
+                                            save_latest)
         if tag is None:
             tag = f"global_step{self.global_steps}"
         module: PipelineModule = self.module
@@ -1243,9 +1419,9 @@ class PipelineEngine(DeepSpeedEngine):
                                            load_optimizer_states,
                                            load_lr_scheduler_states)
         if self._mh and jax.process_count() > 1:
-            raise NotImplementedError(
-                "multi-host pipeline checkpointing is not wired up yet "
-                "(see save_checkpoint)")
+            return self._load_checkpoint_mh(load_dir, tag,
+                                            load_optimizer_states,
+                                            load_lr_scheduler_states)
         try:
             ckpt_dir, model_state, optim_state = \
                 ckpt_io.load_checkpoint_state(load_dir, tag)
@@ -1253,6 +1429,18 @@ class PipelineEngine(DeepSpeedEngine):
             logger.warning(f"load_checkpoint: {e}")
             return None, {}
         module: PipelineModule = self.module
+        if optim_state is None:
+            # multi-host-written checkpoint: per-chunk optim files instead
+            # of the single zero_pp_rank file — reassemble the list layout
+            chunk_files = [self._chunk_optim_name(ckpt_dir, mc)
+                           for mc in range(len(module.parts) - 1)]
+            if all(os.path.isfile(p) for p in chunk_files):
+                optim_state = {
+                    "optimizer_state": [self._mh_read(p)
+                                        for p in chunk_files],
+                    "pipeline_parts": model_state.get(
+                        "pipeline_parts", list(module.parts)),
+                }
         layers = model_state["module"]["layers"]
         tied = model_state["module"]["tied"]
         for s, rt in enumerate(self._runtimes()):

@@ -45,6 +45,46 @@ def main():
         print(f"MHPIPE step={step} loss={float(loss):.6f}", flush=True)
     ev = engine.eval_batch(iter(data(999, M)))
     print(f"MHPIPE eval={float(ev):.6f}", flush=True)
+
+    # multi-host checkpoint roundtrip: every process writes its own
+    # stage's layer/optim pieces; a fresh engine reloads and must train
+    # identically to the original from here
+    import numpy as np
+
+    # the checkpoint dir MUST be shared across all workers (each writes
+    # its own stage's pieces into it) — a per-process tempdir would
+    # scatter the checkpoint
+    assert len(sys.argv) > 5, "usage: ... <steps> <shared_ckpt_dir>"
+    ckdir = sys.argv[5]
+    engine.save_checkpoint(ckdir, tag="mh")
+    fresh, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=nprocs),
+        dist_init_required=False,
+        config_params=config())
+    ckpt_dir, _ = fresh.load_checkpoint(ckdir, tag="mh")
+    assert ckpt_dir is not None and fresh.global_steps == steps
+    l1 = float(engine.train_batch(iter(data(555, M))))
+    l2 = float(fresh.train_batch(iter(data(555, M))))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+    print(f"MHPIPE ckpt_resume l1={l1:.6f} l2={l2:.6f} CKPT_OK",
+          flush=True)
+
+    # cross-direction: a SINGLE-host-written checkpoint (passed by the
+    # parent) loads into this multi-host engine, optimizer state included
+    if len(sys.argv) > 6:
+        shdir = sys.argv[6]
+        xeng, *_ = deepspeed_tpu.initialize(
+            model=build_module(num_stages=nprocs),
+            dist_init_required=False,
+            config_params=config())
+        d, _ = xeng.load_checkpoint(shdir, tag="sh")
+        assert d is not None and xeng.global_steps == 1, xeng.global_steps
+        steps_restored = {
+            mc: int(np.asarray(rt.opt_state["step"]))
+            for mc, rt in xeng._local.items()}
+        assert all(v == 1 for v in steps_restored.values()), steps_restored
+        lx = float(xeng.train_batch(iter(data(777, M))))
+        print(f"MHPIPE crossload lx={lx:.6f} SH_OK", flush=True)
     print("MHPIPE done", flush=True)
 
 

@@ -88,11 +88,13 @@ print("LOAD OK")
 
 
 @pytest.mark.slow
-def test_two_process_infinity_dp():
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_two_process_infinity_dp(nprocs):
     """Multi-host ZeRO-Infinity: each process streams on its local batch
     shard; CrossProcessGradReducer averages grads, so losses and updated
-    masters must agree across workers (replica-divergence guard)."""
-    nprocs = 2
+    masters must agree across workers (replica-divergence guard).
+    nprocs=4 exercises the chunk-staging reduction beyond the pair case
+    (the r3 review's untested-at-scale concern)."""
     coord = f"127.0.0.1:{_free_port()}"
     worker = os.path.join(os.path.dirname(__file__),
                           "multihost_infinity_worker.py")

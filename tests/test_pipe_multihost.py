@@ -69,10 +69,25 @@ def test_two_process_pipeline_parity():
                           "multihost_pipe_worker.py")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    import tempfile
+
+    ckdir = tempfile.mkdtemp(prefix="mhpipe_ck_")
+    # a single-host-written checkpoint for the workers' cross-direction
+    # load check (written on the local 8-device mesh before they start)
+    shdir = tempfile.mkdtemp(prefix="mhpipe_sh_")
+    import deepspeed_tpu
+    from pipe_parity_common import M, build_module, config, data
+
+    sh_engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=nprocs),
+        config_params=config())
+    sh_engine.train_batch(iter(data(100, M)))
+    sh_engine.save_checkpoint(shdir, tag="sh")
+
     procs = [
         subprocess.Popen(
             [sys.executable, worker, str(i), str(nprocs), coord,
-             str(steps)],
+             str(steps), ckdir, shdir],
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             env=env)
         for i in range(nprocs)
@@ -88,10 +103,13 @@ def test_two_process_pipeline_parity():
             if p.poll() is None:
                 p.kill()
 
-    # both processes completed and report identical losses
+    # both processes completed and report identical losses; the
+    # cross-process checkpoint roundtrip resumed with loss parity
     curves = []
     for out in outs:
         assert "MHPIPE done" in out, out[-2000:]
+        assert "CKPT_OK" in out, out[-2000:]
+        assert "SH_OK" in out, out[-2000:]
         losses = [float(ln.split("loss=")[1])
                   for ln in out.splitlines() if "loss=" in ln]
         evals = [float(ln.split("eval=")[1])
@@ -99,6 +117,23 @@ def test_two_process_pipeline_parity():
         assert len(losses) == steps and len(evals) == 1, out[-2000:]
         curves.append(losses + evals)
     np.testing.assert_allclose(curves[0], curves[1], rtol=1e-6)
+
+    # cross-direction loss agreement: both workers continued identically
+    # from the single-host checkpoint
+    lx = {ln.split("lx=")[1].split()[0]
+          for out in outs for ln in out.splitlines() if "lx=" in ln}
+    assert len(lx) == 1, lx
+
+    # and the mh-written checkpoint loads back into a single-host engine
+    # WITH optimizer state (the reassembled per-chunk layout)
+    back, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=nprocs),
+        config_params=config())
+    d, _ = back.load_checkpoint(ckdir, tag="mh")
+    assert d is not None and back.global_steps == steps
+    for rt in back._runtimes():
+        assert int(np.asarray(rt.opt_state["step"])) == steps
+    assert np.isfinite(float(back.train_batch(iter(data(888, M)))))
 
     # and the multi-host curve matches the single-process oracle
     # (2 devices per process over 2 processes vs 8 local devices — use
