@@ -452,12 +452,22 @@ class PipelineEngine(DeepSpeedEngine):
                     self._chan_tied_param[(key, u)] = Channel(
                         groups[o % P], groups[u % P], replicate=True)
         # checkpoint-save gather channels (tied owner -> process 0),
-        # built once so periodic saves don't re-jit transfer programs
+        # built once so periodic saves don't re-jit transfer programs.
+        # Only needed multi-process (mh save is guarded on it), and an
+        # existing owner->user param channel with the user on process 0
+        # is reused rather than duplicated.
         self._chan_tied_save: Dict[str, Channel] = {}
-        for key in sorted(self._tied_owner):
-            o = self._tied_owner[key]
-            if o % P != 0 and endpoint(o, 0):
-                self._chan_tied_save[key] = Channel(
+        if nprocs > 1:
+            for key in sorted(self._tied_owner):
+                o = self._tied_owner[key]
+                if o % P == 0 or not endpoint(o, 0):
+                    continue
+                reuse = next(
+                    (self._chan_tied_param[(key, u)]
+                     for u in sorted(self._tied_users[key])
+                     if u % P == 0 and (key, u) in self._chan_tied_param),
+                    None)
+                self._chan_tied_save[key] = reuse or Channel(
                     groups[o % P], groups[0], replicate=True)
         self._gscal = GlobalScalars()
         self._aval_cache: Dict[Any, Any] = {}
@@ -1069,6 +1079,23 @@ class PipelineEngine(DeepSpeedEngine):
     def _chunk_optim_name(self, ckpt_dir, mc):
         return os.path.join(ckpt_dir, f"pipe_optim_chunk{mc:02d}.msgpack")
 
+    def _read_local_chunks(self, ckpt_dir, tied):
+        """Read every local chunk's layer files + owned tied params in one
+        pass BEFORE mutating any runtime state, so a missing file leaves
+        the engine untouched."""
+        module: PipelineModule = self.module
+        staged = {}
+        for mc in sorted(self._local):
+            lo, hi = module.parts[mc], module.parts[mc + 1]
+            layers = [jax.tree_util.tree_map(
+                jnp.asarray,
+                self._mh_read(ckpt_io.layer_ckpt_name(ckpt_dir, i)))
+                for i in range(lo, hi)]
+            own_tied = {k: jax.tree_util.tree_map(jnp.asarray, tied[k])
+                        for k, o in self._tied_owner.items() if o == mc}
+            staged[mc] = (layers, own_tied)
+        return staged
+
     def _save_checkpoint_mh(self, save_dir, tag=None, client_state=None,
                             save_latest=True):
         if tag is None:
@@ -1081,8 +1108,11 @@ class PipelineEngine(DeepSpeedEngine):
         for mc in sorted(self._local):
             rt = self._local[mc]
             lo = module.parts[mc]
-            own_np = jax.tree_util.tree_map(np.asarray, rt.own)
-            for j, lp in enumerate(own_np["layers"]):
+            # layers only: tied params are gathered separately below, so
+            # a whole-tree D2H would copy the (large) tied tables twice
+            layers_np = jax.tree_util.tree_map(np.asarray,
+                                               rt.own["layers"])
+            for j, lp in enumerate(layers_np):
                 self._mh_write(ckpt_io.layer_ckpt_name(ckpt_dir, lo + j),
                                lp)
             state = rt.opt_state
@@ -1158,15 +1188,18 @@ class PipelineEngine(DeepSpeedEngine):
                 f"{list(module.parts)}; repartitioned multi-host reload "
                 f"is unsupported")
         single_optim = None  # single-host-written optimizer fallback
+        try:
+            staged = self._read_local_chunks(ckpt_dir, tied)
+        except (FileNotFoundError, KeyError) as e:
+            # partial checkpoint (e.g. a writer died before the barrier)
+            # or layer/tied mismatch: keep the warn-and-return contract
+            # the single-host path has, don't crash training scripts
+            logger.warning(f"load_checkpoint: incomplete checkpoint in "
+                           f"{ckpt_dir}: {e!r}")
+            return None, {}
         for mc in sorted(self._local):
             rt = self._local[mc]
-            lo, hi = module.parts[mc], module.parts[mc + 1]
-            layers = [jax.tree_util.tree_map(
-                jnp.asarray,
-                self._mh_read(ckpt_io.layer_ckpt_name(ckpt_dir, i)))
-                for i in range(lo, hi)]
-            own_tied = {k: jax.tree_util.tree_map(jnp.asarray, tied[k])
-                        for k, o in self._tied_owner.items() if o == mc}
+            layers, own_tied = staged[mc]
             rt.own = rt.place_replicated({"layers": layers,
                                           "tied": own_tied})
             if load_optimizer_states:
@@ -1200,6 +1233,13 @@ class PipelineEngine(DeepSpeedEngine):
                         jax.tree_util.tree_map(jnp.asarray, restored))
             rt.zero_acc()
         self._refresh_tied_copies_mh()
+        return self._finish_pipe_load(model_state, ckpt_dir,
+                                      load_lr_scheduler_states)
+
+    def _finish_pipe_load(self, model_state, ckpt_dir,
+                          load_lr_scheduler_states):
+        """Shared tail of both pipeline loaders: scaler/scheduler/rng/
+        counter restore + client-state extraction (one copy, no drift)."""
         if model_state.get("loss_scaler") is not None:
             self._scaler_state = {k: jnp.asarray(v) for k, v in
                                   model_state["loss_scaler"].items()}
@@ -1211,7 +1251,7 @@ class PipelineEngine(DeepSpeedEngine):
         self.global_steps = int(model_state.get("global_steps", 0))
         self.global_samples = int(model_state.get("global_samples", 0))
         self.micro_steps = int(model_state.get("micro_steps", 0))
-        self.loaded_checkpoint_tag = str(tag)
+        self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)
         client_state = {k: v for k, v in model_state.items()
                         if k not in ("module", "lr_scheduler",
                                      "loss_scaler", "pipeline_parts")}
@@ -1464,19 +1504,5 @@ class PipelineEngine(DeepSpeedEngine):
             self._refresh_tied_copies_mh()
         else:
             self._refresh_tied_copies()
-        if model_state.get("loss_scaler") is not None:
-            self._scaler_state = {
-                k: jnp.asarray(v)
-                for k, v in model_state["loss_scaler"].items()}
-        if load_lr_scheduler_states and self.lr_scheduler is not None and \
-                model_state.get("lr_scheduler") is not None:
-            self.lr_scheduler.load_state_dict(model_state["lr_scheduler"])
-        if model_state.get("rng_key") is not None:
-            self._rng_key = jnp.asarray(model_state["rng_key"])
-        self.global_steps = int(model_state.get("global_steps", 0))
-        self.global_samples = int(model_state.get("global_samples", 0))
-        self.micro_steps = int(model_state.get("micro_steps", 0))
-        self.loaded_checkpoint_tag = os.path.basename(ckpt_dir)
-        client_state = {k: v for k, v in model_state.items()
-                        if k not in ("module", "lr_scheduler", "loss_scaler")}
-        return ckpt_dir, client_state
+        return self._finish_pipe_load(model_state, ckpt_dir,
+                                      load_lr_scheduler_states)

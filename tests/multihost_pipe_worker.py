@@ -49,8 +49,6 @@ def main():
     # multi-host checkpoint roundtrip: every process writes its own
     # stage's layer/optim pieces; a fresh engine reloads and must train
     # identically to the original from here
-    import numpy as np
-
     # the checkpoint dir MUST be shared across all workers (each writes
     # its own stage's pieces into it) — a per-process tempdir would
     # scatter the checkpoint

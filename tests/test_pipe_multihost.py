@@ -69,6 +69,7 @@ def test_two_process_pipeline_parity():
                           "multihost_pipe_worker.py")
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)
+    import shutil
     import tempfile
 
     ckdir = tempfile.mkdtemp(prefix="mhpipe_ck_")
@@ -134,6 +135,9 @@ def test_two_process_pipeline_parity():
     for rt in back._runtimes():
         assert int(np.asarray(rt.opt_state["step"])) == steps
     assert np.isfinite(float(back.train_batch(iter(data(888, M)))))
+    # cleanup on success (kept on failure for post-mortem)
+    shutil.rmtree(ckdir, ignore_errors=True)
+    shutil.rmtree(shdir, ignore_errors=True)
 
     # and the multi-host curve matches the single-process oracle
     # (2 devices per process over 2 processes vs 8 local devices — use
