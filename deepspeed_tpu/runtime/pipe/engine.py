@@ -1079,12 +1079,13 @@ class PipelineEngine(DeepSpeedEngine):
     def _chunk_optim_name(self, ckpt_dir, mc):
         return os.path.join(ckpt_dir, f"pipe_optim_chunk{mc:02d}.msgpack")
 
-    def _read_local_chunks(self, ckpt_dir, tied):
-        """Read every local chunk's layer files + owned tied params in one
-        pass BEFORE mutating any runtime state, so a missing file leaves
-        the engine untouched."""
+    def _read_local_chunks(self, ckpt_dir, tied, load_optimizer_states):
+        """Read every local chunk's layer files, owned tied params AND
+        optimizer chunk states in one pass BEFORE mutating any runtime
+        state, so any unreadable file leaves the engine untouched."""
         module: PipelineModule = self.module
         staged = {}
+        single_optim = None  # single-host-written optimizer fallback
         for mc in sorted(self._local):
             lo, hi = module.parts[mc], module.parts[mc + 1]
             layers = [jax.tree_util.tree_map(
@@ -1093,7 +1094,37 @@ class PipelineEngine(DeepSpeedEngine):
                 for i in range(lo, hi)]
             own_tied = {k: jax.tree_util.tree_map(jnp.asarray, tied[k])
                         for k, o in self._tied_owner.items() if o == mc}
-            staged[mc] = (layers, own_tied)
+            restored = None
+            if load_optimizer_states:
+                cpath = self._chunk_optim_name(ckpt_dir, mc)
+                if os.path.isfile(cpath):
+                    restored = self._mh_read(cpath)
+                else:  # single-host-written checkpoint: list layout
+                    if single_optim is None:
+                        opath = ckpt_io.optim_ckpt_name(ckpt_dir)
+                        if os.path.isfile(opath):
+                            so = self._mh_read(opath)
+                            if isinstance(so, dict) and \
+                                    so.get("__dstpu_ckpt_v2__"):
+                                # v2 wrapper: payload under "state",
+                                # sharded leaves in rank piece files
+                                pieces = ckpt_io._load_rank_pieces(
+                                    ckpt_dir, 0)
+                                so = so.get("state")
+                                if pieces:
+                                    so = ckpt_io._reassemble(so, pieces)
+                            single_optim = so or {}
+                    if single_optim and single_optim.get(
+                            "pipeline_parts") == list(module.parts):
+                        restored = single_optim["optimizer_state"][mc]
+                if restored is None:
+                    # loud, not silent: resuming with fresh Adam moments
+                    # is a numerics regression the caller must know about
+                    logger.warning(
+                        f"load_checkpoint: no optimizer state for model "
+                        f"chunk {mc} in {ckpt_dir}; its optimizer "
+                        f"re-initializes from scratch")
+            staged[mc] = (layers, own_tied, restored)
         return staged
 
     def _save_checkpoint_mh(self, save_dir, tag=None, client_state=None,
@@ -1187,50 +1218,30 @@ class PipelineEngine(DeepSpeedEngine):
                 f"{model_state.get('pipeline_parts')} != current "
                 f"{list(module.parts)}; repartitioned multi-host reload "
                 f"is unsupported")
-        single_optim = None  # single-host-written optimizer fallback
         try:
-            staged = self._read_local_chunks(ckpt_dir, tied)
-        except (FileNotFoundError, KeyError) as e:
-            # partial checkpoint (e.g. a writer died before the barrier)
-            # or layer/tied mismatch: keep the warn-and-return contract
-            # the single-host path has, don't crash training scripts
-            logger.warning(f"load_checkpoint: incomplete checkpoint in "
-                           f"{ckpt_dir}: {e!r}")
+            staged = self._read_local_chunks(ckpt_dir, tied,
+                                             load_optimizer_states)
+        except Exception as e:
+            # partial/torn checkpoint (a writer died before the barrier:
+            # missing files raise FileNotFoundError, truncated msgpack
+            # raises unpack errors) or layer/tied mismatch — keep the
+            # warn-and-return contract, don't crash training scripts;
+            # NOTHING was mutated (the staging pass reads everything
+            # before the loop below touches runtime state)
+            logger.warning(f"load_checkpoint: unreadable/incomplete "
+                           f"checkpoint in {ckpt_dir}: {e!r}")
             return None, {}
         for mc in sorted(self._local):
             rt = self._local[mc]
-            layers, own_tied = staged[mc]
+            layers, own_tied, restored = staged[mc]
             rt.own = rt.place_replicated({"layers": layers,
                                           "tied": own_tied})
-            if load_optimizer_states:
-                cpath = self._chunk_optim_name(ckpt_dir, mc)
-                restored = None
-                if os.path.isfile(cpath):
-                    restored = self._mh_read(cpath)
-                else:  # single-host-written checkpoint: list layout
-                    if single_optim is None:
-                        opath = ckpt_io.optim_ckpt_name(ckpt_dir)
-                        if os.path.isfile(opath):
-                            so = self._mh_read(opath)
-                            if isinstance(so, dict) and \
-                                    so.get("__dstpu_ckpt_v2__"):
-                                # v2 wrapper: payload under "state",
-                                # sharded leaves in rank piece files
-                                pieces = ckpt_io._load_rank_pieces(
-                                    ckpt_dir, 0)
-                                so = so.get("state")
-                                if pieces:
-                                    so = ckpt_io._reassemble(so, pieces)
-                            single_optim = so or {}
-                    if single_optim and single_optim.get(
-                            "pipeline_parts") == list(module.parts):
-                        restored = single_optim["optimizer_state"][mc]
-                if restored is not None:
-                    if hasattr(self.optimizer, "deserialize_state"):
-                        restored = self.optimizer.deserialize_state(
-                            restored, rt.own)
-                    rt.opt_state = rt.place_replicated(
-                        jax.tree_util.tree_map(jnp.asarray, restored))
+            if restored is not None:
+                if hasattr(self.optimizer, "deserialize_state"):
+                    restored = self.optimizer.deserialize_state(
+                        restored, rt.own)
+                rt.opt_state = rt.place_replicated(
+                    jax.tree_util.tree_map(jnp.asarray, restored))
             rt.zero_acc()
         self._refresh_tied_copies_mh()
         return self._finish_pipe_load(model_state, ckpt_dir,

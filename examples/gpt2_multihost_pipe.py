@@ -142,7 +142,14 @@ def main():
          "--procs", str(args.procs), "--steps", str(args.steps),
          "--_worker", str(i), "--_coord", coord], env=env)
         for i in range(args.procs)]
-    rc = [p.wait() for p in procs]
+    try:
+        # a dead worker leaves the others blocked in collectives — bound
+        # the wait and kill the stragglers so the demo can't hang
+        rc = [p.wait(timeout=600) for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
     assert all(r == 0 for r in rc), rc
     print("all processes done")
 
