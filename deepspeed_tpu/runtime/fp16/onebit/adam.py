@@ -24,7 +24,8 @@ from jax import lax
 
 # the compress->reduce->recompress pipeline lives in runtime/comm
 # (shared with OnebitLamb and the standalone CompressedBackend)
-from ...comm.compressed import compressed_allreduce  # noqa: E402,F401
+from ...comm.compressed import (compressed_allreduce,  # noqa: E402,F401
+                                int8_compressed_allreduce)
 
 
 class OnebitAdam:
@@ -34,15 +35,21 @@ class OnebitAdam:
     def __init__(self, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
                  bias_correction=True, betas=(0.9, 0.999), eps=1e-8,
                  eps_inside_sqrt=False, weight_decay=0.0, max_grad_norm=0.0,
-                 amsgrad=False, cuda_aware=False):
+                 amsgrad=False, cuda_aware=False, wire="sign"):
         if amsgrad:
             raise RuntimeError("1-bit Adam does not support the AMSGrad variant.")
+        if wire not in ("sign", "int8"):
+            raise ValueError(f"wire must be 'sign' or 'int8', got {wire!r}")
         self.defaults = dict(lr=lr, betas=betas, eps=eps,
                              weight_decay=weight_decay,
                              bias_correction=bias_correction)
         self.param_groups = [dict(self.defaults)]
         self.freeze_step = int(freeze_step)
         self.eps_inside_sqrt = eps_inside_sqrt
+        # wire="int8": quantized all_to_all/allgather instead of sign
+        # compression — the variant whose wire bytes XLA actually shrinks
+        # (~4x vs fp32; sign rides pmean at full width — see BENCH.md)
+        self.wire = wire
 
     @property
     def lr(self):
@@ -77,8 +84,12 @@ class OnebitAdam:
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        def upd(p, grad, m, v, we, se):
-            grad = grad.astype(jnp.float32)
+        def moments(grad, m, v, we, se):
+            """FLAT (single fused buffer) moment update: the reference
+            NCCL backend also compresses one flattened momentum buffer,
+            which both matches its numerics (one scale over the whole
+            buffer) and pays each collective's latency once per step
+            instead of once per leaf."""
 
             def warm_branch(operands):
                 grad_, m_, v_, we_, se_ = operands
@@ -90,16 +101,20 @@ class OnebitAdam:
             def frozen_branch(operands):
                 grad_, m_, v_, we_, se_ = operands
                 m_local = beta1 * m_ + (1.0 - beta1) * grad_
-                m_comp, we_new, se_new = compressed_allreduce(m_local, we_, se_,
-                                                              comm_axis)
+                reduce_fn = (int8_compressed_allreduce
+                             if self.wire == "int8"
+                             else compressed_allreduce)
+                m_comp, we_new, se_new = reduce_fn(m_local, we_, se_,
+                                                   comm_axis)
                 return m_comp, v_, we_new, se_new
 
             # lax.cond so only ONE communication path executes per step —
             # after freeze the dense allreduce must not run, or 1-bit's
             # bandwidth saving is negated.
-            new_m, new_v, new_we, new_se = lax.cond(
+            return lax.cond(
                 frozen, frozen_branch, warm_branch, (grad, m, v, we, se))
 
+        def upd(p, new_m, new_v):
             p32 = p.astype(jnp.float32)
             # bias corrections apply during warmup only: after freeze the
             # reference uses the CONSTANT denominator exp_avg_sq.sqrt()+eps
@@ -115,7 +130,7 @@ class OnebitAdam:
             step_val = (new_m / bc1_eff) / denom
             if wd:
                 step_val = step_val + wd * p32
-            return (p32 - lr * step_val).astype(p.dtype), new_m, new_v, new_we, new_se
+            return (p32 - lr * step_val).astype(p.dtype)
 
         p_leaves, treedef = jax.tree_util.tree_flatten(params)
         gl = treedef.flatten_up_to(grads)
@@ -123,12 +138,27 @@ class OnebitAdam:
         vl = treedef.flatten_up_to(state["exp_avg_sq"])
         wel = treedef.flatten_up_to(state["worker_error"])
         sel = treedef.flatten_up_to(state["server_error"])
-        out = [upd(*t) for t in zip(p_leaves, gl, ml, vl, wel, sel)]
-        unflat = lambda i: jax.tree_util.tree_unflatten(treedef,
-                                                        [t[i] for t in out])
-        return unflat(0), {"step": step, "exp_avg": unflat(1),
-                           "exp_avg_sq": unflat(2), "worker_error": unflat(3),
-                           "server_error": unflat(4)}
+
+        flat = lambda ls: jnp.concatenate(
+            [l.astype(jnp.float32).ravel() for l in ls])
+        fm, fv, fwe, fse = (flat(ml), flat(vl), flat(wel), flat(sel))
+        new_fm, new_fv, new_fwe, new_fse = moments(flat(gl), fm, fv,
+                                                   fwe, fse)
+
+        def split(fvec):
+            out, off = [], 0
+            for p in p_leaves:
+                out.append(fvec[off:off + p.size].reshape(p.shape))
+                off += p.size
+            return out
+
+        nm, nv = split(new_fm), split(new_fv)
+        new_p = [upd(p, m_, v_) for p, m_, v_ in zip(p_leaves, nm, nv)]
+        unflat = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
+        return unflat(new_p), {"step": step, "exp_avg": unflat(nm),
+                               "exp_avg_sq": unflat(nv),
+                               "worker_error": unflat(split(new_fwe)),
+                               "server_error": unflat(split(new_fse))}
 
     def state_dict(self):
         return {"param_groups": self.param_groups,
