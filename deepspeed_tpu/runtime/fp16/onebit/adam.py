@@ -84,35 +84,38 @@ class OnebitAdam:
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
 
-        def moments(grad, m, v, we, se):
-            """FLAT (single fused buffer) moment update: the reference
-            NCCL backend also compresses one flattened momentum buffer,
-            which both matches its numerics (one scale over the whole
-            buffer) and pays each collective's latency once per step
-            instead of once per leaf."""
+        def moments(grad, m, we, se):
+            """FLAT (single fused buffer) momentum update: the reference
+            NCCL backend also compresses one flattened momentum buffer
+            (grouped per-2048 scales), paying each collective's latency
+            once per step instead of once per leaf. Only the COMMUNICATED
+            buffers (m, grad, errors) flatten; v stays per-leaf outside
+            the cond (it is untouched after freeze). Returns
+            (m_new, g_reduced, we_new, se_new) — g_reduced is the dense
+            mean during warmup (feeds the per-leaf v update) and zeros
+            after freeze (v frozen)."""
 
             def warm_branch(operands):
-                grad_, m_, v_, we_, se_ = operands
+                grad_, m_, we_, se_ = operands
                 g_ = lax.pmean(grad_, comm_axis) if comm_axis is not None else grad_
                 m_warm = beta1 * m_ + (1.0 - beta1) * g_
-                v_warm = beta2 * v_ + (1.0 - beta2) * g_ * g_
-                return m_warm, v_warm, we_, se_
+                return m_warm, g_, we_, se_
 
             def frozen_branch(operands):
-                grad_, m_, v_, we_, se_ = operands
+                grad_, m_, we_, se_ = operands
                 m_local = beta1 * m_ + (1.0 - beta1) * grad_
                 reduce_fn = (int8_compressed_allreduce
                              if self.wire == "int8"
                              else compressed_allreduce)
                 m_comp, we_new, se_new = reduce_fn(m_local, we_, se_,
                                                    comm_axis)
-                return m_comp, v_, we_new, se_new
+                return m_comp, jnp.zeros_like(grad_), we_new, se_new
 
             # lax.cond so only ONE communication path executes per step —
             # after freeze the dense allreduce must not run, or 1-bit's
             # bandwidth saving is negated.
             return lax.cond(
-                frozen, frozen_branch, warm_branch, (grad, m, v, we, se))
+                frozen, frozen_branch, warm_branch, (grad, m, we, se))
 
         def upd(p, new_m, new_v):
             p32 = p.astype(jnp.float32)
@@ -141,9 +144,8 @@ class OnebitAdam:
 
         flat = lambda ls: jnp.concatenate(
             [l.astype(jnp.float32).ravel() for l in ls])
-        fm, fv, fwe, fse = (flat(ml), flat(vl), flat(wel), flat(sel))
-        new_fm, new_fv, new_fwe, new_fse = moments(flat(gl), fm, fv,
-                                                   fwe, fse)
+        new_fm, fgred, new_fwe, new_fse = moments(
+            flat(gl), flat(ml), flat(wel), flat(sel))
 
         def split(fvec):
             out, off = [], 0
@@ -152,7 +154,11 @@ class OnebitAdam:
                 off += p.size
             return out
 
-        nm, nv = split(new_fm), split(new_fv)
+        nm, gred = split(new_fm), split(fgred)
+        # v per leaf, outside the cond: frozen -> unchanged (gred is 0
+        # there, but where() keeps the exact old buffer)
+        nv = [jnp.where(frozen, v_, beta2 * v_ + (1.0 - beta2) * g_ * g_)
+              for v_, g_ in zip(vl, gred)]
         new_p = [upd(p, m_, v_) for p, m_, v_ in zip(p_leaves, nm, nv)]
         unflat = lambda ls: jax.tree_util.tree_unflatten(treedef, ls)
         return unflat(new_p), {"step": step, "exp_avg": unflat(nm),

@@ -47,6 +47,10 @@ def main():
     ap.add_argument("--micro", type=int, default=4)
     ap.add_argument("--steps", type=int, default=60)
     ap.add_argument("--dense", action="store_true")
+    ap.add_argument("--wire", default="sign", choices=("sign", "int8"),
+                    help="compressed wire format: reference-parity sign "
+                         "compression, or int8 (the one that actually "
+                         "cuts XLA wire bytes ~2x)")
     args = ap.parse_args()
 
     n_dev = jax.device_count()
@@ -65,7 +69,8 @@ def main():
     else:
         config["optimizer"] = {"type": "OneBitAdam",
                                "params": {"lr": 3e-3, "freeze_step": 45,
-                                          "weight_decay": 0.0}}
+                                          "weight_decay": 0.0,
+                                          "wire": args.wire}}
         config["zero_optimization"] = {"stage": 0}
 
     engine, _, _, _ = deepspeed_tpu.initialize(model=Bert(cfg),
@@ -80,7 +85,7 @@ def main():
         engine.backward()
         engine.step()
         losses.append(float(loss))
-    mode = "zero2-dense" if args.dense else "1bit-adam"
+    mode = "zero2-dense" if args.dense else f"1bit-adam/{args.wire}"
     print_curve(f"{args.size} mlm {mode}", losses)
     assert min(losses[-10:]) < losses[0], losses
 
