@@ -254,14 +254,20 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
             block_q=cfg.flash_block_q or None,
             block_k=cfg.flash_block_k or None)
     elif cfg.sequence_parallel:
-        if cfg.sequence_parallel_impl != "ring":
+        if cfg.sequence_parallel_impl not in ("ring", "ring_zigzag"):
             raise ValueError(
                 f"unknown sequence_parallel_impl "
-                f"{cfg.sequence_parallel_impl!r}; use 'ring' or 'ulysses'")
+                f"{cfg.sequence_parallel_impl!r}; use 'ring', "
+                f"'ring_zigzag' or 'ulysses'")
         from ..parallel.ring_attention import ring_attention
 
-        attn = ring_attention(split_heads(q), split_heads(kk),
-                              split_heads(v), causal=True)
+        # ring_zigzag: the trunk permuted the sequence into the zigzag
+        # layout once after the embedding, so every block's attention
+        # runs the load-balanced causal ring (~2x fewer FLOPs)
+        attn = ring_attention(
+            split_heads(q), split_heads(kk), split_heads(v), causal=True,
+            layout=("zigzag" if cfg.sequence_parallel_impl == "ring_zigzag"
+                    else "contiguous"))
     else:
         attn = multihead_attention(split_heads(q), split_heads(kk),
                                    split_heads(v), causal=True,
@@ -443,6 +449,28 @@ class GPT(TrainModule):
             x = _dropout(x, cfg.embed_dropout, sub, train)
         x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
 
+        zig_inv = None
+        if cfg.sequence_parallel and \
+                cfg.sequence_parallel_impl == "ring_zigzag":
+            # ONE layout change for the whole trunk (a static-index
+            # gather XLA lowers to a single resharding collective), so
+            # every block's ring attention runs mask-free load-balanced;
+            # inverted before ln_f — the model's external contract stays
+            # contiguous
+            if cfg.pipeline_stages > 1:
+                raise NotImplementedError(
+                    "ring_zigzag + SPMD pipeline is not wired up")
+            from ..comm.mesh import SEQ_AXIS as _SA
+            from ..comm.mesh import get_current_mesh
+            from ..parallel.ring_attention import zigzag_order
+
+            n_seq = get_current_mesh().axis_size(_SA)
+            if n_seq > 1:
+                perm, inv = zigzag_order(S, n_seq)
+                zig_inv = jnp.asarray(inv)
+                x = _constrain(x[:, jnp.asarray(perm)], cfg,
+                               P(DATA_AXIS, SEQ_AXIS, None))
+
         if cfg.pipeline_stages > 1:
             if capture_layers:
                 raise NotImplementedError(
@@ -476,8 +504,11 @@ class GPT(TrainModule):
                 x = out
                 if capture_layers is not None and \
                         (capture_layers == "all" or i in capture_layers):
-                    captures[i] = x
+                    # captured in contiguous order even under zigzag
+                    captures[i] = x if zig_inv is None else x[:, zig_inv]
 
+        if zig_inv is not None:
+            x = _constrain(x[:, zig_inv], cfg, P(DATA_AXIS, SEQ_AXIS, None))
         return (layer_norm(x, params["ln_f"], cfg.layer_norm_eps), aux_total,
                 captures)
 

@@ -149,3 +149,27 @@ def test_zigzag_rejects_odd_shard():
     info = comm.make_mesh(data=1, seq=4, devices=jax.devices()[:4])
     with pytest.raises(ValueError, match="divisible by 2n"):
         ring_attention(q, k, v, info, causal=True, layout="zigzag")
+
+
+def test_gpt_ring_zigzag_matches_ring():
+    """sequence_parallel_impl="ring_zigzag" is a drop-in config flag: the
+    trunk permutes once after the embedding and inverts before ln_f, so
+    logits match the contiguous ring implementation exactly."""
+    cfg_kw = dict(vocab_size=128, max_seq_len=64, dropout=0.0,
+                  embed_dropout=0.0, sequence_parallel=True,
+                  shard_activations=True)
+    tok = np.asarray(jax.random.randint(jax.random.PRNGKey(8),
+                                        (2, 64), 0, 128))
+    info = comm.make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    outs = {}
+    for impl in ("ring", "ring_zigzag"):
+        model = GPT(gpt2_config("nano", sequence_parallel_impl=impl,
+                                **cfg_kw))
+        params = model.init(jax.random.PRNGKey(0))
+        with info.mesh:
+            outs[impl] = np.asarray(
+                jax.jit(lambda p, t: model.apply(p, t))(params,
+                                                        jnp.asarray(tok)),
+                np.float32)
+    np.testing.assert_allclose(outs["ring_zigzag"], outs["ring"],
+                               atol=3e-5, rtol=3e-5)
