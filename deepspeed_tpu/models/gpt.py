@@ -59,7 +59,7 @@ class GPTConfig:
     pipeline_stages: int = 1         # >1: stack blocks + pipeline over `pipe`
     pipeline_micro_batches: int = 0  # 0 -> default (= pipe size)
     sequence_parallel: bool = False  # SP attention over the `seq` axis
-    sequence_parallel_impl: str = "ring"  # ring | ulysses (all-to-all)
+    sequence_parallel_impl: str = "ring"  # ring | ring_zigzag | ulysses
     # Mixture-of-Experts (beyond-parity; reference has no MoE, SURVEY §2.2)
     num_experts: int = 1             # >1: MoE FFN every moe_layer_freq layers
     moe_top_k: int = 1
@@ -582,8 +582,14 @@ class GPT(TrainModule):
 
     def stream_supported(self) -> bool:
         cfg = self.config
+        # ring_zigzag needs the trunk's one-shot layout permutation,
+        # which the streamed per-block walk doesn't perform — streaming
+        # it would run zigzag attention on contiguous tokens
+        zigzag = (cfg.sequence_parallel
+                  and cfg.sequence_parallel_impl == "ring_zigzag")
         return (cfg.num_experts == 1 and cfg.pipeline_stages == 1
-                and cfg.dropout == 0.0 and cfg.embed_dropout == 0.0)
+                and cfg.dropout == 0.0 and cfg.embed_dropout == 0.0
+                and not zigzag)
 
     def stream_init(self, rng):
         """Yield (group_name, host_numpy_subtree) with only ONE group ever
