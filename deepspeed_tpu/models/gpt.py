@@ -267,7 +267,10 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         attn = ring_attention(
             split_heads(q), split_heads(kk), split_heads(v), causal=True,
             layout=("zigzag" if cfg.sequence_parallel_impl == "ring_zigzag"
-                    else "contiguous"))
+                    else "contiguous"),
+            # same config knob as the flash kernel: bounds per-step score
+            # memory at [B, H, block_q, chunk]
+            block_q=cfg.flash_block_q)
     else:
         attn = multihead_attention(split_heads(q), split_heads(kk),
                                    split_heads(v), causal=True,

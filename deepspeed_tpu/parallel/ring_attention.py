@@ -49,7 +49,45 @@ def _softmax_block(qf, kc, vc, acc, m, l, mask=None):
     return acc, m_new, l
 
 
-def _ring_body(q, k, v, n, causal, scale):
+def _softmax_block_tiled(qf, kc, vc, acc, m, l, mask=None, block_q=0):
+    """_softmax_block with optional sequential Q-tiling: peak score
+    memory drops from [B, H, Sq, Sk] to [B, H, block_q, Sk] — the knob
+    that keeps VERY long local chunks (ring attention's whole point)
+    from materializing a quadratic block. block_q=0 or non-divisible
+    sizes fall back to one tile."""
+    Sq = qf.shape[1]
+    if not block_q or Sq <= block_q or Sq % block_q:
+        return _softmax_block(qf, kc, vc, acc, m, l, mask)
+    nq = Sq // block_q
+    B, _, H, D = qf.shape
+    qt = qf.reshape(B, nq, block_q, H, D).transpose(1, 0, 2, 3, 4)
+    at = acc.reshape(B, H, nq, block_q, D).transpose(2, 0, 1, 3, 4)
+    mt = m.reshape(B, H, nq, block_q).transpose(2, 0, 1, 3)
+    lt = l.reshape(B, H, nq, block_q).transpose(2, 0, 1, 3)
+
+    if mask is not None:
+        Sk = kc.shape[1]
+        mk = jnp.broadcast_to(mask, (1, 1, Sq, Sk)).reshape(
+            1, 1, nq, block_q, Sk).transpose(2, 0, 1, 3, 4)
+
+        def body(_, xs):
+            q_, a_, m_, l_, k_ = xs
+            return _, _softmax_block(q_, kc, vc, a_, m_, l_, k_)
+
+        _, (a2, m2, l2) = jax.lax.scan(body, None, (qt, at, mt, lt, mk))
+    else:
+        def body(_, xs):
+            q_, a_, m_, l_ = xs
+            return _, _softmax_block(q_, kc, vc, a_, m_, l_, None)
+
+        _, (a2, m2, l2) = jax.lax.scan(body, None, (qt, at, mt, lt))
+    acc = a2.transpose(1, 2, 0, 3, 4).reshape(B, H, Sq, D)
+    m = m2.transpose(1, 2, 0, 3).reshape(B, H, Sq)
+    l = l2.transpose(1, 2, 0, 3).reshape(B, H, Sq)
+    return acc, m, l
+
+
+def _ring_body(q, k, v, n, causal, scale, block_q=0):
     """Per-device ring loop. q/k/v: local [B, Sc, H, D] chunks."""
     idx = jax.lax.axis_index(SEQ_AXIS)
     B, Sc, H, D = q.shape
@@ -68,7 +106,8 @@ def _ring_body(q, k, v, n, causal, scale):
             mask = (qpos >= kpos)[None, None]
         else:
             mask = None
-        acc, m, l = _softmax_block(qf, kc, vc, acc, m, l, mask=mask)
+        acc, m, l = _softmax_block_tiled(qf, kc, vc, acc, m, l,
+                                         mask=mask, block_q=block_q)
         kc = jax.lax.ppermute(kc, SEQ_AXIS, perm)
         vc = jax.lax.ppermute(vc, SEQ_AXIS, perm)
         return (acc, m, l, kc, vc), None
@@ -103,7 +142,7 @@ def zigzag_order(S: int, n: int):
     return perm, inv
 
 
-def _zigzag_body(q, k, v, n, scale):
+def _zigzag_body(q, k, v, n, scale, block_q=0):
     """Load-balanced CAUSAL ring: local chunks are the zigzag pair
     (lo = chunk idx, hi = chunk 2n-1-idx), each [B, c, H, D]. After the
     self-pair step, every ring step is exactly TWO dense unmasked
@@ -118,7 +157,9 @@ def _zigzag_body(q, k, v, n, scale):
     qlo, qhi = qf[:, :c], qf[:, c:]
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    block = _softmax_block
+    from functools import partial
+
+    block = partial(_softmax_block_tiled, block_q=block_q)
     vary = lambda x: jax.lax.pcast(x, (SEQ_AXIS,), to="varying")
     zero = lambda: (vary(jnp.zeros((B, H, c, D), jnp.float32)),
                     vary(jnp.full((B, H, c), NEG_INF, jnp.float32)),
@@ -175,7 +216,7 @@ def _zigzag_body(q, k, v, n, scale):
 
 def ring_attention(q, k, v, mesh_info: Optional[MeshInfo] = None,
                    causal: bool = True, scale: Optional[float] = None,
-                   layout: str = "contiguous"):
+                   layout: str = "contiguous", block_q: int = 0):
     """Sequence-parallel attention. [B, S, H, D] with S sharded over `seq`.
 
     layout="zigzag" (causal only): tokens are pre-permuted by
@@ -202,6 +243,8 @@ def ring_attention(q, k, v, mesh_info: Optional[MeshInfo] = None,
         from ..ops.transformer.attention import multihead_attention
 
         return multihead_attention(q, k, v, causal=causal, scale=scale)
+    if block_q < 0:
+        raise ValueError(f"block_q must be >= 0, got {block_q}")
     if layout == "zigzag":
         if q.shape[1] % (2 * n):
             # an odd per-device shard would silently broadcast mismatched
@@ -209,9 +252,22 @@ def ring_attention(q, k, v, mesh_info: Optional[MeshInfo] = None,
             raise ValueError(
                 f"zigzag needs seq len divisible by 2n={2 * n}, got "
                 f"{q.shape[1]} (use zigzag_order to lay out tokens)")
-        body = lambda q, k, v: _zigzag_body(q, k, v, n, scale)
+        chunk = q.shape[1] // (2 * n)
     else:
-        body = lambda q, k, v: _ring_body(q, k, v, n, causal, scale)
+        chunk = q.shape[1] // n
+    if block_q and chunk > block_q and chunk % block_q:
+        # silently falling back would materialize the full quadratic
+        # block — the OOM this knob exists to prevent (flash_attention
+        # raises for the same reason)
+        raise ValueError(
+            f"block_q={block_q} must divide the per-device chunk "
+            f"({chunk} for layout={layout!r} on a {n}-way seq axis)")
+    if layout == "zigzag":
+        body = lambda q, k, v: _zigzag_body(q, k, v, n, scale,
+                                            block_q=block_q)
+    else:
+        body = lambda q, k, v: _ring_body(q, k, v, n, causal, scale,
+                                          block_q=block_q)
 
     spec = P(None, SEQ_AXIS, None, None)
     fn = jax.shard_map(

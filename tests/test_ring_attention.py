@@ -173,3 +173,53 @@ def test_gpt_ring_zigzag_matches_ring():
                 np.float32)
     np.testing.assert_allclose(outs["ring_zigzag"], outs["ring"],
                                atol=3e-5, rtol=3e-5)
+
+
+@pytest.mark.parametrize("layout", ["contiguous", "zigzag"])
+def test_block_q_tiling_matches_untiled(layout):
+    """Q-tiled ring blocks (bounded score memory) are numerically
+    identical to the untiled path for both layouts."""
+    from deepspeed_tpu.parallel.ring_attention import zigzag_order
+
+    S = 64
+    q, k, v = _qkv(jax.random.PRNGKey(9), S=S)
+    info = comm.make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    if layout == "zigzag":
+        perm, inv = zigzag_order(S, 4)
+        q, k, v = q[:, perm], k[:, perm], v[:, perm]
+    with info.mesh:
+        f = lambda bq: jax.jit(lambda a, b, c: ring_attention(
+            a, b, c, info, causal=True, layout=layout, block_q=bq))(q, k, v)
+        ref = f(0)
+        tiled = f(4)
+    np.testing.assert_allclose(np.asarray(tiled), np.asarray(ref),
+                               atol=2e-6, rtol=2e-6)
+
+
+def test_block_q_validation():
+    q, k, v = _qkv(jax.random.PRNGKey(10), S=64)
+    info = comm.make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    with pytest.raises(ValueError, match="block_q"):
+        ring_attention(q, k, v, info, causal=True, block_q=-4)
+    with pytest.raises(ValueError, match="must divide"):
+        ring_attention(q, k, v, info, causal=True, block_q=6)  # 16 % 6
+
+
+def test_gpt_ring_block_q_through_config():
+    """flash_block_q bounds ring-attention score memory from GPTConfig."""
+    cfg_kw = dict(vocab_size=128, max_seq_len=64, dropout=0.0,
+                  embed_dropout=0.0, sequence_parallel=True,
+                  shard_activations=True)
+    tok = np.asarray(jax.random.randint(jax.random.PRNGKey(11),
+                                        (2, 64), 0, 128))
+    info = comm.make_mesh(data=1, seq=4, devices=jax.devices()[:4])
+    outs = {}
+    for bq in (0, 4):
+        model = GPT(gpt2_config("nano", sequence_parallel_impl="ring_zigzag",
+                                flash_block_q=bq, **cfg_kw))
+        params = model.init(jax.random.PRNGKey(0))
+        with info.mesh:
+            outs[bq] = np.asarray(jax.jit(
+                lambda p, t: model.apply(p, t))(params, jnp.asarray(tok)),
+                np.float32)
+    np.testing.assert_allclose(outs[4], outs[0], atol=2e-6, rtol=2e-6)
