@@ -329,6 +329,7 @@ def test_int8_compressed_allreduce_matches_dense_mean():
     assert total_dev.max() < 4 * step, total_dev.max()
 
 
+@pytest.mark.slow
 def test_int8_wire_onebit_adam_converges_through_engine():
     """OneBitAdam wire="int8" trains through the engine hot path."""
     import deepspeed_tpu

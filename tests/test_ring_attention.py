@@ -205,6 +205,7 @@ def test_block_q_validation():
         ring_attention(q, k, v, info, causal=True, block_q=6)  # 16 % 6
 
 
+@pytest.mark.slow
 def test_gpt_ring_block_q_through_config():
     """flash_block_q bounds ring-attention score memory from GPTConfig."""
     cfg_kw = dict(vocab_size=128, max_seq_len=64, dropout=0.0,
