@@ -57,21 +57,29 @@ def compressed_allreduce(x, worker_error, server_error, axis: Optional[str]):
 INT8_GROUP = 2048  # elements per quantization scale (reference chunking)
 
 
-def _quant_grouped(t):
-    """t: [..., k] with k % INT8_GROUP == 0 -> (int8 same shape,
-    fp32 scales [..., k/INT8_GROUP]). Per-group scales keep small-
-    magnitude regions (layernorm/bias momentum) from quantizing to zero
-    under a layer with 1000x larger values — the reference's per-chunk
-    scale behavior (comm/nccl.py), at ~4 bytes per 2048 wire bytes."""
-    g = t.reshape(*t.shape[:-1], -1, INT8_GROUP)
+def _quant_grouped(t, group=INT8_GROUP):
+    """t: [..., k] with k % group == 0 -> (int8 same shape, fp32 scales
+    [..., k/group]). Per-group scales keep small-magnitude regions
+    (layernorm/bias momentum) from quantizing to zero under a layer with
+    1000x larger values — the reference's per-chunk scale behavior
+    (comm/nccl.py), at ~4 bytes per `group` wire bytes."""
+    g = t.reshape(*t.shape[:-1], -1, group)
     scale = jnp.max(jnp.abs(g), axis=-1) / 127.0 + 1e-12
     q = jnp.clip(jnp.round(g / scale[..., None]), -127, 127)
     return q.astype(jnp.int8).reshape(t.shape), scale
 
 
-def _dequant_grouped(q, scale):
-    g = q.astype(jnp.float32).reshape(*q.shape[:-1], -1, INT8_GROUP)
+def _dequant_grouped(q, scale, group=INT8_GROUP):
+    g = q.astype(jnp.float32).reshape(*q.shape[:-1], -1, group)
     return (g * scale[..., None]).reshape(q.shape)
+
+
+def _group_for(n: int, W: int) -> int:
+    """Quantization group sized to the tensor: full INT8_GROUP for large
+    buffers, shrunk for small ones so a 16-element bias doesn't pad to
+    W * 2048 (a ~1000x wire blowup for per-leaf callers)."""
+    k0 = -(-n // W)  # ceil(n / W): per-worker chunk before rounding
+    return max(1, min(INT8_GROUP, k0))
 
 
 def int8_compressed_allreduce(x, worker_error, server_error, axis):
@@ -92,23 +100,25 @@ def int8_compressed_allreduce(x, worker_error, server_error, axis):
     the single-shard no-comm case). Returns (mean, new_we, new_se)."""
     if axis is None:
         n = x.size
-        pad = (-n) % INT8_GROUP
+        G = _group_for(n, 1)
+        pad = (-n) % G
         c = jnp.pad((x + worker_error).ravel(), (0, pad))
-        q, sw = _quant_grouped(c)
-        deq = _dequant_grouped(q, sw)
+        q, sw = _quant_grouped(c, G)
+        deq = _dequant_grouped(q, sw, G)
         new_we = (c - deq)[:n].reshape(x.shape)
         s = deq + jnp.pad(server_error.ravel(), (0, pad))
-        q2, ss = _quant_grouped(s)
-        out = _dequant_grouped(q2, ss)
+        q2, ss = _quant_grouped(s, G)
+        out = _dequant_grouped(q2, ss, G)
         return (out[:n].reshape(x.shape), new_we,
                 (s - out)[:n].reshape(server_error.shape))
 
     W = lax.psum(1, axis)
     n = x.size
-    pad = (-n) % (W * INT8_GROUP)  # rows must split into whole groups
+    G = _group_for(n, W)
+    pad = (-n) % (W * G)  # rows must split into whole groups
     c = jnp.pad((x + worker_error).ravel(), (0, pad)).reshape(W, -1)
-    q, sw = _quant_grouped(c)            # q [W, k] int8, sw [W, k/G]
-    new_we = ((c - _dequant_grouped(q, sw)).ravel()[:n]
+    q, sw = _quant_grouped(c, G)         # q [W, k] int8, sw [W, k/G]
+    new_we = ((c - _dequant_grouped(q, sw, G)).ravel()[:n]
               .reshape(x.shape))
     # phase 1 (wire: int8 + fp32/2048 scales): worker j receives chunk
     # ROW j from everyone
@@ -116,7 +126,7 @@ def int8_compressed_allreduce(x, worker_error, server_error, axis):
                           tiled=False)                 # [W, k] int8
     rscale = lax.all_to_all(sw, axis, split_axis=0, concat_axis=0,
                             tiled=False)               # [W, k/G]
-    avg = jnp.sum(_dequant_grouped(recv, rscale), axis=0) / W  # [k]
+    avg = jnp.sum(_dequant_grouped(recv, rscale, G), axis=0) / W
 
     # server stage: per-owner error feedback on the owned chunk (the
     # state keeps the full-shape buffer for a static pytree; only the
@@ -126,15 +136,15 @@ def int8_compressed_allreduce(x, worker_error, server_error, axis):
     se_full = jnp.pad(server_error.ravel(), (0, pad)).reshape(W, -1)
     se_chunk = lax.dynamic_index_in_dim(se_full, idx, 0, keepdims=False)
     s = avg + se_chunk
-    q2, ss = _quant_grouped(s)
-    se_new_chunk = s - _dequant_grouped(q2, ss)
+    q2, ss = _quant_grouped(s, G)
+    se_new_chunk = s - _dequant_grouped(q2, ss, G)
     new_se = jnp.zeros_like(se_full).at[idx].set(se_new_chunk)
     new_se = new_se.ravel()[:n].reshape(server_error.shape)
 
     # phase 2 (wire: int8 + fp32/2048 scales per owner)
     allq = lax.all_gather(q2, axis)    # [W, k] int8
     allsc = lax.all_gather(ss, axis)   # [W, k/G]
-    out = _dequant_grouped(allq, allsc).ravel()[:n]
+    out = _dequant_grouped(allq, allsc, G).ravel()[:n]
     return out.reshape(x.shape), new_we, new_se
 
 

@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...comm.compressed import compressed_allreduce
+from ...comm.compressed import (compressed_allreduce,
+                                int8_compressed_allreduce)
 
 
 class OnebitLamb:
@@ -38,9 +39,15 @@ class OnebitLamb:
                  weight_decay=0.0, max_grad_norm=0.0, max_coeff=10.0,
                  min_coeff=0.01, amsgrad=False, cuda_aware=False,
                  comm_backend_name="xla", coeff_beta=0.9, factor_max=4.0,
-                 factor_min=0.5, factor_threshold=0.1):
+                 factor_min=0.5, factor_threshold=0.1, wire="sign"):
         if amsgrad:
             raise RuntimeError("1-bit Lamb does not support AMSGrad")
+        if wire not in ("sign", "int8"):
+            raise ValueError(f"wire must be 'sign' or 'int8', got {wire!r}")
+        # wire="int8": quantized all_to_all/allgather — the format whose
+        # wire bytes XLA actually shrinks (see onebit/adam.py). Lamb's
+        # reduction stays per-leaf (trust ratios are per-leaf anyway).
+        self.wire = wire
         self.defaults = dict(lr=lr, betas=betas, eps=eps,
                              weight_decay=weight_decay,
                              bias_correction=bias_correction,
@@ -88,6 +95,13 @@ class OnebitLamb:
         else:
             bc1 = bc2 = jnp.asarray(1.0, jnp.float32)
         frozen = step > self.freeze_step
+        # wire dispatch resolved once per update, not per leaf. NOTE:
+        # lamb keeps a per-leaf reduction (one collective per leaf);
+        # adam's flatten-reduce-split fusion is the performant wire shape
+        # — proportionate quantization groups (_group_for) keep small
+        # leaves from padding to W*2048 here
+        reduce_fn = (int8_compressed_allreduce if self.wire == "int8"
+                     else compressed_allreduce)
 
         def denom_of(v):
             if self.eps_inside_sqrt:
@@ -128,8 +142,7 @@ class OnebitLamb:
             def compressed(ops):
                 grad_, m_, v_, v_fresh_, we_, se_, coeff_, lf_ = ops
                 m_local = beta1 * m_ + (1.0 - beta1) * grad_
-                m_n, we_n, se_n = compressed_allreduce(
-                    m_local, we_, se_, comm_axis)
+                m_n, we_n, se_n = reduce_fn(m_local, we_, se_, comm_axis)
                 # rebuild a fresh second-moment estimate from the
                 # decompressed momentum delta (reference exp_avg_sq_fresh)
                 g_est = (m_n - beta1 * m_) / (1.0 - beta1)

@@ -358,3 +358,21 @@ def test_int8_wire_onebit_adam_converges_through_engine():
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < 0.5 * losses[0], (losses[0], losses[-1])
+
+
+def test_onebit_lamb_int8_wire_frozen_step():
+    """OnebitLamb(wire="int8") runs the quantized reduction on the
+    compressed path (single-shard axis=None here) and keeps training
+    finite with error feedback accumulating."""
+    from deepspeed_tpu.runtime.fp16.onebit import OnebitLamb
+
+    params = {"w": jnp.asarray(np.linspace(-1, 1, 16), dtype=jnp.float32)}
+    grads = {"w": jnp.asarray(np.linspace(1, -1, 16), dtype=jnp.float32)}
+    ol = OnebitLamb(lr=1e-3, freeze_step=1, wire="int8")
+    state = ol.init(params)
+    params, state = ol.update(grads, state, params)   # warmup
+    params, state = ol.update(grads, state, params)   # compressed int8
+    assert np.isfinite(np.asarray(params["w"])).all()
+    assert not np.allclose(np.asarray(state["worker_error"]["w"]), 0)
+    with pytest.raises(ValueError, match="wire"):
+        OnebitLamb(wire="fp4")
