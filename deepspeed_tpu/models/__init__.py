@@ -5,9 +5,11 @@ from .bert import Bert, BertConfig, bert_config, BERT_SIZES
 from .gpt import GPT, GPTConfig, gpt2_config, GPT2_SIZES
 from .gpt_pipe import gpt_pipeline_module
 from .generation import generate
-from .hf import gpt2_config_from_hf, load_hf_gpt2
+from .hf import (bert_config_from_hf, gpt2_config_from_hf,
+                 load_hf_bert, load_hf_gpt2)
 
 __all__ = ["GPT", "GPTConfig", "gpt2_config", "GPT2_SIZES",
            "gpt_pipeline_module",
            "Bert", "BertConfig", "bert_config", "BERT_SIZES",
-           "load_hf_gpt2", "gpt2_config_from_hf", "generate"]
+           "load_hf_gpt2", "gpt2_config_from_hf",
+           "load_hf_bert", "bert_config_from_hf", "generate"]

@@ -59,6 +59,15 @@ def gpt2_config_from_hf(hf_config, **overrides) -> GPTConfig:
     return GPTConfig(**base)
 
 
+def _torch_sd_to_numpy(hf_model):
+    """state_dict -> float32 numpy. float() first: torch .numpy() rejects
+    bfloat16, and values are re-cast to cfg.param_dtype by the loaders."""
+    import torch
+
+    return {k: np.asarray(v.detach().to(torch.float32).cpu().numpy())
+            for k, v in hf_model.state_dict().items()}
+
+
 def load_hf_gpt2(hf_model, **config_overrides):
     """(GPT, params) from a transformers GPT2LMHeadModel.
 
@@ -69,12 +78,7 @@ def load_hf_gpt2(hf_model, **config_overrides):
         engine, *_ = deepspeed_tpu.initialize(model=model,
                                               model_parameters=params, ...)
     """
-    import torch
-
-    # float() first: torch .numpy() rejects bfloat16, and the values are
-    # re-cast to cfg.param_dtype below anyway
-    sd = {k: np.asarray(v.detach().to(torch.float32).cpu().numpy())
-          for k, v in hf_model.state_dict().items()}
+    sd = _torch_sd_to_numpy(hf_model)
     cfg = gpt2_config_from_hf(hf_model.config, **config_overrides)
     model = GPT(cfg)
     params = hf_gpt2_state_dict_to_params(sd, cfg)
@@ -117,3 +121,113 @@ def hf_gpt2_state_dict_to_params(sd: Dict[str, Any],
     if not cfg.tie_embeddings:
         params["lm_head"] = g("lm_head.weight").T
     return params
+
+
+def bert_config_from_hf(hf_config, **overrides):
+    """Map a transformers BertConfig onto BertConfig (post-LN BERT).
+
+    hidden_act="gelu" (erf) is accepted with a warning: the encoder
+    computes tanh-approximate gelu — the SAME substitution the
+    reference's kernel injection makes when swapping HF layers for
+    DeepSpeedTransformerLayer (module_inject), shifting logits ~1e-3.
+    "gelu_new" matches exactly. Anything else is refused."""
+    from .bert import BertConfig
+
+    act = getattr(hf_config, "hidden_act", "gelu")
+    if act == "gelu":
+        from ..utils.logging import logger
+
+        logger.warning(
+            "HF hidden_act='gelu' (erf): encoder computes tanh-approx "
+            "gelu — logits shift ~1e-3, the same substitution the "
+            "reference kernel injection makes")
+    elif act != "gelu_new":
+        raise ValueError(f"hidden_act={act!r} unsupported (gelu/gelu_new)")
+    if getattr(hf_config, "position_embedding_type",
+               "absolute") != "absolute":
+        raise ValueError("only absolute position embeddings supported")
+    base = dict(
+        vocab_size=hf_config.vocab_size,
+        max_seq_len=hf_config.max_position_embeddings,
+        num_layers=hf_config.num_hidden_layers,
+        num_heads=hf_config.num_attention_heads,
+        d_model=hf_config.hidden_size,
+        d_ff=hf_config.intermediate_size,
+        type_vocab_size=hf_config.type_vocab_size,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        attn_dropout=hf_config.attention_probs_dropout_prob,
+        hidden_dropout=hf_config.hidden_dropout_prob,
+        pre_layer_norm=False,  # stock HF BERT is post-LN
+    )
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+def load_hf_bert(hf_model, **config_overrides):
+    """(Bert, params) from a transformers BertForPreTraining.
+
+    The second cross-framework oracle (alongside load_hf_gpt2): the
+    whole encoder + MLM/NSP heads import with logit parity, and every
+    engine feature then applies to the imported model."""
+    from .bert import Bert
+
+    if not getattr(hf_model.config, "tie_word_embeddings", True):
+        # Bert.apply computes MLM logits from embeddings.word.T — an
+        # independent decoder matrix cannot be represented; refuse
+        # rather than import silently wrong predictions
+        raise ValueError(
+            "untied MLM decoder (tie_word_embeddings=False) unsupported: "
+            "the Bert family ties the decoder to the word embeddings")
+    sd = _torch_sd_to_numpy(hf_model)
+    cfg = bert_config_from_hf(hf_model.config, **config_overrides)
+    model = Bert(cfg)
+    g = lambda k: jnp.asarray(sd[k], cfg.param_dtype)
+    gT = lambda k: jnp.asarray(sd[k].T, cfg.param_dtype)  # torch [out,in]
+
+    def layer(i):
+        p = f"bert.encoder.layer.{i}."
+        qkv_w = np.concatenate([sd[p + f"attention.self.{m}.weight"].T
+                                for m in ("query", "key", "value")], axis=1)
+        qkv_b = np.concatenate([sd[p + f"attention.self.{m}.bias"]
+                                for m in ("query", "key", "value")])
+        return {
+            "attn_qkvw": jnp.asarray(qkv_w, cfg.param_dtype),
+            "attn_qkvb": jnp.asarray(qkv_b, cfg.param_dtype),
+            "attn_ow": gT(p + "attention.output.dense.weight"),
+            "attn_ob": g(p + "attention.output.dense.bias"),
+            "attn_nw": g(p + "attention.output.LayerNorm.weight"),
+            "attn_nb": g(p + "attention.output.LayerNorm.bias"),
+            "inter_w": gT(p + "intermediate.dense.weight"),
+            "inter_b": g(p + "intermediate.dense.bias"),
+            "output_w": gT(p + "output.dense.weight"),
+            "output_b": g(p + "output.dense.bias"),
+            "norm_w": g(p + "output.LayerNorm.weight"),
+            "norm_b": g(p + "output.LayerNorm.bias"),
+        }
+
+    D = cfg.d_model
+    params = {
+        "embeddings": {
+            "word": g("bert.embeddings.word_embeddings.weight"),
+            "position": g("bert.embeddings.position_embeddings.weight"),
+            "token_type": g("bert.embeddings.token_type_embeddings.weight"),
+            "ln_w": g("bert.embeddings.LayerNorm.weight"),
+            "ln_b": g("bert.embeddings.LayerNorm.bias"),
+        },
+        "layers": [layer(i) for i in range(cfg.num_layers)],
+        # post-LN BERT has no final LN; identity values stay unused
+        "final_ln_w": jnp.ones((D,), cfg.param_dtype),
+        "final_ln_b": jnp.zeros((D,), cfg.param_dtype),
+        "pooler": {"w": gT("bert.pooler.dense.weight"),
+                   "b": g("bert.pooler.dense.bias")},
+        "mlm_head": {
+            "w": gT("cls.predictions.transform.dense.weight"),
+            "b": g("cls.predictions.transform.dense.bias"),
+            "ln_w": g("cls.predictions.transform.LayerNorm.weight"),
+            "ln_b": g("cls.predictions.transform.LayerNorm.bias"),
+            "decoder_b": g("cls.predictions.bias"),
+        },
+        "nsp_head": {"w": gT("cls.seq_relationship.weight"),
+                     "b": g("cls.seq_relationship.bias")},
+    }
+    return model, params

@@ -35,7 +35,9 @@ def main():
     args = ap.parse_args()
 
     n_dev = jax.device_count()
-    sp = min(4, n_dev)
+    # largest divisor of the device count <= 4, so the mesh covers
+    # every device at any world size
+    sp = max(d for d in (1, 2, 3, 4) if n_dev % d == 0)
     dp = n_dev // sp
     cfg = gpt2_config("nano", vocab_size=512, max_seq_len=args.seq,
                       dropout=0.0, embed_dropout=0.0,

@@ -99,3 +99,67 @@ def test_hf_untied_and_unsupported_configs():
     bad2 = transformers.GPT2Config(scale_attn_by_inverse_layer_idx=True)
     with pytest.raises(ValueError, match="scale_attn"):
         gpt2_config_from_hf(bad2)
+
+
+def _hf_bert():
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2,
+        hidden_act="gelu_new",  # exact-match activation (tanh approx)
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    torch.manual_seed(3)
+    return transformers.BertForPreTraining(cfg).eval()
+
+
+def test_hf_bert_logits_parity():
+    """Second cross-framework oracle: the whole BERT encoder + MLM/NSP
+    heads (post-LN, additive padding mask, pooler tanh, tied decoder)
+    match the torch implementation."""
+    from deepspeed_tpu.models.hf import load_hf_bert
+
+    hf = _hf_bert()
+    model, params = load_hf_bert(hf, compute_dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 96, (2, 17)).astype(np.int32)
+    tt = rng.randint(0, 2, (2, 17)).astype(np.int32)
+    am = np.ones((2, 17), np.int32)
+    am[1, 11:] = 0  # padding on the second row
+
+    with torch.no_grad():
+        out = hf(torch.tensor(ids, dtype=torch.long),
+                 attention_mask=torch.tensor(am, dtype=torch.long),
+                 token_type_ids=torch.tensor(tt, dtype=torch.long))
+    logits, nsp = model.apply(params, {
+        "input_ids": jnp.asarray(ids),
+        "token_type_ids": jnp.asarray(tt),
+        "attention_mask": jnp.asarray(am)})
+    np.testing.assert_allclose(np.asarray(logits, np.float32),
+                               out.prediction_logits.numpy(),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(nsp, np.float32),
+                               out.seq_relationship_logits.numpy(),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hf_bert_rejects_unsupported():
+    from deepspeed_tpu.models.hf import bert_config_from_hf
+
+    bad = transformers.BertConfig(position_embedding_type="relative_key")
+    with pytest.raises(ValueError, match="position"):
+        bert_config_from_hf(bad)
+    bad2 = transformers.BertConfig(hidden_act="silu")
+    with pytest.raises(ValueError, match="hidden_act"):
+        bert_config_from_hf(bad2)
+
+
+def test_hf_bert_rejects_untied_decoder():
+    from deepspeed_tpu.models import load_hf_bert
+
+    cfg = transformers.BertConfig(
+        vocab_size=96, hidden_size=32, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=64,
+        tie_word_embeddings=False)
+    hf = transformers.BertForPreTraining(cfg)
+    with pytest.raises(ValueError, match="untied"):
+        load_hf_bert(hf)
