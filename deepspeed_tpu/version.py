@@ -4,7 +4,7 @@ Mirrors the reference's version stamping (/root/reference/version.txt,
 deepspeed/git_version_info.py) without requiring a build step.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"  # round 4: multi-host pipe, NVMe masters, zigzag SP, int8 wire, BERT oracle
 version = __version__
 git_hash = "unknown"
 git_branch = "main"
