@@ -45,6 +45,7 @@ from .fp16.loss_scaler import create_loss_scaler
 from .fp16.onebit import OnebitAdam, OnebitLamb
 from .lr_schedules import SCHEDULERS
 from .module import TrainModule
+from .pipe.p2p import batch_shardable
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import ThroughputTimer, clip_grad_norm, has_overflow
 from ..utils.timer import SynchronizedWallClockTimer
@@ -671,7 +672,7 @@ class DeepSpeedEngine:
         def put(x):
             x = jnp.asarray(x)
             spec = [None] * x.ndim
-            if x.ndim and x.shape[0] % max(1, self.dp_world_size) == 0:
+            if batch_shardable(x.shape, max(1, self.dp_world_size)):
                 spec[0] = DATA_AXIS
             elif x.ndim:
                 # replicating costs dp x memory/compute — tell the user once

@@ -46,11 +46,22 @@ from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
 from ..utils import has_overflow
 from .module import PipelineModule, TiedLayerSpec
-from .p2p import Channel, GlobalScalars
+from .p2p import Channel, GlobalScalars, batch_shardable
 from .schedule import (BackwardPass, ForwardPass, InterleavedTrainSchedule,
                        LoadMicroBatch, OptimizerStep, RecvActivation,
                        RecvGrad, ReduceGrads, ReduceTiedGrads,
                        SendActivation, SendGrad, TrainSchedule)
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a completed rename is durable — without this
+    the file's rename can sit in the page cache after the data fsync,
+    and a crash can publish `latest` over missing chunk files."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
 
 
 class _StageRuntime:
@@ -205,7 +216,7 @@ class _StageRuntime:
 
     def place_batch(self, x):
         x = jnp.asarray(x)
-        if x.ndim and x.shape[0] % len(self.devices) == 0:
+        if batch_shardable(x.shape, len(self.devices)):
             return jax.device_put(x, self.batch_sharding)
         return jax.device_put(x, self.replicated)
 
@@ -915,7 +926,8 @@ class PipelineEngine(DeepSpeedEngine):
             y = rt.y_out.pop(b)
             self._mail_act[(mc + 1, mb)] = jax.device_put(
                 y, nxt.batch_sharding
-                if y.shape[0] % len(nxt.devices) == 0 else nxt.replicated)
+                if batch_shardable(y.shape, len(nxt.devices))
+                else nxt.replicated)
         elif isinstance(cmd, RecvGrad):
             mb = self._recv_grad_cnt[mc]
             self._recv_grad_cnt[mc] += 1
@@ -944,7 +956,8 @@ class PipelineEngine(DeepSpeedEngine):
             dx = rt.dx_out.pop(b)
             self._mail_grad[(mc - 1, mb)] = jax.device_put(
                 dx, prev.batch_sharding
-                if dx.shape[0] % len(prev.devices) == 0 else prev.replicated)
+                if batch_shardable(dx.shape, len(prev.devices))
+                else prev.replicated)
         elif isinstance(cmd, ReduceTiedGrads):
             self._reduce_tied_grads()
         elif isinstance(cmd, ReduceGrads):
@@ -1066,9 +1079,18 @@ class PipelineEngine(DeepSpeedEngine):
     def _mh_write(self, path, payload):
         from flax import serialization
 
-        with open(path, "wb") as f:
+        # write-tmp + fsync + rename: the pre-`latest` barrier only orders
+        # processes, not the page cache — a host crash after the barrier
+        # must not leave `latest` pointing at torn chunk files
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
             f.write(serialization.msgpack_serialize(
                 jax.tree_util.tree_map(np.asarray, payload)))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        # the containing dir is fsynced ONCE per save (before the
+        # pre-`latest` barrier), not here — one barrier, not one per file
 
     def _mh_read(self, path):
         from flax import serialization
@@ -1185,12 +1207,25 @@ class PipelineEngine(DeepSpeedEngine):
                 **self._client_state(client_state),
             }
             self._mh_write(ckpt_io.model_ckpt_name(ckpt_dir), model_state)
+        # make this process's renames durable (single directory barrier
+        # for all files written above) AND the <tag> dirent itself (lives
+        # in save_dir — per-host filesystems each need it), then the
         # collective barrier: every process's files are on disk before
         # rank 0 publishes `latest`
+        _fsync_dir(ckpt_dir)
+        _fsync_dir(save_dir)
         self._gscal.sum(np.zeros(1, np.float32))
         if save_latest and me == 0:
-            with open(os.path.join(save_dir, "latest"), "w") as f:
+            # atomic publish: write-tmp-then-rename so a crash mid-write
+            # can't leave a truncated `latest`
+            latest = os.path.join(save_dir, "latest")
+            tmp = latest + ".tmp"
+            with open(tmp, "w") as f:
                 f.write(str(tag))
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, latest)
+            _fsync_dir(save_dir)
         log_dist(f"saved multi-host pipeline checkpoint {tag} to "
                  f"{ckpt_dir}", ranks=[0])
         return True
@@ -1331,10 +1366,11 @@ class PipelineEngine(DeepSpeedEngine):
             x = self.stages[0].place_batch(inputs)
             for rt in self.stages[:-1]:
                 x = rt.fwd_eval_j(rt.own, rt.ro_tied, x, None)
+                nxt = self.stages[rt.stage_id + 1]
                 x = jax.device_put(
-                    x, self.stages[rt.stage_id + 1].batch_sharding
-                    if x.shape[0] % len(self.stages[rt.stage_id + 1].devices) == 0
-                    else self.stages[rt.stage_id + 1].replicated)
+                    x, nxt.batch_sharding
+                    if batch_shardable(x.shape, len(nxt.devices))
+                    else nxt.replicated)
             last = self.stages[-1]
             losses.append(last.eval_loss_j(
                 last.own, last.ro_tied, x, last.place_batch(labels), None))
@@ -1350,8 +1386,23 @@ class PipelineEngine(DeepSpeedEngine):
         for _ in range(M):
             try:
                 inputs, labels = self._next_micro_batch_from(data_iter)
+                got = 1.0
             except StopIteration:
+                got = 0.0
+            # Contract check BEFORE the chunk walk: every process must see
+            # the identical data stream.  If iterators diverge, the process
+            # that got data would enter channel collectives its peer never
+            # joins and the job would hang — sum a got-data flag and raise
+            # on mismatch instead (cheap: one tiny collective per mb).
+            total_got = float(self._gscal.sum([got])[0])
+            if total_got == 0.0:
                 break
+            if total_got != float(self._gscal.nprocs):
+                raise RuntimeError(
+                    f"eval data iterators diverged across processes: "
+                    f"{int(total_got)}/{self._gscal.nprocs} processes had a "
+                    f"micro batch at index {count} — every process must be "
+                    f"given an identical data stream")
             count += 1
             avals = self._chunk_out_avals(jax.ShapeDtypeStruct(
                 np.asarray(inputs).shape, np.asarray(inputs).dtype))

@@ -37,6 +37,14 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def batch_shardable(shape, group_size: int) -> bool:
+    """THE shard-vs-replicate rule for pipeline payloads: batch-shard over
+    a device group iff the leading dim divides evenly.  Sender and
+    receiver of a Channel, and the single-controller placements, must all
+    derive the layout from the aval alone — one rule, one place."""
+    return bool(len(shape)) and shape[0] % group_size == 0
+
+
 class Channel:
     """One-directional transfer: src device group -> dst device group.
 
@@ -69,12 +77,11 @@ class Channel:
         self._zeros: Dict[Any, Any] = {}
 
     def _plan(self, aval):
-        """Batch-shard over the group when the leading dim divides evenly
-        (must mirror _StageRuntime.place_batch so both endpoints agree
-        from the aval alone); always replicated on parameter channels."""
+        """Layout from the aval alone (mirrors _StageRuntime.place_batch
+        via batch_shardable); always replicated on parameter channels."""
         if self.replicate:
             return False
-        return bool(aval.ndim) and aval.shape[0] % self.G == 0
+        return batch_shardable(aval.shape, self.G)
 
     def _zero_shard(self, shape, dtype, device):
         key = (shape, str(dtype), device.id)

@@ -4,18 +4,30 @@
 #           /tmp/tpu_bert{128,512}.json, /tmp/tpu_session_status (one
 #           "name rc" line per command so consumers can tell which
 #           artifacts are trustworthy).
+# Ordered highest-value-first and committed per-artifact: a five-minute
+# tunnel window still yields the headline number in-repo even if the
+# sweeps never get to run.
 # Exit: 0 iff the headline bench produced a valid on-TPU JSON line
-# (tools/bench_gate.py). Sweep failures don't fail the session (their rc
+# (tools/bench_gate.py). Later failures don't fail the session (their rc
 # is in the status file).
 set -x
 cd "$(dirname "$0")/.."
 STATUS=/tmp/tpu_session_status
+ART=bench_artifacts/r5
+mkdir -p "$ART"
 : > "$STATUS"
 
 run() { # run <name> <timeout> <cmd...> — record rc, never abort the session
   local name=$1 tmo=$2; shift 2
   timeout "$tmo" "$@"
   echo "$name $?" >> "$STATUS"
+}
+
+persist() { # persist <file...> — copy into the repo and commit ONLY those
+  cp -f "$@" "$STATUS" "$ART"/ 2>/dev/null
+  git add "$ART" 2>/dev/null && \
+    git commit -m "Record on-TPU artifact: $(basename "$1")" -- "$ART" \
+      >/dev/null 2>&1
 }
 
 run bench 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
@@ -25,16 +37,32 @@ run bench 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
 # trust signal for the headline artifact.
 if ! python tools/bench_gate.py /tmp/tpu_bench.json; then
   echo "gate 1" >> "$STATUS"
+  # a failed gate is the outcome that most needs diagnosis — persist the
+  # evidence (bench output + log + status) before bailing
+  persist /tmp/tpu_bench.json /tmp/tpu_bench.log
   exit 1
 fi
 echo "gate 0" >> "$STATUS"
-run sweep_ce     2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
-run sweep_flash  2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
-run sweep_batch  3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
-run sweep_sparse 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
-run bert128      1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
-run bert512      1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
-run profile      1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
+persist /tmp/tpu_bench.json
+
+# High-value artifacts next (BERT-large rows vs the reference's 64/53
+# TFLOPS anchor, then memory headroom), each committed as it lands.
+run bert128  1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
+persist /tmp/tpu_bert128.json
+run bert512  1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
+persist /tmp/tpu_bert512.json
 run headroom 2400 env DSTPU_BENCH_MODE=headroom python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
+persist /tmp/tpu_headroom.json
+
+run sweep_ce     2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
+persist /tmp/tpu_sweep_ce.txt
+run sweep_flash  2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
+persist /tmp/tpu_sweep_flash.txt
+run sweep_batch  3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
+persist /tmp/tpu_sweep_batch.txt
+run sweep_sparse 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
+persist /tmp/tpu_sweep_sparse.txt
+run profile      1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
+persist /tmp/tpu_profile.log  # also picks up the final status lines
 cat "$STATUS"
 echo done

@@ -27,18 +27,10 @@ while true; do
     echo "[$(date +%F_%T)] TPU UP — running session" >> /tmp/tpu_watch.log
     touch /tmp/tpu_ready
     if bash tools/tpu_session.sh >> /tmp/tpu_watch.log 2>&1; then
+      # the session commits each artifact as it lands (persist());
+      # nothing to copy here
       touch /tmp/tpu_done
-      # persist the measurements into the repo so they survive even if
-      # the build session is over when the tunnel finally opens
-      mkdir -p bench_artifacts/r4
-      cp -f /tmp/tpu_bench.json /tmp/tpu_headroom.json \
-            /tmp/tpu_bert128.json /tmp/tpu_bert512.json \
-            /tmp/tpu_sweep_*.txt /tmp/tpu_session_status \
-            bench_artifacts/r4/ 2>/dev/null
-      git add bench_artifacts/r4 2>/dev/null && \
-        git commit -m "Record on-TPU measurement session artifacts" \
-          >> /tmp/tpu_watch.log 2>&1
-      echo "[$(date +%F_%T)] session complete (artifacts committed)" >> /tmp/tpu_watch.log
+      echo "[$(date +%F_%T)] session complete (artifacts committed per-artifact)" >> /tmp/tpu_watch.log
       exit 0
     fi
     rm -f /tmp/tpu_ready
