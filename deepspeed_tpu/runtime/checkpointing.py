@@ -160,6 +160,81 @@ def _load_rank_pieces(ckpt_dir: str, mp_rank: int) -> Dict[str, list]:
     return pieces
 
 
+_STREAM_PREFIX = "__dstpu_stream__:"
+
+
+def stream_group_ckpt_name(ckpt_dir: str, group: str) -> str:
+    """Per-stream-group checkpoint file (masters + that group's Adam
+    moments), the RAM-bounded unit of the Infinity streaming writer.
+    Reference capability: swap-aware optimizer save,
+    swap_tensor/partitioned_param_swapper.py:223-277."""
+    safe = group.replace(":", "_").replace("/", "_")
+    return os.path.join(ckpt_dir, f"stream_group_{safe}.msgpack")
+
+
+def stream_marker(group: str, slot: str) -> str:
+    """Marker leaf standing in for streamed data: slot is 'leaf:<j>'
+    (master leaf j of the group), 'optim:<key>' (Adam moments of flat
+    leaf <key>) or 'acc:<key>' (mid-accumulation grad sink entry)."""
+    return f"{_STREAM_PREFIX}{group}|{slot}"
+
+
+def write_stream_group(ckpt_dir: str, group: str, payload) -> str:
+    path = stream_group_ckpt_name(ckpt_dir, group)
+    with open(path, "wb") as f:
+        f.write(serialization.msgpack_serialize(_to_host(payload)))
+    return path
+
+
+def _read_stream_group(ckpt_dir: str, group: str):
+    path = stream_group_ckpt_name(ckpt_dir, group)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"streamed checkpoint group file not found: {path}")
+    with open(path, "rb") as f:
+        return serialization.msgpack_restore(f.read())
+
+
+def has_stream_markers(tree) -> bool:
+    return any(isinstance(l, str) and l.startswith(_STREAM_PREFIX)
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+def resolve_streamed(tree, ckpt_dir: str):
+    """Materialize stream markers by reading group files (one cached at a
+    time — marker visitation order has group locality, so each file is
+    normally read once).  Consumers that must stay RAM-bounded skip this
+    and walk the group files themselves (InfinityRuntime.load_streamed)."""
+    cache: Dict[str, Any] = {}
+
+    def lookup(marker: str):
+        group, slot = marker[len(_STREAM_PREFIX):].split("|", 1)
+        if group not in cache:
+            cache.clear()
+            cache[group] = _read_stream_group(ckpt_dir, group)
+        payload = cache[group]
+        kind, _, idx = slot.partition(":")
+        if kind == "leaf":
+            return np.asarray(payload["leaves"][idx])
+        if kind == "optim":
+            return {k: np.asarray(v)
+                    for k, v in payload["optim"][idx].items()}
+        if kind == "acc":
+            return np.asarray(payload["acc"][idx])
+        raise ValueError(f"unknown stream marker slot {slot!r}")
+
+    def visit(node):
+        if isinstance(node, str) and node.startswith(_STREAM_PREFIX):
+            return lookup(node)
+        if isinstance(node, dict):
+            return {k: visit(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            return type(node)(visit(v) for v in node)
+        return node
+
+    return visit(tree)
+
+
 def model_ckpt_name(ckpt_dir: str, mp_rank: int = 0) -> str:
     return os.path.join(ckpt_dir, f"mp_rank_{mp_rank:02d}_model_states.msgpack")
 
@@ -263,8 +338,13 @@ def read_latest_tag(load_dir: str) -> Optional[str]:
 
 
 def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
-                          mp_rank: int = 0, dp_rank: int = 0):
-    """Returns (ckpt_dir, model_state, optim_state_or_None)."""
+                          mp_rank: int = 0, dp_rank: int = 0,
+                          resolve_streams: bool = True):
+    """Returns (ckpt_dir, model_state, optim_state_or_None).
+
+    resolve_streams=False leaves Infinity stream markers in place so a
+    paged engine can walk the group files RAM-bounded instead of
+    materializing the full fp32 set here."""
     if tag is None:
         tag = read_latest_tag(load_dir)
         if tag is None:
@@ -304,4 +384,9 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
                 optim_state.get("__dstpu_ckpt_v2__"):
             # v2 sharded layout: the skeleton lives in rank 0's file
             optim_state = _reassemble(optim_state.get("state"), pieces)
+    if resolve_streams:
+        if has_stream_markers(model_state):
+            model_state = resolve_streamed(model_state, ckpt_dir)
+        if optim_state is not None and has_stream_markers(optim_state):
+            optim_state = resolve_streamed(optim_state, ckpt_dir)
     return ckpt_dir, model_state, optim_state

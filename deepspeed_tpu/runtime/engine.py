@@ -1589,8 +1589,15 @@ class DeepSpeedEngine:
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
+        inf_sd = None
         if self._infinity is not None:
-            module_np = self._infinity.masters_tree()
+            if self._infinity.pager is not None:
+                # NVMe-paged masters: stream group files directly from the
+                # pages — never materialize the full fp32 set in host RAM
+                module_np, inf_sd = self._infinity.save_streamed(
+                    os.path.join(save_dir, str(tag)))
+            else:
+                module_np = self._infinity.masters_tree()
         elif self._offload is not None:
             # host fp32 masters are the source of truth under offload
             module_np = jax.tree_util.tree_unflatten(
@@ -1624,7 +1631,8 @@ class DeepSpeedEngine:
                            if k not in ("worker_error", "server_error")}
         optim_state = {
             "optimizer_state": (
-                self._infinity.state_dict() if self._infinity is not None
+                inf_sd if inf_sd is not None
+                else self._infinity.state_dict() if self._infinity is not None
                 else self._offload.state_dict() if self._offload is not None
                 else opt_to_save),
             "offload": (self._offload is not None
@@ -1654,18 +1662,38 @@ class DeepSpeedEngine:
 
     def load_checkpoint(self, load_dir, tag=None, load_module_strict=True,
                         load_optimizer_states=True, load_lr_scheduler_states=True):
+        # a paged Infinity engine walks stream-group files RAM-bounded;
+        # everyone else materializes markers here (resolve_streamed)
+        paged = (self._infinity is not None
+                 and self._infinity.pager is not None)
         try:
             ckpt_dir, model_state, optim_state = ckpt_io.load_checkpoint_state(
-                load_dir, tag)
+                load_dir, tag, resolve_streams=not paged)
         except FileNotFoundError as e:
             logger.warning(f"load_checkpoint: {e}")
             return None, {}
 
         if self._infinity is not None:
-            self._infinity.load_masters_tree(model_state["module"])
-            if load_optimizer_states and optim_state is not None and \
-                    optim_state.get("offload"):
-                self._infinity.load_state_dict(optim_state["optimizer_state"])
+            if paged and ckpt_io.has_stream_markers(model_state["module"]):
+                try:
+                    self._infinity.load_streamed(
+                        ckpt_dir,
+                        optim_state["optimizer_state"]
+                        if (load_optimizer_states
+                            and optim_state is not None
+                            and optim_state.get("offload")) else None)
+                except FileNotFoundError as e:
+                    # pre-flight inside load_streamed: nothing was mutated
+                    logger.warning(f"load_checkpoint: {e}")
+                    return None, {}
+            else:
+                # non-paged engines got markers resolved by
+                # load_checkpoint_state (resolve_streams=True above)
+                self._infinity.load_masters_tree(model_state["module"])
+                if load_optimizer_states and optim_state is not None and \
+                        optim_state.get("offload"):
+                    self._infinity.load_state_dict(
+                        optim_state["optimizer_state"])
             if model_state.get("loss_scaler") is not None:
                 self._scaler_state = {
                     k: jnp.asarray(v)

@@ -546,19 +546,145 @@ class InfinityRuntime:
 
     # -- checkpoint parity -------------------------------------------------
 
+    def save_streamed(self, ckpt_dir: str):
+        """RAM-bounded checkpoint write for NVMe-paged masters: one group
+        file per stream group carrying the group's fp32 masters, Adam
+        moments and any mid-accumulation grad-sink entries, written while
+        at most ~2 groups are resident.  Returns (module_skeleton,
+        optimizer_sd_skeleton) — marker trees that slot into the normal
+        checkpoint files, so the checkpoint loads in a non-paged engine
+        via checkpointing.resolve_streamed.  Reference capability:
+        swap-aware optimizer save, swap_tensor/optimizer_utils.py +
+        partitioned_param_swapper.py:223-277."""
+        import os
+
+        from .. import checkpointing as ckpt_io
+
+        write = jax.process_index() == 0  # masters replicated across hosts
+        if write:
+            os.makedirs(ckpt_dir, exist_ok=True)
+        groups_markers: Dict[str, Any] = {}
+        state_markers: Dict[str, str] = {}
+        acc_markers: Dict[str, str] = {}
+        order = self.group_order
+        for idx, name in enumerate(order):
+            _, treedef, shapes = self.masters[name]
+            sizes = self._group_sizes(name)
+            base = self._leaf_base[name]
+            groups_markers[name] = jax.tree_util.tree_unflatten(
+                treedef, [ckpt_io.stream_marker(name, f"leaf:{j}")
+                          for j in range(len(shapes))])
+            if write:
+                flat = self._masters_flat(name)
+                self._prefetch_masters(order[idx + 1]
+                                       if idx + 1 < len(order) else None)
+                payload: Dict[str, Any] = {
+                    "leaves": {str(j): m.reshape(s).copy()
+                               for j, (m, s) in enumerate(zip(flat, shapes))},
+                    "optim": {}, "acc": {}}
+            for j, n in enumerate(sizes):
+                key = base + j
+                if write:
+                    if self.nvme is not None:
+                        # nvme.load fabricates zeros for unknown keys —
+                        # never-stepped leaves must serialize NO moments,
+                        # not 8 bytes/param of zeros
+                        st = (self.nvme.load(key, n)
+                              if self.nvme.has(key) else None)
+                    else:
+                        st = self.adam._state.get(key)
+                    if st is not None:
+                        payload["optim"][str(key)] = {
+                            k: np.asarray(v).copy() for k, v in st.items()}
+                    if key in self._acc_sink:
+                        payload["acc"][str(key)] = self._acc_sink[key]
+                state_markers[str(key)] = ckpt_io.stream_marker(
+                    name, f"optim:{key}")
+                if key in self._acc_sink:
+                    acc_markers[str(key)] = ckpt_io.stream_marker(
+                        name, f"acc:{key}")
+            if write:
+                # pre-first-step: no moments exist yet; markers must not
+                # dangle, so drop the skeleton entries for absent state
+                for j in range(len(sizes)):
+                    if str(base + j) not in payload["optim"]:
+                        state_markers.pop(str(base + j), None)
+                ckpt_io.write_stream_group(ckpt_dir, name, payload)
+        sd: Dict[str, Any] = {"step": self.adam.step_count,
+                              "state": state_markers,
+                              "n_elements": self.n_elements}
+        if self._acc_count:
+            sd["acc_count"] = self._acc_count
+            sd["acc_sink"] = acc_markers
+        module_skel = self.model.assemble_groups(groups_markers)
+        return module_skel, sd
+
+    def load_streamed(self, ckpt_dir: str, sd: Optional[dict]) -> None:
+        """RAM-bounded inverse of save_streamed: walk the group files,
+        page each group's masters straight to NVMe and its moments into
+        the moment store, never materializing the full model.  sd is the
+        optimizer skeleton (None skips moments/step restore)."""
+        import os
+
+        from .. import checkpointing as ckpt_io
+
+        # pre-flight BEFORE mutating anything: a missing group file must
+        # leave the engine untouched (the loader's warn-and-return
+        # contract), not half-loaded with mixed old/new masters
+        missing = [name for name in self.group_order
+                   if not os.path.isfile(
+                       ckpt_io.stream_group_ckpt_name(ckpt_dir, name))]
+        if missing:
+            raise FileNotFoundError(
+                f"streamed checkpoint incomplete: missing group files for "
+                f"{missing} in {ckpt_dir}")
+        self._kept.clear()
+        load_opt = sd is not None
+        if load_opt:
+            self.adam.step_count = int(sd["step"])
+            self.adam._state = {}
+            self._acc_count = int(sd.get("acc_count", 0))
+            self._acc_sink = {}
+        for name in self.group_order:
+            _, treedef, shapes = self.masters[name]
+            sizes = self._group_sizes(name)
+            base = self._leaf_base[name]
+            payload = ckpt_io._read_stream_group(ckpt_dir, name)
+            flat = [np.asarray(payload["leaves"][str(j)],
+                               np.float32).ravel()
+                    for j in range(len(shapes))]
+            for f, n in zip(flat, sizes):
+                if f.size != n:
+                    raise ValueError(
+                        f"stream group {name!r}: leaf size {f.size} != "
+                        f"expected {n} (checkpoint/model config mismatch)")
+            self._commit_masters(name, flat)
+            if not load_opt:
+                continue
+            for key_s, st in (payload.get("optim") or {}).items():
+                key = int(key_s)
+                st = {k: np.asarray(v, np.float32) for k, v in st.items()}
+                if self.nvme is not None:
+                    self.nvme.store(key, st)
+                else:
+                    self.adam._state[key] = st
+            for key_s, g in (payload.get("acc") or {}).items():
+                self._acc_sink[int(key_s)] = np.asarray(g, np.float32)
+
     def masters_tree(self):
         # copies, not views: the masters mutate in place every step, and a
         # view would alias through zero-copy device_put on CPU backends.
         # NOTE: this materializes the FULL fp32 master set in host RAM —
-        # with NVMe-paged masters, checkpointing a model sized beyond
-        # host RAM needs a streaming writer (not built yet); warn so the
-        # OOM is attributable
+        # engine checkpointing of paged masters streams group-by-group
+        # (save_streamed) instead; this path remains for direct full-tree
+        # access (engine.params, save_fp16_model), where materialization
+        # is the point. Warn so an OOM is attributable
         if self.pager is not None:
             log_dist(
-                f"checkpoint: materializing {self.n_elements * 4 / 2**30:.1f}"
-                f" GiB of NVMe-paged fp32 masters in host RAM (a streaming "
-                f"checkpoint writer is not implemented; for models beyond "
-                f"host RAM export group-by-group via stream_groups)",
+                f"materializing {self.n_elements * 4 / 2**30:.1f}"
+                f" GiB of NVMe-paged fp32 masters in host RAM (checkpoint "
+                f"save/load streams group-by-group and stays RAM-bounded; "
+                f"this full-tree access does not)",
                 ranks=[0])
         groups = {}
         for name in self.group_order:

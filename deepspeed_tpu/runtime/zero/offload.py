@@ -70,6 +70,12 @@ class NvmeStateStore:
     def _path(self, key: int, name: str) -> str:
         return os.path.join(self.dir, f"leaf{key}_{name}.bin")
 
+    def has(self, key: int) -> bool:
+        """True iff moments for this leaf have ever been stored (load()
+        fabricates zeros for unknown keys — callers that must distinguish
+        'fresh' from 'zero' ask first)."""
+        return key in self._initialized
+
     def load(self, key: int, n: int):
         m = np.zeros(n, np.float32)
         v = np.zeros(n, np.float32)
