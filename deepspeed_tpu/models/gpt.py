@@ -453,8 +453,8 @@ class GPT(TrainModule):
         x = _constrain(x, cfg, P(DATA_AXIS, SEQ_AXIS, None))
 
         zig_inv = None
-        if cfg.sequence_parallel and \
-                cfg.sequence_parallel_impl == "ring_zigzag":
+        n_seq = self._stream_zigzag_n()
+        if n_seq:
             # ONE layout change for the whole trunk (a static-index
             # gather XLA lowers to a single resharding collective), so
             # every block's ring attention runs mask-free load-balanced;
@@ -463,16 +463,12 @@ class GPT(TrainModule):
             if cfg.pipeline_stages > 1:
                 raise NotImplementedError(
                     "ring_zigzag + SPMD pipeline is not wired up")
-            from ..comm.mesh import SEQ_AXIS as _SA
-            from ..comm.mesh import get_current_mesh
             from ..parallel.ring_attention import zigzag_order
 
-            n_seq = get_current_mesh().axis_size(_SA)
-            if n_seq > 1:
-                perm, inv = zigzag_order(S, n_seq)
-                zig_inv = jnp.asarray(inv)
-                x = _constrain(x[:, jnp.asarray(perm)], cfg,
-                               P(DATA_AXIS, SEQ_AXIS, None))
+            perm, inv = zigzag_order(S, n_seq)
+            zig_inv = jnp.asarray(inv)
+            x = _constrain(x[:, jnp.asarray(perm)], cfg,
+                           P(DATA_AXIS, SEQ_AXIS, None))
 
         if cfg.pipeline_stages > 1:
             if capture_layers:
@@ -585,14 +581,23 @@ class GPT(TrainModule):
 
     def stream_supported(self) -> bool:
         cfg = self.config
-        # ring_zigzag needs the trunk's one-shot layout permutation,
-        # which the streamed per-block walk doesn't perform — streaming
-        # it would run zigzag attention on contiguous tokens
-        zigzag = (cfg.sequence_parallel
-                  and cfg.sequence_parallel_impl == "ring_zigzag")
         return (cfg.num_experts == 1 and cfg.pipeline_stages == 1
-                and cfg.dropout == 0.0 and cfg.embed_dropout == 0.0
-                and not zigzag)
+                and cfg.dropout == 0.0 and cfg.embed_dropout == 0.0)
+
+    def _stream_zigzag_n(self) -> int:
+        """seq-axis size when zigzag layout is active, else 0 — THE
+        gating rule, shared by the trunk's one-shot layout change
+        (_trunk) and the streamed boundary (stream_embed permutes,
+        stream_head_loss inverts), so the two paths cannot drift and
+        long-context + larger-than-HBM compose."""
+        cfg = self.config
+        if not (cfg.sequence_parallel
+                and cfg.sequence_parallel_impl == "ring_zigzag"):
+            return 0
+        from ..comm.mesh import get_current_mesh
+
+        n = get_current_mesh().axis_size(SEQ_AXIS)
+        return n if n > 1 else 0
 
     def stream_init(self, rng):
         """Yield (group_name, host_numpy_subtree) with only ONE group ever
@@ -641,15 +646,33 @@ class GPT(TrainModule):
 
     def stream_embed(self, embed_p, tokens):
         S = tokens.shape[1]
-        return embed_p["wte"][tokens] + embed_p["wpe"][:S][None, :, :]
+        x = embed_p["wte"][tokens] + embed_p["wpe"][:S][None, :, :]
+        n = self._stream_zigzag_n()
+        if n:
+            from ..parallel.ring_attention import zigzag_order
+
+            perm, _ = zigzag_order(S, n)
+            x = _constrain(x[:, jnp.asarray(perm)], self.config,
+                           P(DATA_AXIS, SEQ_AXIS, None))
+        return x
 
     def stream_block(self, block_p, x):
         return gpt_block(x, block_p, self.config, None, True)[0]
 
     def stream_head_loss(self, head_p, wte_or_lm_head, x, labels, valid):
         """ln_f + fused projection CE. `wte_or_lm_head`: the tied wte
-        ([V, D]) or lm_head ([D, V]) — tied grads flow to the caller."""
+        ([V, D]) or lm_head ([D, V]) — tied grads flow to the caller.
+        Under zigzag SP, x arrives in the zigzag layout (stream_embed
+        permuted it) and is inverted here — labels stay contiguous, the
+        same contract as the trunk's pre-ln_f inverse."""
         cfg = self.config
+        n = self._stream_zigzag_n()
+        if n:
+            from ..parallel.ring_attention import zigzag_order
+
+            _, inv = zigzag_order(x.shape[1], n)
+            x = _constrain(x[:, jnp.asarray(inv)], cfg,
+                           P(DATA_AXIS, SEQ_AXIS, None))
         x = layer_norm(x, head_p["ln_f"], cfg.layer_norm_eps)
         w = (wte_or_lm_head.T if cfg.tie_embeddings else wte_or_lm_head)
         B, S, D = x.shape
