@@ -1,4 +1,6 @@
-from .handle import AsyncIOHandle, aio_read, aio_write
+from .handle import (AsyncIOHandle, aio_read, aio_write, alloc_aligned,
+                     uring_supported)
 from ..op_builder import AsyncIOBuilder  # reference ops/aio exports it
 
-__all__ = ["AsyncIOHandle", "aio_read", "aio_write", "AsyncIOBuilder"]
+__all__ = ["AsyncIOHandle", "aio_read", "aio_write", "AsyncIOBuilder",
+           "alloc_aligned", "uring_supported"]

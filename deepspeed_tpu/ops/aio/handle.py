@@ -2,18 +2,35 @@
 
 Reference API: /root/reference/csrc/aio/py_lib/deepspeed_py_aio_handle.cpp
 (aio_handle with read/write/pread/pwrite + wait) and ops/aio. Backing
-engine: csrc/aio/ds_aio.cpp (thread pool + pread/pwrite, O_DIRECT when the
-filesystem supports it — this image has no libaio headers).
+engines (csrc/aio/ds_aio.cpp): a raw-syscall io_uring engine (the
+kernel-async analogue of the reference's libaio io_submit path) with a
+std::thread pread/pwrite pool as the portable fallback.
 """
 
 from __future__ import annotations
 
 import ctypes
+import os
 from typing import Optional
 
 import numpy as np
 
 from ..op_builder import get_op
+
+
+def uring_supported() -> bool:
+    """True iff an io_uring ring can be created (kernel + seccomp)."""
+    return bool(get_op("async_io").aio_uring_supported())
+
+
+def alloc_aligned(nbytes: int, dtype=np.uint8, align: int = 4096):
+    """Buffer whose data pointer is `align`-aligned — O_DIRECT needs
+    4 KiB-aligned address/length/offset or the engine silently degrades
+    that op to buffered I/O."""
+    dt = np.dtype(dtype)
+    raw = np.empty(nbytes + align, np.uint8)
+    off = (-raw.ctypes.data) % align
+    return raw[off:off + nbytes].view(dt)
 
 
 class AsyncIOHandle:
@@ -24,13 +41,33 @@ class AsyncIOHandle:
         h.async_pwrite(arr, "/ssd/shard0.bin")
         ... overlap compute ...
         h.wait()
+
+    engine: "auto" (io_uring when the kernel allows it, else the thread
+    pool — override with DSTPU_AIO_ENGINE), "uring", or "threads".
+    n_threads doubles as the io_uring SQ depth.
     """
 
     def __init__(self, n_threads: int = 4, block_size: int = 1 << 20,
-                 o_direct: bool = False):
+                 o_direct: bool = False, engine: str = "auto"):
         self._lib = get_op("async_io")
-        self._h = self._lib.aio_handle_create(int(n_threads), int(block_size),
-                                              1 if o_direct else 0)
+        if engine == "auto":  # env steers only the default, never an
+            engine = os.environ.get("DSTPU_AIO_ENGINE",  # explicit arg
+                                    engine).lower()
+        codes = {"auto": 0, "threads": 1, "uring": 2}
+        if engine not in codes:
+            raise ValueError(f"unknown aio engine {engine!r}; "
+                             f"use auto | threads | uring")
+        self._h = self._lib.aio_handle_create2(int(n_threads),
+                                               int(block_size),
+                                               1 if o_direct else 0,
+                                               codes[engine])
+        if not self._h:
+            raise RuntimeError(
+                f"aio engine {engine!r} unavailable "
+                f"(io_uring blocked by kernel/seccomp?)")
+        # what was ACTUALLY built (auto may fall back mid-construction)
+        self.engine = {1: "threads",
+                       2: "uring"}[self._lib.aio_handle_engine(self._h)]
         self._pinned = []  # keep submitted buffers alive until wait()
 
     def _buf(self, arr: np.ndarray):

@@ -139,6 +139,13 @@ class AsyncIOBuilder(OpBuilder):
         lib.aio_handle_create.restype = ctypes.c_void_p
         lib.aio_handle_create.argtypes = [ctypes.c_int, ctypes.c_int,
                                           ctypes.c_int]
+        lib.aio_handle_create2.restype = ctypes.c_void_p
+        lib.aio_handle_create2.argtypes = [ctypes.c_int, ctypes.c_int,
+                                           ctypes.c_int, ctypes.c_int]
+        lib.aio_uring_supported.restype = ctypes.c_int
+        lib.aio_uring_supported.argtypes = []
+        lib.aio_handle_engine.restype = ctypes.c_int
+        lib.aio_handle_engine.argtypes = [ctypes.c_void_p]
         lib.aio_handle_destroy.restype = None
         lib.aio_handle_destroy.argtypes = [ctypes.c_void_p]
         common = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_char_p,

@@ -1,12 +1,18 @@
-"""Async-IO throughput sweep for the native aio engine.
+"""Async-IO throughput sweep for the native aio engines.
 
 Mirrors the reference's perf harnesses
 (/root/reference/csrc/aio/py_test/run_read_sweep.sh, run_write_sweep.sh):
-sweep thread count x transfer size, print MB/s per cell for reads and
-writes. Drives csrc/aio/ds_aio.cpp through ops.aio.AsyncIOHandle — the
-same engine ZeRO-Infinity/Offload use for NVMe paging.
+sweep thread count/queue depth x transfer size, print MB/s per cell for
+reads and writes. Drives csrc/aio/ds_aio.cpp through
+ops.aio.AsyncIOHandle — the same engines ZeRO-Infinity/Offload use for
+NVMe paging.
 
 Usage: python tools/aio_sweep.py [--dir /path/on/ssd] [--mb 64]
+           [--engine auto|threads|uring] [--o-direct]
+
+--o-direct bypasses the page cache (4 KiB-aligned buffers/sizes), giving
+the real device bandwidth that bounds Infinity capacity claims; without
+it the numbers are page-cache-assisted engine-overhead ceilings.
 """
 
 from __future__ import annotations
@@ -22,23 +28,33 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".."))
 
 
-def sweep(workdir: str, total_mb: int):
-    from deepspeed_tpu.ops.aio import AsyncIOHandle
+def sweep(workdir: str, total_mb: int, engine: str, o_direct: bool):
+    from deepspeed_tpu.ops.aio import (AsyncIOHandle, alloc_aligned,
+                                       uring_supported)
 
     os.makedirs(workdir, exist_ok=True)
+    if engine in ("auto", "uring") and not uring_supported():
+        print("# io_uring unavailable (kernel/seccomp); threads only")
+        engine = "threads"
+    print(f"# engine={engine} o_direct={o_direct}")
     sizes_mb = [1, 4, 16, max(16, total_mb)]
     threads = [1, 2, 4, 8]
     print(f"{'op':>6} {'size':>7} " +
           " ".join(f"t={t:<2}" .rjust(9) for t in threads))
     for size_mb in sizes_mb:
         n = size_mb * 1024 * 1024 // 4
-        buf = np.random.RandomState(0).rand(n).astype(np.float32)
+        # O_DIRECT contract: 4 KiB-aligned address/length (sizes here are
+        # MiB multiples, so only the address needs care)
+        buf = alloc_aligned(n * 4, np.float32) if o_direct \
+            else np.empty(n, np.float32)
+        buf[:] = np.random.RandomState(0).rand(n)
         path = os.path.join(workdir, f"aio_sweep_{size_mb}mb.bin")
         reps = max(1, total_mb // size_mb)
 
         row_w, row_r = [], []
         for t in threads:
-            h = AsyncIOHandle(n_threads=t)
+            h = AsyncIOHandle(n_threads=t, engine=engine,
+                              o_direct=o_direct)
             t0 = time.perf_counter()
             for _ in range(reps):
                 h.async_pwrite(buf, path)
@@ -46,7 +62,8 @@ def sweep(workdir: str, total_mb: int):
             dt = time.perf_counter() - t0
             row_w.append(reps * size_mb / dt)
 
-            out = np.empty_like(buf)
+            out = alloc_aligned(n * 4, np.float32) if o_direct \
+                else np.empty_like(buf)
             t0 = time.perf_counter()
             for _ in range(reps):
                 h.async_pread(out, path)
@@ -66,8 +83,12 @@ def main():
     ap.add_argument("--dir", default="/tmp/dstpu_aio_sweep")
     ap.add_argument("--mb", type=int, default=64,
                     help="total MB moved per cell")
+    ap.add_argument("--engine", default="auto",
+                    choices=["auto", "threads", "uring"])
+    ap.add_argument("--o-direct", action="store_true",
+                    help="bypass the page cache (real device bandwidth)")
     args = ap.parse_args()
-    sweep(args.dir, args.mb)
+    sweep(args.dir, args.mb, args.engine, args.o_direct)
 
 
 if __name__ == "__main__":
