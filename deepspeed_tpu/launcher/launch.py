@@ -45,8 +45,10 @@ def main(args=None):
     world_info = decode_world_info(args.world_info)
     hosts = list(world_info.keys())
     node_rank = args.node_rank
-    if node_rank < 0:  # from MPI env (reference launch.py via OMPI)
-        node_rank = int(os.environ.get("OMPI_COMM_WORLD_RANK", 0))
+    if node_rank < 0:  # from MPI env (reference launch.py via OMPI/MV2)
+        node_rank = int(os.environ.get(
+            "OMPI_COMM_WORLD_RANK",
+            os.environ.get("MV2_COMM_WORLD_RANK", 0)))
     num_nodes = len(hosts)
     ppn = max(1, args.procs_per_node)
     world_size = num_nodes * ppn

@@ -52,7 +52,7 @@ def parse_args(args=None):
                         default=TORCH_DISTRIBUTED_DEFAULT_PORT)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "openmpi", "local"])
+                        choices=["pdsh", "openmpi", "mvapich", "local"])
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -223,21 +223,30 @@ def build_pdsh_cmd(args, active_resources, world_info_b64: str):
     return ["pdsh", "-S", "-f", "1024", "-w", hosts, launch]
 
 
-def build_mpi_cmd(args, active_resources, world_info_b64: str):
-    """reference multinode_runner.py:80-121 OpenMPIRunner: one proc per
-    HOST (TPU single-controller), not per slot."""
-    nprocs = len(active_resources)
-    # filtered hostfile with ONE slot per active host (single-controller:
-    # one proc per host); the user's hostfile may contain excluded hosts
-    # and slots=N entries that would let OpenMPI stack ranks on one box
+def _write_hostfile(active_resources, line_fmt: str) -> str:
+    """Filtered temp hostfile with ONE entry per active host
+    (single-controller: one proc per host); the user's hostfile may
+    contain excluded hosts and slots=N entries that would let the MPI
+    stack ranks on one box.  Removed at interpreter exit (the launcher
+    process outlives the mpirun it spawns)."""
+    import atexit
     import tempfile
 
     fh = tempfile.NamedTemporaryFile(
         "w", prefix="dstpu_hostfile_", suffix=".txt", delete=False)
     for host in active_resources:
-        fh.write(f"{host} slots=1\n")
+        fh.write(line_fmt.format(host=host))
     fh.close()
-    cmd = ["mpirun", "-n", str(nprocs), "-hostfile", fh.name,
+    atexit.register(lambda p=fh.name: os.path.exists(p) and os.remove(p))
+    return fh.name
+
+
+def build_mpi_cmd(args, active_resources, world_info_b64: str):
+    """reference multinode_runner.py:80-121 OpenMPIRunner: one proc per
+    HOST (TPU single-controller), not per slot."""
+    nprocs = len(active_resources)
+    hostfile = _write_hostfile(active_resources, "{host} slots=1\n")
+    cmd = ["mpirun", "-n", str(nprocs), "-hostfile", hostfile,
            "--mca", "btl", "^openib"]
     for line in _export_env_lines():
         cmd += ["-x", line.split("=", 1)[0].replace("export ", "")]
@@ -246,6 +255,35 @@ def build_mpi_cmd(args, active_resources, world_info_b64: str):
             f"--master_addr={args.master_addr}",
             f"--master_port={args.master_port}",
             "--node_rank=-1",  # from OMPI env
+            args.user_script] + args.user_args
+    return cmd
+
+
+def build_mvapich_cmd(args, active_resources, world_info_b64: str):
+    """reference multinode_runner.py MVAPICHRunner: mpirun_rsh with
+    ENV=VAL forwarding and a bare host-per-line hostfile; one proc per
+    HOST (TPU single-controller), rank from MV2_COMM_WORLD_RANK."""
+    nprocs = len(active_resources)
+    hostfile = _write_hostfile(active_resources, "{host}\n")
+    cmd = ["mpirun_rsh", "-np", str(nprocs), "-hostfile", hostfile]
+    # mpirun_rsh takes ENV=VAL pairs before the executable, but rebuilds
+    # the remote command by whitespace-joining — a value with spaces
+    # (e.g. multi-flag XLA_FLAGS) would shatter into stray tokens; skip
+    # those loudly rather than corrupt the launch
+    for ln in _export_env_lines():
+        pair = ln.replace("export ", "", 1)
+        if any(c in pair for c in " \t"):
+            logger.warning(
+                f"mvapich launcher: skipping env var with whitespace "
+                f"value (mpirun_rsh cannot carry it): "
+                f"{pair.split('=', 1)[0]}")
+            continue
+        cmd.append(pair)
+    cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+            f"--world_info={world_info_b64}",
+            f"--master_addr={args.master_addr}",
+            f"--master_port={args.master_port}",
+            "--node_rank=-1",  # from MV2 env
             args.user_script] + args.user_args
     return cmd
 
@@ -290,14 +328,19 @@ def main(args=None):
         cmd = build_pdsh_cmd(args, active, world_info_b64)
     elif args.launcher == "openmpi" and shutil.which("mpirun"):
         cmd = build_mpi_cmd(args, active, world_info_b64)
+    elif args.launcher == "mvapich" and shutil.which("mpirun_rsh"):
+        cmd = build_mvapich_cmd(args, active, world_info_b64)
     elif args.launcher == "pdsh" and shutil.which("mpirun"):
         # pdsh requested but absent; mpirun present — usable fallback
         logger.warning("pdsh not found; falling back to mpirun")
         cmd = build_mpi_cmd(args, active, world_info_b64)
     else:
+        missing = {"pdsh": "pdsh (or mpirun)", "openmpi": "mpirun",
+                   "mvapich": "mpirun_rsh"}.get(args.launcher,
+                                                "pdsh/mpirun")
         raise RuntimeError(
-            f"launcher {args.launcher!r} unavailable (pdsh/mpirun not "
-            f"found) — install one or use --launcher local on each host")
+            f"launcher {args.launcher!r} unavailable ({missing} not "
+            f"found) — install it or use --launcher local on each host")
     logger.info(f"cmd = {' '.join(cmd)}")
     result = subprocess.Popen(cmd, env=os.environ.copy())
     result.wait()

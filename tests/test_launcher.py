@@ -85,6 +85,34 @@ def test_world_info_roundtrip():
     assert decode_world_info(encode_world_info(info)) == info
 
 
+def test_multinode_cmd_builders(tmp_path):
+    """pdsh/openmpi/mvapich command construction (reference
+    multinode_runner.py runners) — no backend binaries needed."""
+    from collections import OrderedDict
+
+    from deepspeed_tpu.launcher.runner import (build_mpi_cmd,
+                                               build_mvapich_cmd,
+                                               build_pdsh_cmd, parse_args)
+
+    args = parse_args(["--master_addr", "h1", "train.py", "--x", "1"])
+    active = OrderedDict([("h1", [0, 1]), ("h2", [0, 1])])
+    winfo = encode_world_info(active)
+
+    pdsh = build_pdsh_cmd(args, active, winfo)
+    assert pdsh[0] == "pdsh" and "h1,h2" in pdsh
+    assert "--node_rank=%n" in pdsh[-1] and "train.py" in pdsh[-1]
+
+    mpi = build_mpi_cmd(args, active, winfo)
+    assert mpi[0] == "mpirun" and mpi[mpi.index("-n") + 1] == "2"
+    assert "--node_rank=-1" in mpi and "train.py" in mpi
+
+    mv = build_mvapich_cmd(args, active, winfo)
+    assert mv[0] == "mpirun_rsh" and mv[mv.index("-np") + 1] == "2"
+    assert "--node_rank=-1" in mv and "train.py" in mv
+    hostfile = mv[mv.index("-hostfile") + 1]
+    assert open(hostfile).read() == "h1\nh2\n"
+
+
 def test_local_launch_end_to_end(tmp_path):
     """launch.py spawns the user script with the DSTPU_*/RANK env contract
     and fail-fast group kill (reference launch.py:122-175)."""
