@@ -263,22 +263,32 @@ def build_mvapich_cmd(args, active_resources, world_info_b64: str):
     """reference multinode_runner.py MVAPICHRunner: mpirun_rsh with
     ENV=VAL forwarding and a bare host-per-line hostfile; one proc per
     HOST (TPU single-controller), rank from MV2_COMM_WORLD_RANK."""
+    import shlex
+
     nprocs = len(active_resources)
     hostfile = _write_hostfile(active_resources, "{host}\n")
     cmd = ["mpirun_rsh", "-np", str(nprocs), "-hostfile", hostfile]
-    # mpirun_rsh takes ENV=VAL pairs before the executable, but rebuilds
-    # the remote command by whitespace-joining — a value with spaces
-    # (e.g. multi-flag XLA_FLAGS) would shatter into stray tokens; skip
-    # those loudly rather than corrupt the launch
+    # mpirun_rsh takes ENV=VAL pairs before the executable.  A bare KEY
+    # line (export-by-name, valid for the OpenMPI -x path) would be
+    # parsed as the remote executable — skip it.  Values with whitespace
+    # (multi-flag XLA_FLAGS) would shatter when mpirun_rsh re-joins the
+    # command line — those ride a shell-quoted env(1) prefix instead
+    # (remote start goes through ssh, so the remote shell re-parses the
+    # joined line and the quoting survives).
+    spaced = []
     for ln in _export_env_lines():
         pair = ln.replace("export ", "", 1)
-        if any(c in pair for c in " \t"):
+        if "=" not in pair:
             logger.warning(
-                f"mvapich launcher: skipping env var with whitespace "
-                f"value (mpirun_rsh cannot carry it): "
-                f"{pair.split('=', 1)[0]}")
+                f"mvapich launcher: skipping bare env line (no '='): "
+                f"{pair!r} — export it as KEY=VALUE in ~/.deepspeed_env")
             continue
-        cmd.append(pair)
+        if any(c in pair for c in " \t"):
+            spaced.append(pair)
+        else:
+            cmd.append(pair)
+    if spaced:
+        cmd += ["/usr/bin/env"] + [shlex.quote(p) for p in spaced]
     cmd += [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
             f"--world_info={world_info_b64}",
             f"--master_addr={args.master_addr}",

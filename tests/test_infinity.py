@@ -305,9 +305,13 @@ def test_streamed_checkpoint_mid_accumulation(tmp_path):
 def test_streamed_zigzag_matches_ring():
     """Zigzag SP composes with Infinity streaming (VERDICT r4 weak #5):
     the streamed boundary applies the layout permutation once
-    (stream_embed) and inverts it at the head, so the streamed zigzag
-    walk must train identically to the streamed contiguous ring."""
-    results = {}
+    (stream_embed) and inverts it at the head.  Fast representative:
+    raw fp32 GRADIENT parity of one streamed micro step vs the streamed
+    contiguous ring — the direct measure of the layout composition
+    (post-Adam params would amplify reduction-order noise on near-zero
+    grads through m/sqrt(v)).  The multi-step training-parity variant
+    runs in the slow lane below."""
+    grads = {}
     for impl in ("ring", "ring_zigzag"):
         cfg = _config()
         cfg["mesh"] = {"data": 2, "seq": 4}  # S=16 % 2n=8 == 0
@@ -316,27 +320,36 @@ def test_streamed_zigzag_matches_ring():
                          sequence_parallel_impl=impl),
             config_params=cfg)
         assert engine._infinity is not None
-        # raw fp32 gradient parity (the direct measure of the layout
-        # composition — comparing post-Adam params would amplify
-        # reduction-order noise on near-zero grads through m/sqrt(v))
-        engine._infinity.micro_step(_batch(0))
-        grads = {k: v.copy()
-                 for k, v in engine._infinity._acc_sink.items()}
-        engine._infinity._acc_sink = {}
-        engine._infinity._acc_count = 0
+        loss = engine._infinity.micro_step(_batch(0))
+        assert np.isfinite(float(loss))
+        grads[impl] = dict(engine._infinity._acc_sink)
+    zg, rg = grads["ring_zigzag"], grads["ring"]
+    assert zg.keys() == rg.keys()
+    for k in zg:
+        np.testing.assert_allclose(zg[k], rg[k], rtol=1e-4, atol=1e-7,
+                                   err_msg=f"grad leaf {k}")
+
+
+@pytest.mark.slow
+def test_streamed_zigzag_trains_like_ring():
+    """Slow lane: 3 full engine steps, loss-curve parity between the
+    streamed zigzag and streamed contiguous-ring engines."""
+    results = {}
+    for impl in ("ring", "ring_zigzag"):
+        cfg = _config()
+        cfg["mesh"] = {"data": 2, "seq": 4}
+        engine, *_ = deepspeed_tpu.initialize(
+            model=_model(sequence_parallel=True,
+                         sequence_parallel_impl=impl),
+            config_params=cfg)
         losses = []
         for i in range(3):
             loss = engine.forward(_batch(i))
             engine.backward(); engine.step()
             losses.append(float(loss))
-        results[impl] = (losses, grads)
-    np.testing.assert_allclose(results["ring_zigzag"][0],
-                               results["ring"][0], rtol=1e-5)
-    zg, rg = results["ring_zigzag"][1], results["ring"][1]
-    assert zg.keys() == rg.keys()
-    for k in zg:
-        np.testing.assert_allclose(zg[k], rg[k], rtol=1e-4, atol=1e-7,
-                                   err_msg=f"grad leaf {k}")
+        results[impl] = losses
+    np.testing.assert_allclose(results["ring_zigzag"], results["ring"],
+                               rtol=1e-5)
 
 
 @pytest.mark.slow

@@ -111,6 +111,17 @@ def test_multinode_cmd_builders(tmp_path):
     assert "--node_rank=-1" in mv and "train.py" in mv
     hostfile = mv[mv.index("-hostfile") + 1]
     assert open(hostfile).read() == "h1\nh2\n"
+    # env forwarding contract: no bare (no '=') tokens before the
+    # executable, and whitespace values ride the quoted env(1) prefix
+    exe_at = mv.index("/usr/bin/env") if "/usr/bin/env" in mv \
+        else mv.index(sys.executable)
+    for tok in mv[mv.index("-hostfile") + 2:exe_at]:
+        assert "=" in tok, f"bare pre-executable token {tok!r}"
+    if "/usr/bin/env" in mv:   # ambient XLA_FLAGS has spaces under pytest
+        quoted = mv[mv.index("/usr/bin/env") + 1:mv.index(sys.executable)]
+        import shlex
+        for q in quoted:
+            assert " " not in shlex.split(q)[0].split("=", 1)[0]
 
 
 def test_local_launch_end_to_end(tmp_path):
