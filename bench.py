@@ -66,19 +66,35 @@ def _pin_cpu() -> None:
         pass
 
 
-def _dense_peak_tflops(n=4096, iters=30) -> float:
-    """Achievable bf16 MXU rate on this chip — the MFU denominator."""
+def _dense_peak_tflops(n=4096, iters=100) -> float:
+    """Achievable bf16 MXU rate on this chip — the MFU denominator.
+
+    Twin of tools/perf_sweep.py chip_matmul_tflops (bench.py must stay a
+    standalone single file for the driver) — fix both together.
+
+    The iteration chain lives INSIDE one jit (lax.fori_loop with a data
+    dependency between matmuls), so the whole measurement is a single
+    dispatch. The earlier one-dispatch-per-matmul loop measured tunnel
+    RTT, not the MXU (18.6 "TFLOPS" on a chip whose model step was
+    simultaneously achieving 26+ — an MFU denominator below the
+    numerator)."""
     import jax
     import jax.numpy as jnp
 
     x = jnp.ones((n, n), jnp.bfloat16)
-    f = jax.jit(lambda a, b: a @ b)
-    y = f(x, x).block_until_ready()
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        y = f(y, x)
-    y.block_until_ready()
-    return iters * 2 * n**3 / (time.perf_counter() - t0) / 1e12
+
+    @jax.jit
+    def chain(y, x):
+        return jax.lax.fori_loop(
+            0, iters, lambda i, y: jax.lax.dot(y, x), y)
+
+    y = chain(x, x).block_until_ready()  # compile
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        chain(y, x).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return iters * 2 * n**3 / best / 1e12
 
 
 def run_bench(on_tpu: bool) -> dict:

@@ -67,19 +67,20 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
     DeepSpeedTransformerLayer.
 
     impl: "auto" (pallas on TPU when tileable), "pallas", "xla".
-    The Pallas path has no attention-matrix dropout (flash kernels keep
-    probabilities implicit); with dropout active in training we use XLA.
+    The Pallas path applies probability dropout in-kernel (hash-generated
+    tile masks, no [S, S] materialisation); an additive bias still routes
+    to XLA.
     """
     S, D = q.shape[1], q.shape[3]
     want_dropout = train and dropout_rate > 0.0 and dropout_rng is not None
     use_pallas = False
     if impl == "pallas":
-        # the flash kernel carries no bias/probability-dropout; honoring
-        # those args wins over the impl request (silent mask-dropping is
-        # numerically wrong)
-        use_pallas = not (want_dropout or bias is not None)
+        # the flash kernel carries no additive bias; honoring that arg wins
+        # over the impl request (silently dropping a mask is numerically
+        # wrong)
+        use_pallas = bias is None
     elif impl == "auto":
-        use_pallas = (_on_tpu() and not want_dropout and bias is None
+        use_pallas = (_on_tpu() and bias is None
                       and S >= _FLASH_MIN_SEQ and S % 128 == 0
                       and k.shape[1] % 128 == 0 and D in (64, 128, 256))
     if use_pallas:
@@ -89,8 +90,10 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
         bq = block_q or DEFAULT_BLOCK_Q
         bk = block_k or DEFAULT_BLOCK_K
         if S % bq == 0 and k.shape[1] % bk == 0:
-            return flash_attention(q, k, v, causal=causal, scale=scale,
-                                   block_q=bq, block_k=bk)
+            return flash_attention(
+                q, k, v, causal=causal, scale=scale, block_q=bq, block_k=bk,
+                dropout_rate=dropout_rate if want_dropout else 0.0,
+                dropout_rng=dropout_rng if want_dropout else None)
         if block_q or block_k:
             # explicit tuning request that cannot tile: say so instead of
             # silently paying the O(S^2) XLA path

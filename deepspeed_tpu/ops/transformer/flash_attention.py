@@ -35,6 +35,39 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+# ---------------------------------------------------------------------------
+# probability dropout
+# ---------------------------------------------------------------------------
+# The reference's attention core applies dropout to the softmax
+# probabilities inside the fused kernel (csrc/transformer/dropout_kernels.cu
+# via ds_transformer_cuda.cpp). Flash kernels keep probabilities implicit,
+# so the mask is REGENERATED tile-by-tile — in the forward and in both
+# backward kernels — from (seed, batch·head, global q idx, global k idx)
+# with a counter-based integer hash. Pure uint32 arithmetic: identical
+# values under the Pallas interpreter (CPU tests) and Mosaic (TPU), and no
+# hardware-PRNG state to thread across grid programs. The hash is over
+# GLOBAL indices, so the mask is invariant to block-size tuning.
+
+def _keep_mask(seed, bh, q0, k0, bq, bk, rate):
+    """fp32 {0, 1/keep} matrix for the (bq, bk) tile at rows q0+, cols k0+.
+
+    murmur3-finalizer-style mixing; keep iff hash < keep·2^32. E[mask] = 1,
+    so attention stays unbiased (inverted-dropout scaling)."""
+    keep = 1.0 - rate
+    u = jnp.uint32
+    qi = q0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 0)
+    ki = k0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 1)
+    h = (seed.astype(u) * u(0x9E3779B1)) ^ (bh.astype(u) * u(0x7FEB352D)) \
+        ^ (qi * u(0x85EBCA6B)) ^ (ki * u(0xC2B2AE35))
+    h = h ^ (h >> 15)
+    h = h * u(0x2C1B3C6D)
+    h = h ^ (h >> 12)
+    h = h * u(0x297A2D39)
+    h = h ^ (h >> 15)
+    thresh = u(min(0xFFFFFFFF, int(keep * 4294967296.0)))
+    return (h < thresh).astype(jnp.float32) * (1.0 / keep)
+
+
 def _compiler_params():
     return pltpu.CompilerParams(
         dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY))
@@ -44,8 +77,9 @@ def _compiler_params():
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
-                scale, causal, bq, bk, nk):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s,
+                l_s, *, scale, causal, bq, bk, nk, rate):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -72,7 +106,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
+        # the softmax denominator accumulates the UNdropped p (dropout acts
+        # on normalized probabilities); only the value accumulation sees the
+        # dropped, 1/keep-rescaled probabilities
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            p = p * _keep_mask(seed_ref[0], bh,
+                               qi * bq, ki * bk, bq, bk, rate)
         acc[:] = acc[:] * alpha + jnp.dot(
             p.astype(v_ref.dtype), v_ref[0],
             preferred_element_type=jnp.float32)
@@ -92,16 +132,17 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
                                       (bq, 128))
 
 
-def _fwd(q, k, v, causal, scale, bq, bk):
+def _fwd(q, k, v, seed, causal, scale, bq, bk, rate):
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // bq, Sk // bk
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               bq=bq, bk=bk, nk=nk, rate=rate)
     out, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
@@ -121,7 +162,7 @@ def _fwd(q, k, v, causal, scale, bq, bk):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v)
+    )(seed, q, k, v)
     return out, lse
 
 
@@ -129,8 +170,9 @@ def _fwd(q, k, v, causal, scale, bq, bk):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, bq, bk, nk):
+def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, scale, causal, bq, bk, nk, rate):
+    bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -155,6 +197,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            # dS = P ∘ (mask/keep ∘ dPd − delta); delta = rowsum(dO∘O)
+            # equals rowsum(Pd∘dPd), so the no-dropout delta trick holds
+            dp = dp * _keep_mask(seed_ref[0], bh,
+                                 qi * bq, ki * bk, bq, bk, rate)
         ds = p * (dp - delta_ref[0][:, :1])
         dq_acc[:] += scale * jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
                                      preferred_element_type=jnp.float32)
@@ -166,8 +213,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk, nq):
+def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
+                nq, rate):
+    bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -190,12 +239,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jnp.where(qidx >= kidx, s, NEG_INF)
         p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk)
         do = do_ref[0].astype(jnp.float32)             # (bq, D)
+        if rate > 0.0:
+            # same (seed, bh, global q, global k) hash as the forward —
+            # this kernel's grid swaps (ki, qi) but the mask arguments
+            # stay in global-index order, so the tiles agree
+            mask = _keep_mask(seed_ref[0], bh,
+                              qi * bq, ki * bk, bq, bk, rate)
+            pd = p * mask
+            dp_scale = mask
+        else:
+            pd = p
+            dp_scale = None
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # p^T @ do
+            pd, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # Pd^T @ do
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if dp_scale is not None:
+            dp = dp * dp_scale
         ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[:] += scale * jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
@@ -208,8 +270,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, bq, bk, res, dout):
-    q, k, v, out, lse = res
+def _bwd(causal, scale, bq, bk, rate, res, dout):
+    q, k, v, seed, out, lse = res
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // bq, Sk // bk
@@ -219,9 +281,10 @@ def _bwd(causal, scale, bq, bk, res, dout):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, rate=rate),
         grid=(BH, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
@@ -234,13 +297,14 @@ def _bwd(causal, scale, bq, bk, res, dout):
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, dout, lse, delta)
+    )(seed, q, k, v, dout, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, rate=rate),
         grid=(BH, nk, nq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
@@ -262,7 +326,7 @@ def _bwd(causal, scale, bq, bk, res, dout):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(q, k, v, dout, lse, delta)
+    )(seed, q, k, v, dout, lse, delta)
     return dq, dk, dv
 
 
@@ -270,19 +334,19 @@ def _bwd(causal, scale, bq, bk, res, dout):
 # public entry (BSHD) with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, causal, scale, bq, bk):
-    out, _ = _fwd(q, k, v, causal, scale, bq, bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, seed, causal, scale, bq, bk, rate):
+    out, _ = _fwd(q, k, v, seed, causal, scale, bq, bk, rate)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, bq, bk):
-    out, lse = _fwd(q, k, v, causal, scale, bq, bk)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, seed, causal, scale, bq, bk, rate):
+    out, lse = _fwd(q, k, v, seed, causal, scale, bq, bk, rate)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, bq, bk, res, dout):
-    return _bwd(causal, scale, bq, bk, res, dout)
+def _flash_bwd_rule(causal, scale, bq, bk, rate, res, dout):
+    return (*_bwd(causal, scale, bq, bk, rate, res, dout), None)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -291,19 +355,37 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 def flash_attention(q, k, v, causal: bool = True,
                     scale: Optional[float] = None,
                     block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_k: int = DEFAULT_BLOCK_K,
+                    dropout_rate: float = 0.0,
+                    dropout_rng=None):
     """Flash attention over [B, S, H, D] inputs (BSHD), causal or full.
 
     Requires S % block_q == 0 and S_k % block_k == 0 (the dispatcher in
     attention.py falls back to XLA otherwise).
+
+    dropout_rate > 0 with a dropout_rng applies probability dropout inside
+    the kernel (reference: attention-probability dropout in the fused CUDA
+    layer, csrc/transformer/dropout_kernels.cu) — the mask is hash-generated
+    per tile from a per-call seed, never materialised at [S, S], and
+    regenerated identically in the backward kernels.
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
     if S % block_q or Sk % block_k:
         raise ValueError(f"seq lens ({S},{Sk}) not divisible by blocks "
                          f"({block_q},{block_k})")
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
     scale = (D ** -0.5) if scale is None else scale
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        seed = jax.random.randint(dropout_rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+        rate = float(dropout_rate)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+        rate = 0.0
     to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, t.shape[1], D)
-    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, scale,
-                      block_q, block_k)
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), seed, causal,
+                      scale, block_q, block_k, rate)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

@@ -83,3 +83,122 @@ def test_flash_nondefault_blocks_match_xla(bq, bk):
     got = flash_attention(q, k, v, causal=True, block_q=bq, block_k=bk)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel probability dropout
+# ---------------------------------------------------------------------------
+
+def _host_keep_mask(seed, BH, S, Sk, rate):
+    """numpy replica of flash_attention._keep_mask over the full [S, Sk]
+    plane — the kernel's mask is a pure index hash, so the test can
+    reconstruct it exactly and feed an explicitly-masked reference."""
+    keep = 1.0 - rate
+    u32 = np.uint32
+    bh = np.arange(BH, dtype=u32)[:, None, None]
+    qi = np.arange(S, dtype=u32)[None, :, None]
+    ki = np.arange(Sk, dtype=u32)[None, None, :]
+    with np.errstate(over="ignore"):
+        h = ((u32(seed) * u32(0x9E3779B1)) ^ (bh * u32(0x7FEB352D))
+             ^ (qi * u32(0x85EBCA6B)) ^ (ki * u32(0xC2B2AE35)))
+        h = h ^ (h >> u32(15))
+        h = h * u32(0x2C1B3C6D)
+        h = h ^ (h >> u32(12))
+        h = h * u32(0x297A2D39)
+        h = h ^ (h >> u32(15))
+    thresh = u32(min(0xFFFFFFFF, int(keep * 4294967296.0)))
+    return (h < thresh).astype(np.float32) / keep
+
+
+def _masked_ref_attention(q, k, v, mask_bhss, causal):
+    """Reference attention with an explicit probability-dropout mask."""
+    B, S, H, D = q.shape
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (D ** -0.5)
+    if causal:
+        qi = jnp.arange(S)[:, None]
+        ki = jnp.arange(S)[None, :]
+        scores = jnp.where(qi >= ki, scores, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = probs * mask_bhss.reshape(B, H, S, S)
+    return jnp.einsum("bhqk,bkhd->bqhd",
+                      probs.astype(v.dtype), v).astype(q.dtype)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_dropout_forward_matches_masked_ref(causal):
+    B, S, H, D, rate = 1, 256, 2, 64, 0.3
+    q, k, v = _make_qkv(jax.random.PRNGKey(6), B=B, S=S, H=H, D=D)
+    rng = jax.random.PRNGKey(42)
+    seed = int(jax.random.randint(rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    mask = _host_keep_mask(seed, B * H, S, S, rate)
+    want = _masked_ref_attention(q, k, v, jnp.asarray(mask), causal)
+    got = flash_attention(q, k, v, causal=causal, dropout_rate=rate,
+                          dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_dropout_backward_matches_masked_ref(causal):
+    B, S, H, D, rate = 1, 256, 2, 64, 0.2
+    q, k, v = _make_qkv(jax.random.PRNGKey(7), B=B, S=S, H=H, D=D)
+    rng = jax.random.PRNGKey(43)
+    seed = int(jax.random.randint(rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    mask = jnp.asarray(_host_keep_mask(seed, B * H, S, S, rate))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                       dropout_rate=rate,
+                                       dropout_rng=rng) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_masked_ref_attention(q, k, v, mask, causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_dropout_mask_invariant_to_blocks():
+    """The hash is over GLOBAL indices: retuning block sizes must not
+    change which probabilities are dropped (fwd outputs identical)."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(8), B=1, S=512, H=2, D=64)
+    rng = jax.random.PRNGKey(44)
+    a = flash_attention(q, k, v, dropout_rate=0.25, dropout_rng=rng,
+                        block_q=128, block_k=128)
+    b = flash_attention(q, k, v, dropout_rate=0.25, dropout_rng=rng,
+                        block_q=256, block_k=512)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               atol=1e-6, rtol=1e-6)
+
+
+def test_flash_dropout_seed_sensitivity_and_rate():
+    q, k, v = _make_qkv(jax.random.PRNGKey(9), B=1, S=256, H=2, D=64)
+    r1, r2 = jax.random.PRNGKey(1), jax.random.PRNGKey(2)
+    a = flash_attention(q, k, v, dropout_rate=0.5, dropout_rng=r1)
+    b = flash_attention(q, k, v, dropout_rate=0.5, dropout_rng=r2)
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    # empirical keep fraction of the host-replica mask tracks 1 - rate
+    m = _host_keep_mask(12345, 2, 256, 256, 0.5)
+    assert abs((m > 0).mean() - 0.5) < 0.02
+
+
+def test_dispatch_pallas_impl_routes_dropout_in_kernel():
+    """impl='pallas' with dropout must use the in-kernel mask (bit-exact
+    with flash_attention's own dropout path), not fall back to XLA."""
+    q, k, v = _make_qkv(jax.random.PRNGKey(10), B=1, S=256, H=2, D=64)
+    rng = jax.random.PRNGKey(3)
+    via_dispatch = multihead_attention(q, k, v, impl="pallas",
+                                       dropout_rate=0.4, dropout_rng=rng,
+                                       train=True)
+    direct = flash_attention(q, k, v, dropout_rate=0.4, dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(via_dispatch), np.asarray(direct),
+                               atol=0, rtol=0)
