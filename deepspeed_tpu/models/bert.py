@@ -47,6 +47,7 @@ class BertConfig:
     sparsity_config: Any = None      # block-sparse attention (SparseAttentionUtils)
     remat: bool = False
     attn_impl: str = "auto"
+    loss_chunks: int = 0             # MLM CE chunking: 0 auto, 1 off, n chunks
     param_dtype: Any = jnp.float32
     compute_dtype: Any = jnp.bfloat16
 
@@ -196,33 +197,55 @@ class Bert(TrainModule):
             x = self._ln(x, params["final_ln_w"], params["final_ln_b"])
         return x
 
+    def _mlm_hidden(self, params, x):
+        """MLM-head transform (gelu + LN) shared by apply() and loss()."""
+        mh = params["mlm_head"]
+        h = jax.nn.gelu(x @ mh["w"].astype(x.dtype) + mh["b"].astype(x.dtype),
+                        approximate=True)
+        return self._ln(h, mh["ln_w"], mh["ln_b"])
+
+    def _nsp_logits(self, params, x):
+        pooled = jnp.tanh(x[:, 0, :] @ params["pooler"]["w"].astype(x.dtype) +
+                          params["pooler"]["b"].astype(x.dtype))
+        return pooled @ params["nsp_head"]["w"].astype(x.dtype) + \
+            params["nsp_head"]["b"].astype(x.dtype)
+
     def apply(self, params, batch, rng=None, train=False):
         x = self.encode(params, batch["input_ids"],
                         batch.get("token_type_ids"),
                         batch.get("attention_mask"), rng=rng, train=train)
-        mh = params["mlm_head"]
-        h = jax.nn.gelu(x @ mh["w"].astype(x.dtype) + mh["b"].astype(x.dtype),
-                        approximate=True)
-        h = self._ln(h, mh["ln_w"], mh["ln_b"])
+        h = self._mlm_hidden(params, x)
         # tied decoder: embeddings.word^T (reference BERT ties MLM decoder)
         logits = h @ params["embeddings"]["word"].astype(x.dtype).T + \
-            mh["decoder_b"].astype(x.dtype)
-        pooled = jnp.tanh(x[:, 0, :] @ params["pooler"]["w"].astype(x.dtype) +
-                          params["pooler"]["b"].astype(x.dtype))
-        nsp = pooled @ params["nsp_head"]["w"].astype(x.dtype) + \
-            params["nsp_head"]["b"].astype(x.dtype)
-        return logits, nsp
+            params["mlm_head"]["decoder_b"].astype(x.dtype)
+        return logits, self._nsp_logits(params, x)
 
     def loss(self, params, batch, rng=None, train=True):
-        logits, nsp = self.apply(params, batch, rng=rng, train=train)
+        # streamed MLM cross entropy: hidden states and the tied decoder
+        # weight go straight to summed NLL via the GPT family's fused
+        # projection+CE (logsumexp − label logit) — no [B, S, V] fp32
+        # log-softmax is materialised (~2 GB at the reference's seq-128
+        # micro-64 pretraining recipe). apply() keeps returning full
+        # logits for inference and the HF parity oracle.
+        from .gpt import _softmax_xent_from_hidden
+
+        x = self.encode(params, batch["input_ids"],
+                        batch.get("token_type_ids"),
+                        batch.get("attention_mask"), rng=rng, train=train)
+        h = self._mlm_hidden(params, x)
         labels = batch["mlm_labels"]
         mask = (labels != -100)
         safe = jnp.where(mask, labels, 0)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        B, S, D = h.shape
+        w = params["embeddings"]["word"].astype(h.dtype).T  # tied decoder
+        total = _softmax_xent_from_hidden(
+            h.reshape(B * S, D), w, safe.reshape(-1), mask.reshape(-1),
+            self.config.loss_chunks,
+            bias=params["mlm_head"]["decoder_b"])
         denom = jnp.maximum(mask.sum(), 1)
-        loss = jnp.where(mask, nll, 0.0).sum() / denom
+        loss = total / denom
         if "nsp_labels" in batch:
+            nsp = self._nsp_logits(params, x)
             nsp_logp = jax.nn.log_softmax(nsp.astype(jnp.float32), axis=-1)
             loss = loss - jnp.mean(
                 jnp.take_along_axis(nsp_logp,

@@ -315,7 +315,7 @@ def _ce_rows(logits32, labels, valid):
 
 
 def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
-                              impl="auto"):
+                              impl="auto", bias=None):
     """Fused projection + cross entropy: hidden states [N, D] and the [D, V]
     head weight go straight to summed NLL without a [N, V] activation
     surviving the loss.
@@ -334,6 +334,12 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
     N, D = x.shape
     V = w.shape[-1]
 
+    if impl == "pallas" and bias is not None:
+        from ..utils.logging import logger
+
+        logger.warning("loss_impl='pallas': fused kernel carries no "
+                       "decoder bias; using the XLA path")
+        impl = "xla"
     if impl == "pallas":
         from ..comm.mesh import peek_mesh
         from ..ops.transformer.fused_xent import fused_softmax_xent_sum
@@ -358,8 +364,11 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
                        f"lane-aligned block divisor; using the XLA path")
 
     def project(rows):
-        return jax.lax.dot_general(rows, w, (((1,), (0,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
+        out = jax.lax.dot_general(rows, w, (((1,), (0,)), ((), ())),
+                                  preferred_element_type=jnp.float32)
+        if bias is not None:
+            out = out + bias.astype(jnp.float32)
+        return out
 
     if n_chunks == 0:  # auto: only chunk when the logits buffer is large
         if V >= 4096 and N >= 4096:

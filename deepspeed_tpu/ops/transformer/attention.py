@@ -68,21 +68,28 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
 
     impl: "auto" (pallas on TPU when tileable), "pallas", "xla".
     The Pallas path applies probability dropout in-kernel (hash-generated
-    tile masks, no [S, S] materialisation); an additive bias still routes
-    to XLA.
+    tile masks, no [S, S] materialisation) and accepts per-key additive
+    biases ([B, 1, 1, Sk] — the BERT padding-mask shape) in-kernel too;
+    only a full [.., S, Sk] bias (e.g. relative-position) routes to XLA.
     """
-    S, D = q.shape[1], q.shape[3]
+    B, S, D = q.shape[0], q.shape[1], q.shape[3]
+    Sk = k.shape[1]
     want_dropout = train and dropout_rate > 0.0 and dropout_rng is not None
+    key_bias = None
+    if bias is not None and getattr(bias, "ndim", 0) == 4 \
+            and bias.shape[1] == 1 and bias.shape[2] == 1 \
+            and bias.shape[3] == Sk and bias.shape[0] in (1, B):
+        key_bias = bias
     use_pallas = False
     if impl == "pallas":
-        # the flash kernel carries no additive bias; honoring that arg wins
-        # over the impl request (silently dropping a mask is numerically
-        # wrong)
-        use_pallas = bias is None
+        # the flash kernel carries per-key biases only; honoring a full
+        # [.., S, Sk] bias wins over the impl request (silently dropping
+        # a mask is numerically wrong)
+        use_pallas = bias is None or key_bias is not None
     elif impl == "auto":
-        use_pallas = (_on_tpu() and bias is None
+        use_pallas = (_on_tpu() and (bias is None or key_bias is not None)
                       and S >= _FLASH_MIN_SEQ and S % 128 == 0
-                      and k.shape[1] % 128 == 0 and D in (64, 128, 256))
+                      and Sk % 128 == 0 and D in (64, 128, 256))
     if use_pallas:
         from .flash_attention import (DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q,
                                       flash_attention)
@@ -93,7 +100,8 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
             return flash_attention(
                 q, k, v, causal=causal, scale=scale, block_q=bq, block_k=bk,
                 dropout_rate=dropout_rate if want_dropout else 0.0,
-                dropout_rng=dropout_rng if want_dropout else None)
+                dropout_rng=dropout_rng if want_dropout else None,
+                key_bias=key_bias)
         if block_q or block_k:
             # explicit tuning request that cannot tile: say so instead of
             # silently paying the O(S^2) XLA path

@@ -77,8 +77,12 @@ def _compiler_params():
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s,
-                l_s, *, scale, causal, bq, bk, nk, rate):
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal, bq,
+                bk, nk, rate, has_bias):
+    if has_bias:
+        kb_ref, o_ref, lse_ref, acc, m_s, l_s = rest
+    else:
+        o_ref, lse_ref, acc, m_s, l_s = rest
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -101,10 +105,18 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s,
             qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qidx >= kidx, s, NEG_INF)
+        if has_bias:
+            s = s + kb_ref[...]  # (1, bk) per-key additive bias, row-bcast
         m_prev = m_s[:, :1]
         l_prev = l_s[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
+        if has_bias:
+            # a fully-masked tile leaves m_new at ~NEG_INF, where
+            # exp(s - m_new) = 1 for every masked entry — zero them
+            # explicitly (the causal-only path never hits this: the
+            # diagonal tile always has a live entry per row)
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         alpha = jnp.exp(m_prev - m_new)
         # the softmax denominator accumulates the UNdropped p (dropout acts
         # on normalized probabilities); only the value accumulation sees the
@@ -132,21 +144,30 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s,
                                       (bq, 128))
 
 
-def _fwd(q, k, v, seed, causal, scale, bq, bk, rate):
+def _fwd(q, k, v, seed, kb, causal, scale, bq, bk, rate, n_heads):
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // bq, Sk // bk
+    has_bias = kb is not None
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk, rate=rate)
+                               bq=bq, bk=bk, nk=nk, rate=rate,
+                               has_bias=has_bias)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [seed, q, k, v]
+    if has_bias:
+        # [B, Sk] per-key bias; BH programs map back to batch b // H
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda b, i, j: (b // n_heads, j)))
+        operands.append(kb)
     out, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
@@ -162,7 +183,7 @@ def _fwd(q, k, v, seed, causal, scale, bq, bk, rate):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(seed, q, k, v)
+    )(*operands)
     return out, lse
 
 
@@ -171,7 +192,11 @@ def _fwd(q, k, v, seed, causal, scale, bq, bk, rate):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_acc, *, scale, causal, bq, bk, nk, rate):
+               *rest, scale, causal, bq, bk, nk, rate, has_bias):
+    if has_bias:
+        kb_ref, dq_ref, dq_acc = rest
+    else:
+        dq_ref, dq_acc = rest
     bh = pl.program_id(0)
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -192,7 +217,13 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qidx >= kidx, s, NEG_INF)
+        if has_bias:
+            s = s + kb_ref[...]
         p = jnp.exp(s - lse_ref[0][:, :1])
+        if has_bias:
+            # fully-masked rows carry lse ≈ NEG_INF; exp(s - lse) would
+            # resurrect masked entries — zero them like the forward does
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         do = do_ref[0].astype(jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
@@ -214,8 +245,11 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, bq, bk,
-                nq, rate):
+                *rest, scale, causal, bq, bk, nq, rate, has_bias):
+    if has_bias:
+        kb_ref, dk_ref, dv_ref, dk_acc, dv_acc = rest
+    else:
+        dk_ref, dv_ref, dk_acc, dv_acc = rest
     bh = pl.program_id(0)
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -237,7 +271,11 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             qidx = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kidx = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qidx >= kidx, s, NEG_INF)
+        if has_bias:
+            s = s + kb_ref[...]
         p = jnp.exp(s - lse_ref[0][:, :1])              # (bq, bk)
+        if has_bias:
+            p = jnp.where(s <= NEG_INF * 0.5, 0.0, p)
         do = do_ref[0].astype(jnp.float32)             # (bq, D)
         if rate > 0.0:
             # same (seed, bh, global q, global k) hash as the forward —
@@ -270,48 +308,63 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, bq, bk, rate, res, dout):
-    q, k, v, seed, out, lse = res
+def _bwd(causal, scale, bq, bk, rate, n_heads, res, dout):
+    q, k, v, seed, kb, out, lse = res
     BH, S, D = q.shape
     Sk = k.shape[1]
     nq, nk = S // bq, Sk // bk
+    has_bias = kb is not None
     delta = jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1)  # (BH, S)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
 
+    dq_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
+    ]
+    dq_operands = [seed, q, k, v, dout, lse, delta]
+    if has_bias:
+        dq_specs.append(
+            pl.BlockSpec((1, bk), lambda b, i, j: (b // n_heads, j)))
+        dq_operands.append(kb)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nk=nk, rate=rate),
+                          bq=bq, bk=bk, nk=nk, rate=rate,
+                          has_bias=has_bias),
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i, j: (b, i, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(seed, q, k, v, dout, lse, delta)
+    )(*dq_operands)
 
+    dkv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
+    ]
+    dkv_operands = [seed, q, k, v, dout, lse, delta]
+    if has_bias:
+        dkv_specs.append(
+            pl.BlockSpec((1, bk), lambda b, j, i: (b // n_heads, j)))
+        dkv_operands.append(kb)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nq=nq, rate=rate),
+                          bq=bq, bk=bk, nq=nq, rate=rate,
+                          has_bias=has_bias),
         grid=(BH, nk, nq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, j, i: (b, i, 0)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
@@ -326,7 +379,7 @@ def _bwd(causal, scale, bq, bk, rate, res, dout):
         ],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
-    )(seed, q, k, v, dout, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -334,19 +387,21 @@ def _bwd(causal, scale, bq, bk, rate, res, dout):
 # public entry (BSHD) with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, seed, causal, scale, bq, bk, rate):
-    out, _ = _fwd(q, k, v, seed, causal, scale, bq, bk, rate)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_bhsd(q, k, v, seed, kb, causal, scale, bq, bk, rate, n_heads):
+    out, _ = _fwd(q, k, v, seed, kb, causal, scale, bq, bk, rate, n_heads)
     return out
 
 
-def _flash_fwd_rule(q, k, v, seed, causal, scale, bq, bk, rate):
-    out, lse = _fwd(q, k, v, seed, causal, scale, bq, bk, rate)
-    return out, (q, k, v, seed, out, lse)
+def _flash_fwd_rule(q, k, v, seed, kb, causal, scale, bq, bk, rate,
+                    n_heads):
+    out, lse = _fwd(q, k, v, seed, kb, causal, scale, bq, bk, rate, n_heads)
+    return out, (q, k, v, seed, kb, out, lse)
 
 
-def _flash_bwd_rule(causal, scale, bq, bk, rate, res, dout):
-    return (*_bwd(causal, scale, bq, bk, rate, res, dout), None)
+def _flash_bwd_rule(causal, scale, bq, bk, rate, n_heads, res, dout):
+    return (*_bwd(causal, scale, bq, bk, rate, n_heads, res, dout),
+            None, None)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -357,7 +412,8 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K,
                     dropout_rate: float = 0.0,
-                    dropout_rng=None):
+                    dropout_rng=None,
+                    key_bias=None):
     """Flash attention over [B, S, H, D] inputs (BSHD), causal or full.
 
     Requires S % block_q == 0 and S_k % block_k == 0 (the dispatcher in
@@ -368,6 +424,12 @@ def flash_attention(q, k, v, causal: bool = True,
     layer, csrc/transformer/dropout_kernels.cu) — the mask is hash-generated
     per tile from a per-call seed, never materialised at [S, S], and
     regenerated identically in the backward kernels.
+
+    key_bias is a per-key additive bias, [B, Sk] or [B, 1, 1, Sk] fp32
+    (the BERT padding-mask convention: 0 keep, large-negative masked;
+    reference adds it pre-softmax in softmax_kernels.cu). Rows whose keys
+    are ALL masked produce zero output (the XLA path's softmax yields a
+    uniform don't-care row there instead).
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
@@ -385,7 +447,13 @@ def flash_attention(q, k, v, causal: bool = True,
     else:
         seed = jnp.zeros((1,), jnp.int32)
         rate = 0.0
+    kb = None
+    if key_bias is not None:
+        kb = jnp.asarray(key_bias, jnp.float32).reshape(-1, Sk)
+        kb = jnp.broadcast_to(kb, (B, Sk))
+        # clamp so s + bias stays finite (finfo.min would NaN the exp)
+        kb = jnp.maximum(kb, NEG_INF)
     to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, t.shape[1], D)
-    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), seed, causal,
-                      scale, block_q, block_k, rate)
+    out = _flash_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v), seed, kb, causal,
+                      scale, block_q, block_k, rate, H)
     return out.reshape(B, H, S, D).transpose(0, 2, 1, 3)

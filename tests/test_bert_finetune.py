@@ -97,3 +97,52 @@ def test_squad_style_finetune_em():
     # the reference asserts absolute EM/F1 after real SQuAD; here the
     # synthetic answer is fully recoverable, so EM must become strong
     assert em0 < 0.1 and em1 > 0.8, (em0, em1)
+
+
+def test_streamed_mlm_loss_matches_naive_formula():
+    """Bert.loss streams projection+CE (no [B,S,V] log-softmax buffer);
+    it must agree with the naive full-log-softmax formula it replaced."""
+    cfg = bert_config("bert-base", num_layers=2, num_heads=2, d_model=32,
+                      vocab_size=256, max_seq_len=32,
+                      attn_dropout=0.0, hidden_dropout=0.0)
+    model = Bert(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.RandomState(0)
+    B = 2
+    ids = rng.randint(0, 256, size=(B, S)).astype(np.int32)
+    labels = np.full((B, S), -100, np.int32)
+    m = rng.rand(B, S) < 0.2
+    labels[m] = ids[m]
+    batch = {"input_ids": jnp.asarray(ids),
+             "mlm_labels": jnp.asarray(labels),
+             "nsp_labels": jnp.asarray(rng.randint(0, 2, size=(B,)))}
+
+    got = model.loss(params, batch, train=False)
+
+    logits, nsp = model.apply(params, batch, train=False)
+    mask = labels != -100
+    safe = np.where(mask, labels, 0)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, jnp.asarray(safe)[..., None],
+                               axis=-1)[..., 0]
+    want = jnp.where(jnp.asarray(mask), nll, 0.0).sum() / max(mask.sum(), 1)
+    nsp_logp = jax.nn.log_softmax(nsp.astype(jnp.float32), axis=-1)
+    want = want - jnp.mean(jnp.take_along_axis(
+        nsp_logp, batch["nsp_labels"][:, None], axis=-1))
+    np.testing.assert_allclose(float(got), float(want), rtol=2e-3)
+
+
+def test_streamed_mlm_loss_chunked_matches_unchunked():
+    cfg = bert_config("bert-base", num_layers=1, num_heads=2, d_model=32,
+                      vocab_size=128, max_seq_len=32,
+                      attn_dropout=0.0, hidden_dropout=0.0)
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, 128, size=(2, S)).astype(np.int32)
+    labels = np.where(rng.rand(2, S) < 0.3, ids, -100).astype(np.int32)
+    batch = {"input_ids": jnp.asarray(ids), "mlm_labels": jnp.asarray(labels)}
+    params = Bert(cfg).init(jax.random.PRNGKey(1))
+    a = Bert(bert_config("bert-base", **{**cfg.__dict__})).loss(
+        params, batch, train=False)
+    cfg4 = bert_config("bert-base", **{**cfg.__dict__, "loss_chunks": 4})
+    b = Bert(cfg4).loss(params, batch, train=False)
+    np.testing.assert_allclose(float(a), float(b), rtol=1e-5)

@@ -202,3 +202,100 @@ def test_dispatch_pallas_impl_routes_dropout_in_kernel():
     direct = flash_attention(q, k, v, dropout_rate=0.4, dropout_rng=rng)
     np.testing.assert_allclose(np.asarray(via_dispatch), np.asarray(direct),
                                atol=0, rtol=0)
+
+
+# ---------------------------------------------------------------------------
+# per-key additive bias (padding masks) in-kernel
+# ---------------------------------------------------------------------------
+
+def _padding_bias(valid_lens, S):
+    """BERT-convention additive mask [B, 1, 1, S]: 0 keep, -1e30 masked."""
+    ar = np.arange(S)[None, :]
+    keep = ar < np.asarray(valid_lens)[:, None]
+    return jnp.asarray(np.where(keep, 0.0, -1e30)[:, None, None, :],
+                       jnp.float32)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_key_bias_matches_xla(causal):
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(11), B=B, S=S, H=H, D=D)
+    bias = _padding_bias([200, 131], S)
+    want = xla_attention(q, k, v, causal=causal, bias=bias)
+    got = flash_attention(q, k, v, causal=causal, key_bias=bias)
+    # rows attending only to masked keys differ by convention (flash: 0,
+    # XLA: uniform don't-care); with causal the fully-masked region is
+    # empty here because every query attends at least to itself... only
+    # compare valid query rows for the non-causal case too
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_key_bias_backward_matches_xla():
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(12), B=B, S=S, H=H, D=D)
+    bias = _padding_bias([256, 140], S)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False,
+                                       key_bias=bias) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(xla_attention(q, k, v, causal=False, bias=bias) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr, name in zip(g_flash, g_ref, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-3, rtol=2e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_flash_key_bias_with_dropout_matches_masked_ref():
+    """bias + in-kernel dropout compose: parity vs the host-reconstructed
+    dropout mask applied to a bias-masked reference."""
+    B, S, H, D, rate = 1, 256, 2, 64, 0.25
+    q, k, v = _make_qkv(jax.random.PRNGKey(13), B=B, S=S, H=H, D=D)
+    bias = _padding_bias([190], S)
+    rng = jax.random.PRNGKey(45)
+    seed = int(jax.random.randint(rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    dmask = jnp.asarray(_host_keep_mask(seed, B * H, S, S, rate))
+
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (D ** -0.5)
+    scores = scores + bias
+    probs = jax.nn.softmax(scores, axis=-1) * dmask.reshape(B, H, S, S)
+    want = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+    got = flash_attention(q, k, v, causal=False, key_bias=bias,
+                          dropout_rate=rate, dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_flash_fully_masked_rows_zero_and_finite():
+    B, S, H, D = 1, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(14), B=B, S=S, H=H, D=D)
+    bias = jnp.full((B, 1, 1, S), -1e30, jnp.float32)  # ALL keys masked
+    out = flash_attention(q, k, v, causal=False, key_bias=bias)
+    assert np.isfinite(np.asarray(out)).all()
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+    g = jax.grad(lambda q: jnp.sum(flash_attention(
+        q, k, v, causal=False, key_bias=bias) ** 2))(q)
+    assert np.isfinite(np.asarray(g)).all()
+    np.testing.assert_allclose(np.asarray(g), 0.0, atol=1e-6)
+
+
+def test_dispatch_routes_padding_bias_to_pallas():
+    """impl='pallas' + [B,1,1,S] bias must hit the kernel (bit-identical
+    with flash_attention's key_bias path), not silently fall back."""
+    B, S, H, D = 2, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(15), B=B, S=S, H=H, D=D)
+    bias = _padding_bias([256, 100], S)
+    via = multihead_attention(q, k, v, causal=False, impl="pallas",
+                              bias=bias)
+    direct = flash_attention(q, k, v, causal=False, key_bias=bias)
+    np.testing.assert_allclose(np.asarray(via), np.asarray(direct),
+                               atol=0, rtol=0)

@@ -224,6 +224,15 @@ def main():
         runs += [dict(micro=16), dict(micro=32),
                  dict(micro=16, seq=2048), dict(micro=8, seq=2048),
                  dict(micro=32, remat=True)]
+        if backend != "cpu":
+            # headline-candidate configs: bert128's 55.5 TF at 336M params
+            # vs gpt2-small's 26.5 TF says bigger model + bigger batch is
+            # where MFU lives — measure medium so data picks the bench.py
+            # default
+            runs += [dict(size="medium", micro=8),
+                     dict(size="medium", micro=16),
+                     dict(size="medium", micro=16, remat=True),
+                     dict(size="medium", micro=32, remat=True)]
 
     results = []
     for overrides in runs:

@@ -6,10 +6,18 @@
 #           artifacts are trustworthy).
 # Ordered highest-value-first and committed per-artifact: a five-minute
 # tunnel window still yields the headline number in-repo even if the
-# sweeps never get to run.
-# Exit: 0 iff the headline bench produced a valid on-TPU JSON line
-# (tools/bench_gate.py). Later failures don't fail the session (their rc
-# is in the status file).
+# sweeps never get to run. After the headline, the flash/ce sweeps come
+# BEFORE the bert rows: they are the on-chip tuning data that decides the
+# headline config, and the 07-31 session lost them to a mid-run tunnel
+# drop after spending 40 min on the headroom search.
+# Between phases a cheap subprocess probe checks the tunnel is still up;
+# when it has dropped, the session exits instead of burning each
+# remaining phase's full timeout against a hung backend (the watcher
+# re-probes and relaunches; per-artifact commits make that resumable).
+# Exit: 0 iff the FULL session ran to the end with the headline gate
+# passed. A mid-session tunnel drop exits 1 so the watcher re-probes and
+# relaunches (per-artifact commits make that resumable). Per-phase trust
+# comes from the status file's "name rc" lines, NOT the exit code.
 set -x
 cd "$(dirname "$0")/.."
 STATUS=/tmp/tpu_session_status
@@ -17,17 +25,27 @@ ART=bench_artifacts/r5
 mkdir -p "$ART"
 : > "$STATUS"
 
+alive() { # tunnel liveness: backend init in a killable subprocess
+  timeout 120 python -c \
+    "import jax; assert jax.default_backend() != 'cpu'" 2>/dev/null
+}
+
 run() { # run <name> <timeout> <cmd...> — record rc, never abort the session
   local name=$1 tmo=$2; shift 2
+  if ! alive; then
+    echo "$name skipped-tunnel-down" >> "$STATUS"
+    persist  # flush the status file into the repo
+    exit 1
+  fi
   timeout "$tmo" "$@"
   echo "$name $?" >> "$STATUS"
 }
 
-persist() { # persist <file...> — copy into the repo and commit ONLY those
+persist() { # persist [file...] — copy into the repo and commit ONLY those
   cp -f "$@" "$STATUS" "$ART"/ 2>/dev/null
   git add "$ART" 2>/dev/null && \
-    git commit -m "Record on-TPU artifact: $(basename "$1")" -- "$ART" \
-      >/dev/null 2>&1
+    git commit -m "Record on-TPU artifact: $(basename "${1:-$STATUS}")" \
+      -- "$ART" >/dev/null 2>&1
 }
 
 run bench 1200 python bench.py > /tmp/tpu_bench.json 2>/tmp/tpu_bench.log
@@ -45,21 +63,24 @@ fi
 echo "gate 0" >> "$STATUS"
 persist /tmp/tpu_bench.json
 
-# High-value artifacts next (BERT-large rows vs the reference's 64/53
-# TFLOPS anchor, then memory headroom), each committed as it lands.
+# On-chip tuning data first: which attention impl/blocks and CE chunking
+# win on real hardware — this decides the headline config.
+run sweep_flash  2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
+persist /tmp/tpu_sweep_flash.txt
+run sweep_ce     2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
+persist /tmp/tpu_sweep_ce.txt
+
+# High-value anchor artifacts (BERT-large rows vs the reference's 64/53
+# TFLOPS), each committed as it lands.
 run bert128  1800 python tools/bert_bench.py --seq 128 > /tmp/tpu_bert128.json 2>/tmp/tpu_bert128.log
 persist /tmp/tpu_bert128.json
 run bert512  1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
 persist /tmp/tpu_bert512.json
-run headroom 2400 env DSTPU_BENCH_MODE=headroom python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
-persist /tmp/tpu_headroom.json
 
-run sweep_ce     2400 python tools/perf_sweep.py --phase ce --steps 20 > /tmp/tpu_sweep_ce.txt 2>&1
-persist /tmp/tpu_sweep_ce.txt
-run sweep_flash  2400 python tools/perf_sweep.py --phase flash --steps 20 > /tmp/tpu_sweep_flash.txt 2>&1
-persist /tmp/tpu_sweep_flash.txt
 run sweep_batch  3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
 persist /tmp/tpu_sweep_batch.txt
+run headroom 2400 env DSTPU_BENCH_MODE=headroom python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
+persist /tmp/tpu_headroom.json
 run sweep_sparse 2400 python tools/perf_sweep.py --phase sparse --steps 20 > /tmp/tpu_sweep_sparse.txt 2>&1
 persist /tmp/tpu_sweep_sparse.txt
 run profile      1200 python tools/profile_step.py --outdir /tmp/tpu_trace > /tmp/tpu_profile.log 2>&1
