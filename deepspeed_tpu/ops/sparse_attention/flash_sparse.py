@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..transformer.flash_attention import _keep_mask, derive_seed
+
 NEG_INF = -1e30
 
 
@@ -67,8 +69,8 @@ def _causal_mask(s, qi, kj, blk):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(tbl, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
-                scale, causal, blk, W, H):
+def _fwd_kernel(tbl, seed, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s,
+                l_s, *, scale, causal, blk, W, H, rate):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     a = pl.program_id(2)
@@ -95,6 +97,12 @@ def _fwd_kernel(tbl, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_s[:, :1] = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        if rate > 0.0:
+            # same global-index hash as the dense flash kernel: the mask
+            # depends on token coordinates (via the layout table), so the
+            # dq/dkv walks regenerate identical tiles
+            p = p * _keep_mask(seed[0], b, qi * blk, kj * blk, blk, blk,
+                               rate)
         acc[:] = acc[:] * alpha + jnp.dot(
             p.astype(v_ref.dtype), v_ref[0],
             preferred_element_type=jnp.float32)
@@ -110,7 +118,7 @@ def _fwd_kernel(tbl, q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s, *,
             lse_ref[0].shape)
 
 
-def _fwd(q, k, v, tbl, causal, scale, blk, H):
+def _fwd(q, k, v, tbl, seed, causal, scale, blk, H, rate):
     BH, S, D = q.shape
     nq = S // blk
     W = tbl.shape[-1]
@@ -119,20 +127,20 @@ def _fwd(q, k, v, tbl, causal, scale, blk, H):
         return jnp.maximum(j, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(BH, nq, W),
         in_specs=[
-            pl.BlockSpec((1, blk, D), lambda b, i, a, t: (b, i, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, i, a, t, sd: (b, i, 0)),
             pl.BlockSpec((1, blk, D),
-                         lambda b, i, a, t: (
+                         lambda b, i, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), i, a]), 0)),
             pl.BlockSpec((1, blk, D),
-                         lambda b, i, a, t: (
+                         lambda b, i, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), i, a]), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk, D), lambda b, i, a, t: (b, i, 0)),
-            pl.BlockSpec((1, blk, 128), lambda b, i, a, t: (b, i, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, i, a, t, sd: (b, i, 0)),
+            pl.BlockSpec((1, blk, 128), lambda b, i, a, t, sd: (b, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk, D), jnp.float32),
@@ -141,7 +149,7 @@ def _fwd(q, k, v, tbl, causal, scale, blk, H):
         ],
     )
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               blk=blk, W=W, H=H)
+                               blk=blk, W=W, H=H, rate=rate)
     out, lse = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -153,7 +161,7 @@ def _fwd(q, k, v, tbl, causal, scale, blk, H):
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
                                  pltpu.ARBITRARY)),
         interpret=_interpret(),
-    )(tbl, q, k, v)
+    )(tbl, seed, q, k, v)
     return out, lse
 
 
@@ -161,8 +169,8 @@ def _fwd(q, k, v, tbl, causal, scale, blk, H):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_acc, *, scale, causal, blk, W, H):
+def _dq_kernel(tbl, seed, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_acc, *, scale, causal, blk, W, H, rate):
     b = pl.program_id(0)
     qi = pl.program_id(1)
     a = pl.program_id(2)
@@ -186,6 +194,9 @@ def _dq_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if rate > 0.0:
+            dp = dp * _keep_mask(seed[0], b, qi * blk, kj * blk, blk, blk,
+                                 rate)
         ds = p * (dp - delta_ref[0][:, :1])
         dq_acc[:] += scale * jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
                                      preferred_element_type=jnp.float32)
@@ -195,9 +206,9 @@ def _dq_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(tbl, seed, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, blk, Wq,
-                H):
+                H, rate):
     b = pl.program_id(0)
     kjg = pl.program_id(1)
     a = pl.program_id(2)
@@ -219,12 +230,21 @@ def _dkv_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, qi, kjg, blk)
         p = jnp.exp(s - lse_ref[0][:, :1])
         do = do_ref[0].astype(jnp.float32)
+        if rate > 0.0:
+            mask = _keep_mask(seed[0], b, qi * blk, kjg * blk, blk, blk,
+                              rate)
+            pd = p * mask
+        else:
+            mask = None
+            pd = p
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            pd, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_ref[0].astype(jnp.float32),
                                  (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
+        if mask is not None:
+            dp = dp * mask
         ds = p * (dp - delta_ref[0][:, :1])
         dk_acc[:] += scale * jax.lax.dot_general(
             ds, q_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
@@ -236,9 +256,9 @@ def _dkv_kernel(tbl, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(causal, scale, blk, H, tables, res, dout):
+def _bwd(causal, scale, blk, H, rate, tables, res, dout):
     fwd_tbl, rev_tbl = tables
-    q, k, v, out, lse = res
+    q, k, v, seed, out, lse = res
     BH, S, D = q.shape
     nq = S // blk
     W = fwd_tbl.shape[-1]
@@ -251,56 +271,56 @@ def _bwd(causal, scale, blk, H, tables, res, dout):
         return jnp.maximum(j, 0)
 
     dq_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(BH, nq, W),
         in_specs=[
-            pl.BlockSpec((1, blk, D), lambda b, i, a, t: (b, i, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, i, a, t, sd: (b, i, 0)),
             pl.BlockSpec((1, blk, D),
-                         lambda b, i, a, t: (
+                         lambda b, i, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), i, a]), 0)),
             pl.BlockSpec((1, blk, D),
-                         lambda b, i, a, t: (
+                         lambda b, i, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), i, a]), 0)),
-            pl.BlockSpec((1, blk, D), lambda b, i, a, t: (b, i, 0)),
-            pl.BlockSpec((1, blk, 128), lambda b, i, a, t: (b, i, 0)),
-            pl.BlockSpec((1, blk, 128), lambda b, i, a, t: (b, i, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, i, a, t, sd: (b, i, 0)),
+            pl.BlockSpec((1, blk, 128), lambda b, i, a, t, sd: (b, i, 0)),
+            pl.BlockSpec((1, blk, 128), lambda b, i, a, t, sd: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk, D), lambda b, i, a, t: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, blk, D), lambda b, i, a, t, sd: (b, i, 0)),
         scratch_shapes=[pltpu.VMEM((blk, D), jnp.float32)],
     )
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, blk=blk,
-                          W=W, H=H),
+                          W=W, H=H, rate=rate),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
                                  pltpu.ARBITRARY)),
         interpret=_interpret(),
-    )(fwd_tbl, q, k, v, dout, lse, delta)
+    )(fwd_tbl, seed, q, k, v, dout, lse, delta)
 
     dkv_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
+        num_scalar_prefetch=2,
         grid=(BH, nq, Wq),
         in_specs=[
             pl.BlockSpec((1, blk, D),
-                         lambda b, j, a, t: (
+                         lambda b, j, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), j, a]), 0)),
-            pl.BlockSpec((1, blk, D), lambda b, j, a, t: (b, j, 0)),
-            pl.BlockSpec((1, blk, D), lambda b, j, a, t: (b, j, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, j, a, t, sd: (b, j, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, j, a, t, sd: (b, j, 0)),
             pl.BlockSpec((1, blk, D),
-                         lambda b, j, a, t: (
+                         lambda b, j, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), j, a]), 0)),
             pl.BlockSpec((1, blk, 128),
-                         lambda b, j, a, t: (
+                         lambda b, j, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), j, a]), 0)),
             pl.BlockSpec((1, blk, 128),
-                         lambda b, j, a, t: (
+                         lambda b, j, a, t, sd: (
                              b, clamp(t[jax.lax.rem(b, H), j, a]), 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, blk, D), lambda b, j, a, t: (b, j, 0)),
-            pl.BlockSpec((1, blk, D), lambda b, j, a, t: (b, j, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, j, a, t, sd: (b, j, 0)),
+            pl.BlockSpec((1, blk, D), lambda b, j, a, t, sd: (b, j, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((blk, D), jnp.float32),
@@ -309,7 +329,7 @@ def _bwd(causal, scale, blk, H, tables, res, dout):
     )
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, blk=blk,
-                          Wq=Wq, H=H),
+                          Wq=Wq, H=H, rate=rate),
         grid_spec=dkv_spec,
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
@@ -319,7 +339,7 @@ def _bwd(causal, scale, blk, H, tables, res, dout):
             dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
                                  pltpu.ARBITRARY)),
         interpret=_interpret(),
-    )(rev_tbl, q, k, v, dout, lse, delta)
+    )(rev_tbl, seed, q, k, v, dout, lse, delta)
     return dq, dk, dv
 
 
@@ -327,20 +347,24 @@ def _bwd(causal, scale, blk, H, tables, res, dout):
 # public entry (BSHD) with custom VJP
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_sparse_bhsd(q, k, v, fwd_tbl, rev_tbl, causal, scale, blk, H):
-    out, _ = _fwd(q, k, v, jnp.asarray(fwd_tbl), causal, scale, blk, H)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_sparse_bhsd(q, k, v, seed, fwd_tbl, rev_tbl, causal, scale, blk,
+                       H, rate):
+    out, _ = _fwd(q, k, v, jnp.asarray(fwd_tbl), seed, causal, scale, blk,
+                  H, rate)
     return out
 
 
-def _fwd_rule(q, k, v, fwd_tbl, rev_tbl, causal, scale, blk, H):
-    out, lse = _fwd(q, k, v, jnp.asarray(fwd_tbl), causal, scale, blk, H)
-    return out, (q, k, v, out, lse)
+def _fwd_rule(q, k, v, seed, fwd_tbl, rev_tbl, causal, scale, blk, H, rate):
+    out, lse = _fwd(q, k, v, jnp.asarray(fwd_tbl), seed, causal, scale, blk,
+                    H, rate)
+    return out, (q, k, v, seed, out, lse)
 
 
-def _bwd_rule(fwd_tbl, rev_tbl, causal, scale, blk, H, res, dout):
-    return _bwd(causal, scale, blk, H,
-                (jnp.asarray(fwd_tbl), jnp.asarray(rev_tbl)), res, dout)
+def _bwd_rule(fwd_tbl, rev_tbl, causal, scale, blk, H, rate, res, dout):
+    return (*_bwd(causal, scale, blk, H, rate,
+                  (jnp.asarray(fwd_tbl), jnp.asarray(rev_tbl)), res, dout),
+            None)
 
 
 _flash_sparse_bhsd.defvjp(_fwd_rule, _bwd_rule)
@@ -348,7 +372,9 @@ _flash_sparse_bhsd.defvjp(_fwd_rule, _bwd_rule)
 
 def flash_sparse_attention(q, k, v, layout: np.ndarray, block: int,
                            causal: bool = False,
-                           scale: Optional[float] = None):
+                           scale: Optional[float] = None,
+                           dropout_rate: float = 0.0,
+                           dropout_rng=None):
     """Block-sparse flash attention over [B, S, H, D] (BSHD).
 
     layout: STATIC numpy [H, S/block, S/block] 0/1 (SparsityConfig
@@ -356,23 +382,32 @@ def flash_sparse_attention(q, k, v, layout: np.ndarray, block: int,
     the diagonal blocks). The kernel tiles at the LAYOUT's block size —
     SparsityConfig blocks of 128 map 1:1 onto MXU tiles; smaller layout
     blocks still run (interpret/compat) but waste lanes.
+
+    dropout_rate > 0 with a dropout_rng applies probability dropout
+    in-kernel — the same global-index hash mask as the dense flash
+    kernel (ops/transformer/flash_attention.py), regenerated in both
+    backward walks, never materialised at [S, S].
     """
     B, S, Hh, D = q.shape
     nb = S // block
     assert S % block == 0, (S, block)
     layout = np.asarray(layout)
     assert layout.shape == (Hh, nb, nb), (layout.shape, (Hh, nb, nb))
+    if not 0.0 <= dropout_rate < 1.0:
+        raise ValueError(f"dropout_rate must be in [0, 1), got "
+                         f"{dropout_rate}")
     fwd_tbl, rev_tbl = layout_tables(layout)
     scale = (D ** -0.5) if scale is None else scale
+    seed, rate = derive_seed(dropout_rate, dropout_rng)
     to_bhsd = lambda t: t.transpose(0, 2, 1, 3).reshape(B * Hh, S, D)
     # hashable static tables for the custom-vjp nondiff args
     fwd_key = tuple(map(tuple, fwd_tbl.reshape(Hh * nb, -1)))
     rev_key = tuple(map(tuple, rev_tbl.reshape(Hh * nb, -1)))
     out = _flash_sparse_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), seed,
         _Table(fwd_key, (Hh, nb, fwd_tbl.shape[-1])),
         _Table(rev_key, (Hh, nb, rev_tbl.shape[-1])),
-        causal, scale, block, Hh)
+        causal, scale, block, Hh, rate)
     return out.reshape(B, Hh, S, D).transpose(0, 2, 1, 3)
 
 

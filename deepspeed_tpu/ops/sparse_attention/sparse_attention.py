@@ -174,10 +174,9 @@ class SparseSelfAttention:
             attn_bias = rpe if attn_bias is None else attn_bias + rpe
 
         # Pallas flash-sparse kernel: streams only active layout blocks
-        # through VMEM (no [.., W, blk, blk] score tiles in HBM). The
-        # kernel carries no bias/dropout — those route to the XLA path.
-        plain = (key_padding_bias is None and attn_bias is None
-                 and dropout_rate == 0.0)
+        # through VMEM (no [.., W, blk, blk] score tiles in HBM). Dropout
+        # runs in-kernel (hash tile masks); biases route to the XLA path.
+        plain = key_padding_bias is None and attn_bias is None
         want_pallas = self.impl == "pallas" or (
             self.impl == "auto" and plain
             and jax.default_backend() == "tpu"
@@ -188,7 +187,8 @@ class SparseSelfAttention:
 
             return flash_sparse_attention(
                 query, key, value, layout, self.sparsity_config.block,
-                causal=causal)
+                causal=causal, dropout_rate=dropout_rate,
+                dropout_rng=dropout_rng)
         return block_sparse_attention(
             query, key, value, layout, self.sparsity_config.block,
             causal_token_mask=causal, key_padding_bias=key_padding_bias,

@@ -30,6 +30,25 @@ def _on_tpu() -> bool:
         return False
 
 
+def _is_ad_tracer(x) -> bool:
+    """True when x is being differentiated (a JVP/linearize tracer).
+
+    The flash kernel's VJP returns no cotangent for its key-bias operand,
+    so a bias that itself needs gradients (e.g. a learnable per-key bias)
+    must stay on the XLA path; a constant padding mask — even inside jit
+    or under grad-w.r.t.-params, where it is an ArrayImpl or a plain
+    DynamicJaxprTracer — still takes the kernel."""
+    name = type(x).__name__
+    if name in ("JVPTracer", "LinearizeTracer"):
+        return True
+    try:
+        from jax.interpreters import ad
+
+        return isinstance(x, getattr(ad, "JVPTracer", ()))
+    except Exception:
+        return False
+
+
 def xla_attention(q, k, v, causal=True, bias=None, dropout_rate=0.0,
                   dropout_rng=None, train=False, scale=None):
     """Reference attention in pure XLA. [B,S,H,D] -> [B,S,H,D].
@@ -78,7 +97,8 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
     key_bias = None
     if bias is not None and getattr(bias, "ndim", 0) == 4 \
             and bias.shape[1] == 1 and bias.shape[2] == 1 \
-            and bias.shape[3] == Sk and bias.shape[0] in (1, B):
+            and bias.shape[3] == Sk and bias.shape[0] in (1, B) \
+            and not _is_ad_tracer(bias):
         key_bias = bias
     use_pallas = False
     if impl == "pallas":

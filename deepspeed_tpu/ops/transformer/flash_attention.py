@@ -68,6 +68,17 @@ def _keep_mask(seed, bh, q0, k0, bq, bk, rate):
     return (h < thresh).astype(jnp.float32) * (1.0 / keep)
 
 
+def derive_seed(dropout_rate, dropout_rng):
+    """(seed array, static rate) for the dropout kernels — ONE definition,
+    shared with the sparse flash kernel: the hash-mask contract depends on
+    identical seed derivation everywhere."""
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        seed = jax.random.randint(dropout_rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
+        return seed, float(dropout_rate)
+    return jnp.zeros((1,), jnp.int32), 0.0
+
+
 def _compiler_params():
     return pltpu.CompilerParams(
         dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY))
@@ -440,13 +451,7 @@ def flash_attention(q, k, v, causal: bool = True,
         raise ValueError(f"dropout_rate must be in [0, 1), got "
                          f"{dropout_rate}")
     scale = (D ** -0.5) if scale is None else scale
-    if dropout_rate > 0.0 and dropout_rng is not None:
-        seed = jax.random.randint(dropout_rng, (1,), 0,
-                                  jnp.iinfo(jnp.int32).max, dtype=jnp.int32)
-        rate = float(dropout_rate)
-    else:
-        seed = jnp.zeros((1,), jnp.int32)
-        rate = 0.0
+    seed, rate = derive_seed(dropout_rate, dropout_rng)
     kb = None
     if key_bias is not None:
         kb = jnp.asarray(key_bias, jnp.float32).reshape(-1, Sk)

@@ -299,3 +299,21 @@ def test_dispatch_routes_padding_bias_to_pallas():
     direct = flash_attention(q, k, v, causal=False, key_bias=bias)
     np.testing.assert_allclose(np.asarray(via), np.asarray(direct),
                                atol=0, rtol=0)
+
+
+def test_differentiated_bias_gets_real_gradients():
+    """A bias that itself needs gradients must NOT be routed to the flash
+    kernel (whose VJP has no bias cotangent): grad w.r.t. the bias through
+    the dispatcher must be nonzero even when the shape looks like a
+    padding mask."""
+    B, S, H, D = 1, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(16), B=B, S=S, H=H, D=D)
+    bias0 = jnp.zeros((B, 1, 1, S), jnp.float32)
+
+    def loss(b):
+        out = multihead_attention(q, k, v, causal=False, impl="pallas",
+                                  bias=b)
+        return jnp.sum(out ** 2)
+
+    g = jax.grad(loss)(bias0)
+    assert float(jnp.abs(g).max()) > 0.0, "bias gradient silently zero"

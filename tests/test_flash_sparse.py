@@ -98,3 +98,86 @@ def test_flash_sparse_memory_is_layout_bounded():
     want = np.einsum("bnhqk,bnkhd->bnqhd", np.asarray(p), vb)
     want = want.reshape(B, S, H, D)
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# in-kernel probability dropout (sparse)
+# ---------------------------------------------------------------------------
+
+def _dense_sparse_ref(q, k, v, layout, blk, dmask=None):
+    """Dense attention restricted to the layout's active blocks, with an
+    optional post-softmax dropout mask — the oracle for the sparse
+    kernel's dropout path."""
+    Bq, Sq, Hq, Dq = q.shape
+    allow = np.kron(np.asarray(layout), np.ones((blk, blk)))  # [H, S, S]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * (Dq ** -0.5)
+    scores = jnp.where(jnp.asarray(allow[None], bool), scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    if dmask is not None:
+        probs = probs * dmask.reshape(Bq, Hq, Sq, Sq)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+
+
+def test_sparse_dropout_forward_matches_masked_ref():
+    from tests.test_flash_attention import _host_keep_mask
+
+    q, k, v = _qkv(5)
+    layout = _layout()
+    rate = 0.3
+    rng = jax.random.PRNGKey(50)
+    seed = int(jax.random.randint(rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    dmask = jnp.asarray(_host_keep_mask(seed, B * H, S, S, rate))
+    want = _dense_sparse_ref(q, k, v, layout, BLK, dmask)
+    got = flash_sparse_attention(q, k, v, layout, BLK, dropout_rate=rate,
+                                 dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=3e-5, rtol=3e-5)
+
+
+def test_sparse_dropout_backward_matches_masked_ref():
+    from tests.test_flash_attention import _host_keep_mask
+
+    q, k, v = _qkv(6)
+    layout = _layout("bigbird")
+    rate = 0.2
+    rng = jax.random.PRNGKey(51)
+    seed = int(jax.random.randint(rng, (1,), 0,
+                                  jnp.iinfo(jnp.int32).max,
+                                  dtype=jnp.int32)[0])
+    dmask = jnp.asarray(_host_keep_mask(seed, B * H, S, S, rate))
+
+    def loss_kernel(q, k, v):
+        return jnp.sum(flash_sparse_attention(
+            q, k, v, layout, BLK, dropout_rate=rate, dropout_rng=rng) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_dense_sparse_ref(q, k, v, layout, BLK, dmask) ** 2)
+
+    g_k = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gk, gr, name in zip(g_k, g_r, "qkv"):
+        np.testing.assert_allclose(np.asarray(gk), np.asarray(gr),
+                                   atol=3e-3, rtol=3e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
+def test_sparse_self_attention_routes_dropout_to_kernel():
+    """SparseSelfAttention(impl='pallas') with dropout must produce the
+    kernel's hash-mask output (bit-identical with the direct call)."""
+    from deepspeed_tpu.ops.sparse_attention.sparse_attention import (
+        SparseSelfAttention)
+
+    q, k, v = _qkv(7)
+    cfg = FixedSparsityConfig(num_heads=H, block=BLK, num_local_blocks=2,
+                              num_global_blocks=1,
+                              attention="bidirectional")
+    attn = SparseSelfAttention(sparsity_config=cfg, impl="pallas")
+    rng = jax.random.PRNGKey(52)
+    via = attn(q, k, v, dropout_rate=0.4, dropout_rng=rng)
+    direct = flash_sparse_attention(q, k, v, np.asarray(cfg.make_layout(S)),
+                                    BLK, dropout_rate=0.4, dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(via), np.asarray(direct),
+                               atol=0, rtol=0)

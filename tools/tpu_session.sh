@@ -30,7 +30,8 @@ alive() { # tunnel liveness: backend init in a killable subprocess
     "import jax; assert jax.default_backend() != 'cpu'" 2>/dev/null
 }
 
-run() { # run <name> <timeout> <cmd...> — record rc, never abort the session
+run() { # run <name> <timeout> <cmd...> — record rc; a failing PHASE never
+  # aborts the session, but a dead TUNNEL does (exit 1 -> watcher resumes)
   local name=$1 tmo=$2; shift 2
   if ! alive; then
     echo "$name skipped-tunnel-down" >> "$STATUS"
