@@ -374,11 +374,12 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
         # enough to matter against TPU HBM (16 GB on v5e) — chunking costs
         # a full logit recompute in backward, so below ~4 GB of fp32
         # logits the single fused matmul wins; GPT-2 at micro 8 / seq 1024
-        # (1.6 GB) and the BERT-large seq-128 recipe (1 GB) stay unchunked
-        if N * V * 4 > 4 << 30:
-            n_chunks = max(1, N // 2048)
-        else:
-            n_chunks = 1
+        # (1.6 GB) and the BERT-large seq-128 recipe (1 GB) stay unchunked.
+        # Above the threshold, chunk count is sized from the SAME bytes
+        # (≈2 GB per chunk) so the decision and the count can't disagree
+        # at small N / huge V
+        total = N * V * 4
+        n_chunks = -(-total // (2 << 30)) if total > 4 << 30 else 1
     while n_chunks > 1 and N % n_chunks:
         n_chunks -= 1
     if n_chunks <= 1:

@@ -1,5 +1,7 @@
 from .compressed import CompressedBackend, compressed_allreduce
 from .compressed_ar import (compressed_all_reduce, decompose, reconstruct)
+from .hostwire import HostWire, HostWireBackend
 
 __all__ = ["CompressedBackend", "compressed_allreduce",
-           "compressed_all_reduce", "decompose", "reconstruct"]
+           "compressed_all_reduce", "decompose", "reconstruct",
+           "HostWire", "HostWireBackend"]

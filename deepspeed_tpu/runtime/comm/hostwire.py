@@ -1,0 +1,211 @@
+"""Host-TCP compressed collectives — the second comm substrate.
+
+Reference: deepspeed/runtime/comm/mpi.py (MpiBackend) — the SAME
+error-compensated 1-bit algorithm as the NCCL backend, carried by a
+second, device-fabric-independent transport. The TPU analogue: XLA
+collectives over ICI/DCN are the primary substrate
+(runtime/comm/compressed.py); this module carries the identical
+algorithm over the jax.distributed coordination service's key-value
+store — plain TCP between processes, nothing on the device fabric.
+
+Two things only a host wire can do here:
+
+* a TRUE 1-bit wire format: np.packbits ships 1 bit/element + one fp32
+  scale. XLA has no packed-int1 type, so the in-jit sign path travels at
+  full width (measured negative result, BENCH.md "1-bit Adam measured");
+  the reference needed CuPy bit-packing for exactly this
+  (deepspeed/runtime/compression/cupy.py) — packbits is its host-side
+  twin.
+* transport independence: gradients can be reduced even when the device
+  fabric is owned by a different collective (e.g. during pipeline
+  channel transfers), mirroring how the reference's MPI backend rides
+  beside NCCL.
+
+Intended for SMALL, compression-friendly payloads (1-bit/int8 optimizer
+wires). The coordinator relays bytes (upload ~1 full payload + 1 owned
+chunk per step per rank), so this is a fallback/secondary fabric, not a
+bandwidth contender — same positioning as the reference's MPI path.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+DEFAULT_TIMEOUT_MS = 120_000
+
+
+def _client():
+    from jax._src import distributed
+
+    state = distributed.global_state
+    if state.client is None:
+        return None, 0, 1
+    return state.client, state.process_id, state.num_processes
+
+
+class HostWire:
+    """Allgather of byte payloads over the coordination-service KV store.
+
+    Every call site must be entered by ALL processes (collective
+    contract, like any allreduce). Keys are step-scoped and deleted
+    after a barrier, so coordinator memory stays bounded."""
+
+    def __init__(self, tag: str = "dstpu-hostwire",
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
+        self.client, self.rank, self.world = _client()
+        self.tag = tag
+        self.timeout_ms = timeout_ms
+        self._step = 0
+
+    def allgather_bytes(self, payload: bytes) -> list:
+        """payload from every process, in rank order."""
+        if self.client is None or self.world == 1:
+            self._step += 1
+            return [payload]
+        key = f"{self.tag}/{self._step}"
+        self.client.key_value_set_bytes(f"{key}/{self.rank}", payload)
+        out = [
+            payload if r == self.rank else
+            self.client.blocking_key_value_get_bytes(
+                f"{key}/{r}", self.timeout_ms)
+            for r in range(self.world)
+        ]
+        # nobody may delete until everyone has read; nobody may proceed
+        # to the NEXT step's set() until this step's keys are gone
+        self.client.wait_at_barrier(f"{key}/read", self.timeout_ms)
+        if self.rank == 0:
+            for r in range(self.world):
+                self.client.key_value_delete(f"{key}/{r}")
+        self.client.wait_at_barrier(f"{key}/clean", self.timeout_ms)
+        self._step += 1
+        return out
+
+
+def _pack_sign(c: np.ndarray) -> Tuple[bytes, float]:
+    """sign-compress: 1 bit/element (bit=1 means +scale) + L1-mean scale."""
+    scale = float(np.mean(np.abs(c)))
+    return np.packbits(c >= 0).tobytes(), scale
+
+
+def _unpack_sign(payload: bytes, scale: float, n: int) -> np.ndarray:
+    bits = np.unpackbits(np.frombuffer(payload, np.uint8), count=n)
+    return np.where(bits.astype(bool), scale, -scale).astype(np.float32)
+
+
+class HostWireBackend:
+    """Out-of-jit compressed-allreduce over the host wire — the same
+    surface as CompressedBackend (runtime/comm/compressed.py) and the
+    same two-stage error-compensated algorithm as the reference backends
+    (deepspeed/runtime/comm/mpi.py:34-290):
+
+      worker: c = x + worker_error; ship sign(c)·scale (packed 1-bit)
+      server: rank r owns chunk r of the worker-mean; adds its server
+              error, recompresses, ships; everyone reassembles
+
+    wire="sign": 1 bit/element + 4-byte scale per stage (the true 1-bit
+    wire). wire="int8": one byte/element + per-group scales (higher
+    fidelity, 8x the bytes)."""
+
+    INT8_GROUP = 2048
+
+    def __init__(self, tag: str = "dstpu-onebit", wire: str = "sign",
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
+        if wire not in ("sign", "int8"):
+            raise ValueError(f"wire must be 'sign' or 'int8', got {wire!r}")
+        self.wire = HostWire(tag=tag, timeout_ms=timeout_ms)
+        self.mode = wire
+        self._errors: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+
+    @property
+    def rank(self):
+        return self.wire.rank
+
+    @property
+    def world(self):
+        return self.wire.world
+
+    # -- int8 helpers (numpy twins of compressed.py's _quant_grouped) ----
+    def _quant(self, c: np.ndarray) -> Tuple[bytes, np.ndarray]:
+        G = max(1, min(self.INT8_GROUP, c.size))
+        pad = (-c.size) % G
+        g = np.pad(c, (0, pad)).reshape(-1, G)
+        scale = np.max(np.abs(g), axis=-1) / 127.0 + 1e-12
+        q = np.clip(np.round(g / scale[:, None]), -127, 127).astype(np.int8)
+        return q.tobytes(), scale.astype(np.float32)
+
+    def _dequant(self, payload: bytes, scale: np.ndarray,
+                 n: int) -> np.ndarray:
+        q = np.frombuffer(payload, np.int8)
+        g = q.astype(np.float32).reshape(len(scale), -1)
+        return (g * scale[:, None]).ravel()[:n]
+
+    def _compress(self, c: np.ndarray):
+        if self.mode == "sign":
+            payload, scale = _pack_sign(c)
+            return payload, np.float32([scale])
+        return self._quant(c)
+
+    def _decompress(self, payload: bytes, scale: np.ndarray,
+                    n: int) -> np.ndarray:
+        if self.mode == "sign":
+            return _unpack_sign(payload, float(scale[0]), n)
+        return self._dequant(payload, scale, n)
+
+    def compressed_allreduce(self, tensor, name: str = "default"):
+        """Error-compensated compressed MEAN of `tensor` over all
+        processes. tensor: host array (np or jax); returns np.float32 of
+        the same shape. Must be called collectively."""
+        x = np.asarray(tensor, np.float32)
+        n = x.size
+        W = self.world
+        if name not in self._errors:
+            self._errors[name] = (np.zeros(n, np.float32),
+                                  np.zeros(n, np.float32))
+        we, se = self._errors[name]
+
+        # worker stage
+        c = x.ravel() + we
+        payload, scale = self._compress(c)
+        deq_own = self._decompress(payload, scale, n)
+        we_new = c - deq_own
+
+        parts = self.wire.allgather_bytes(payload + scale.tobytes())
+        sbytes = scale.nbytes
+        mean = deq_own.copy()  # own payload already decompressed above
+        for r, p in enumerate(parts):
+            if r == self.rank:
+                continue
+            sc = np.frombuffer(p[len(p) - sbytes:], np.float32)
+            mean += self._decompress(p[:len(p) - sbytes], sc, n)
+        mean /= W
+
+        # server stage: rank r owns chunk r (reference per-rank server
+        # error slices, comm/mpi.py server_error)
+        chunk = -(-n // W)
+        lo, hi = self.rank * chunk, min(n, (self.rank + 1) * chunk)
+        out = np.empty(n, np.float32)
+        se_new = se.copy()
+        if hi > lo:
+            s = mean[lo:hi] + se[lo:hi]
+            p2, sc2 = self._compress(s)
+            se_new[lo:hi] = s - self._decompress(p2, sc2, hi - lo)
+            own = p2 + sc2.tobytes()
+        else:  # more ranks than chunks
+            own = b""
+        parts2 = self.wire.allgather_bytes(own)
+        for r, p in enumerate(parts2):
+            rlo, rhi = r * chunk, min(n, (r + 1) * chunk)
+            if rhi <= rlo or not p:
+                continue
+            # scale-tail length per chunk: 1 float for sign, else one
+            # per quant group of THIS chunk's size (last chunk may be
+            # ragged)
+            ng = 1 if self.mode == "sign" else \
+                -(-(rhi - rlo) // max(1, min(self.INT8_GROUP, rhi - rlo)))
+            sc = np.frombuffer(p[len(p) - 4 * ng:], np.float32)
+            out[rlo:rhi] = self._decompress(p[:len(p) - 4 * ng], sc,
+                                            rhi - rlo)
+        self._errors[name] = (we_new, se_new)
+        return out.reshape(x.shape)

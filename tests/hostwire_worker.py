@@ -1,0 +1,48 @@
+"""Worker for test_hostwire.py: one of N jax.distributed processes
+running HostWireBackend.compressed_allreduce over the coordination
+service — no device collectives involved. Prints the result checksum so
+the parent can assert cross-process agreement and parity with the
+single-process numpy oracle."""
+
+import os
+import sys
+
+
+def main():
+    proc_id = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    wire = sys.argv[4]
+
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=proc_id)
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), ".."))
+    from deepspeed_tpu.runtime.comm.hostwire import HostWireBackend
+
+    backend = HostWireBackend(wire=wire)
+    assert backend.world == nprocs and backend.rank == proc_id
+
+    rng = np.random.RandomState(7 + proc_id)  # DIFFERENT data per rank
+    n = 5000
+    results = []
+    for step in range(3):
+        x = rng.rand(n).astype(np.float32) - 0.5 + 0.01 * step
+        out = backend.compressed_allreduce(x, name="m")
+        results.append(out)
+    # every rank prints the identical reduction -> parent asserts equality
+    for step, out in enumerate(results):
+        print(f"CHECK {proc_id} {step} {float(np.sum(out)):.6f} "
+              f"{float(np.abs(out).mean()):.6f}", flush=True)
+    print(f"DONE {proc_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
