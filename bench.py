@@ -97,27 +97,22 @@ def _dense_peak_tflops(n=4096, iters=100) -> float:
     return iters * 2 * n**3 / best / 1e12
 
 
-def run_bench(on_tpu: bool) -> dict:
+def _time_config(size, seq, micro, remat, steps, warmup=2):
+    """Build an engine for one config and time `steps` steps. Returns the
+    measurement dict, with every engine reference dropped afterwards so
+    the next (possibly larger) config starts from a clean HBM."""
+    import gc
+
     import jax
-    import jax.numpy as jnp  # noqa: F401
 
     import deepspeed_tpu
     from deepspeed_tpu.models import GPT, gpt2_config
 
     n_dev = jax.device_count()
-    if on_tpu:
-        size, seq, micro, steps = "small", 1024, 8, 20
-    else:  # smoke mode for CPU dev runs / TPU-unavailable fallback
-        size, seq, micro, steps = "nano", 128, 4, 5
-    # sweep overrides (tools/perf_sweep.py drives these)
-    size = os.environ.get("DSTPU_BENCH_SIZE", size)
-    seq = int(os.environ.get("DSTPU_BENCH_SEQ", seq))
-    micro = int(os.environ.get("DSTPU_BENCH_MICRO", micro))
-
     cfg = gpt2_config(size, max_seq_len=seq,
-                      shard_activations=n_dev > 1, remat=False)
+                      shard_activations=n_dev > 1, remat=remat)
     model = GPT(cfg)
-    config = {
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
         "train_batch_size": micro * n_dev,
         "train_micro_batch_size_per_gpu": micro,
         "bf16": {"enabled": True},
@@ -125,14 +120,11 @@ def run_bench(on_tpu: bool) -> dict:
         "zero_optimization": {"stage": 2},
         "mesh": {"data": n_dev},
         "steps_per_print": 0,
-    }
-    engine, _, _, _ = deepspeed_tpu.initialize(model=model,
-                                               config_params=config)
+    })
     n_params = model.num_params()
     global_batch = micro * n_dev
-    rng = jax.random.PRNGKey(0)
-    tokens = jax.random.randint(rng, (global_batch, seq + 1), 0,
-                                cfg.vocab_size)
+    tokens = jax.random.randint(jax.random.PRNGKey(0),
+                                (global_batch, seq + 1), 0, cfg.vocab_size)
     batch = (tokens[:, :-1], tokens[:, 1:])
 
     def step():
@@ -141,19 +133,93 @@ def run_bench(on_tpu: bool) -> dict:
         engine.step()
         return loss
 
-    # warmup / compile
-    step().block_until_ready()
-    step().block_until_ready()
+    try:
+        for _ in range(warmup):
+            step().block_until_ready()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step()
+        loss.block_until_ready()
+        dt = time.perf_counter() - t0
+    finally:
+        # drop every engine/closure/array reference (same discipline as
+        # run_headroom) before the caller builds the next engine
+        try:
+            del step, loss
+        except UnboundLocalError:
+            pass
+        del engine, batch, tokens, model
+        gc.collect()
 
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = step()
-    loss.block_until_ready()
-    dt = time.perf_counter() - t0
+    tok_s_chip = steps * global_batch * seq / dt / n_dev
+    return {
+        "size": size, "seq": seq, "micro": micro, "remat": remat,
+        "n_params": n_params, "n_dev": n_dev,
+        "tok_s_chip": tok_s_chip,
+        "tflops": 6.0 * n_params * tok_s_chip / 1e12,
+    }
 
-    tokens_per_sec = steps * global_batch * seq / dt
-    tokens_per_sec_chip = tokens_per_sec / n_dev
-    achieved_tflops = 6.0 * n_params * tokens_per_sec_chip / 1e12
+
+# headline candidates for the on-chip autotune probe: the fused
+# single-chip step's MFU depends on model size x batch x remat in ways
+# only hardware can rank (BERT-large at micro 64 measured 2x the MFU of
+# GPT-2 small at micro 8 — BENCH.md 07-31). Probed cheaply (3 steps),
+# winner gets the full measurement.
+AUTOTUNE_CANDIDATES = (
+    ("small", 8, False),   # the historical headline config
+    ("small", 32, False),  # bigger batch, same model
+    ("medium", 16, True),  # bigger matmuls, remat for headroom
+)
+
+
+def run_bench(on_tpu: bool) -> dict:
+    import jax
+
+    if on_tpu:
+        size, seq, micro, steps, remat = "small", 1024, 8, 20, False
+    else:  # smoke mode for CPU dev runs / TPU-unavailable fallback
+        size, seq, micro, steps, remat = "nano", 128, 4, 5, False
+    # sweep overrides (tools/perf_sweep.py drives these) pin the config
+    # and disable the autotune probe
+    pinned = any(k in os.environ for k in
+                 ("DSTPU_BENCH_SIZE", "DSTPU_BENCH_MICRO",
+                  "DSTPU_BENCH_SEQ"))
+    size = os.environ.get("DSTPU_BENCH_SIZE", size)
+    seq = int(os.environ.get("DSTPU_BENCH_SEQ", seq))
+    micro = int(os.environ.get("DSTPU_BENCH_MICRO", micro))
+    autotune = (on_tpu and not pinned
+                and os.environ.get("DSTPU_BENCH_AUTOTUNE", "1") != "0")
+
+    probes = []
+    if autotune:
+        best = None
+        for c_size, c_micro, c_remat in AUTOTUNE_CANDIDATES:
+            try:
+                r = _time_config(c_size, seq, c_micro, c_remat, steps=3,
+                                 warmup=1)
+            except Exception as exc:
+                # a probe is OPTIONAL: any failure (OOM, lowering error
+                # on some TPU generation, ...) skips the candidate — the
+                # headline must never die on a probe when the default
+                # config would have measured fine
+                oom = ("RESOURCE_EXHAUSTED" in str(exc)
+                       or "Out of memory" in str(exc))
+                probes.append({"size": c_size, "micro": c_micro,
+                               "remat": c_remat,
+                               "failed": type(exc).__name__,
+                               "oom": oom})
+                continue
+            probes.append({k: (round(v, 2) if isinstance(v, float) else v)
+                           for k, v in r.items()
+                           if k not in ("n_params", "n_dev")})
+            if best is None or r["tflops"] > best["tflops"]:
+                best = r
+        if best is not None:
+            size, micro, remat = best["size"], best["micro"], best["remat"]
+
+    r = _time_config(size, seq, micro, remat, steps=steps)
+    tokens_per_sec_chip = r["tok_s_chip"]
+    achieved_tflops = r["tflops"]
     peak = _dense_peak_tflops() if on_tpu else 0.0
 
     out = {
@@ -163,17 +229,21 @@ def run_bench(on_tpu: bool) -> dict:
         "vs_baseline": round(achieved_tflops / REFERENCE_TFLOPS, 4),
         "platform": jax.default_backend() if on_tpu else "cpu-smoke",
         "tflops_per_chip": round(achieved_tflops, 2),
-        "world_size": n_dev,
+        "world_size": r["n_dev"],
         "micro_batch": micro,
         "seq_len": seq,
     }
+    if r["remat"]:
+        out["remat"] = True
+    if probes:
+        out["autotune_probes"] = probes
     if peak:
         # MFU against this chip's MEASURED dense bf16 matmul rate (the
         # vs_baseline denominator stays the reference's published 64
         # TFLOPS/GPU so the driver metric is comparable across rounds)
         out["chip_dense_tflops"] = round(peak, 1)
         out["mfu_pct"] = round(100 * achieved_tflops / peak, 1)
-    if n_dev == 1:
+    if r["n_dev"] == 1:
         out["note"] = ("world_size=1: ZeRO dp-sharding inactive; measures "
                        "the fused single-chip step only")
     return out

@@ -380,8 +380,11 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
         # at small N / huge V
         total = N * V * 4
         n_chunks = -(-total // (2 << 30)) if total > 4 << 30 else 1
+    # fix up to a divisor of N by adding chunks (smaller chunks — never
+    # backslide below the byte-derived count, which could silently undo
+    # the chunking decision at awkward N)
     while n_chunks > 1 and N % n_chunks:
-        n_chunks -= 1
+        n_chunks += 1
     if n_chunks <= 1:
         return _ce_rows(project(x), labels, valid)
 

@@ -191,7 +191,9 @@ class HostWireBackend:
             s = mean[lo:hi] + se[lo:hi]
             p2, sc2 = self._compress(s)
             se_new[lo:hi] = s - self._decompress(p2, sc2, hi - lo)
-            own = p2 + sc2.tobytes()
+            # explicit payload-length prefix: the receiver must not
+            # re-derive _quant's group/padding split (ragged last chunk)
+            own = len(p2).to_bytes(4, "little") + p2 + sc2.tobytes()
         else:  # more ranks than chunks
             own = b""
         parts2 = self.wire.allgather_bytes(own)
@@ -199,13 +201,8 @@ class HostWireBackend:
             rlo, rhi = r * chunk, min(n, (r + 1) * chunk)
             if rhi <= rlo or not p:
                 continue
-            # scale-tail length per chunk: 1 float for sign, else one
-            # per quant group of THIS chunk's size (last chunk may be
-            # ragged)
-            ng = 1 if self.mode == "sign" else \
-                -(-(rhi - rlo) // max(1, min(self.INT8_GROUP, rhi - rlo)))
-            sc = np.frombuffer(p[len(p) - 4 * ng:], np.float32)
-            out[rlo:rhi] = self._decompress(p[:len(p) - 4 * ng], sc,
-                                            rhi - rlo)
+            plen = int.from_bytes(p[:4], "little")
+            sc = np.frombuffer(p[4 + plen:], np.float32)
+            out[rlo:rhi] = self._decompress(p[4:4 + plen], sc, rhi - rlo)
         self._errors[name] = (we_new, se_new)
         return out.reshape(x.shape)
