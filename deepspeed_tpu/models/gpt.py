@@ -212,11 +212,11 @@ def layer_norm(x, p, eps):
 
 
 def _dropout(x, rate, rng, train):
-    if not train or rate <= 0.0 or rng is None:
-        return x
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
-    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+    # counter-hash mask, not bernoulli/threefry — see
+    # ops/transformer/dropout.py for why
+    from ..ops.transformer.dropout import hash_dropout
+
+    return hash_dropout(x, rate, rng, train)
 
 
 def _constrain(x, cfg: GPTConfig, spec):

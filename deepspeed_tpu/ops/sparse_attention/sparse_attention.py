@@ -112,9 +112,10 @@ def block_sparse_attention(q, k, v, layout, block: int,
     probs = jax.nn.softmax(flat, axis=-1).reshape(scores.shape)
     probs = jnp.where(mask, probs, 0.0)  # fully-masked rows -> zero output
     if dropout_rate > 0.0 and dropout_rng is not None:
-        keep = 1.0 - dropout_rate
-        dmask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
-        probs = jnp.where(dmask, probs / keep, 0.0)
+        # counter-hash mask instead of per-element threefry (dropout.py)
+        from ..transformer.dropout import hash_dropout
+
+        probs = hash_dropout(probs, dropout_rate, dropout_rng)
 
     out = jnp.einsum("bhiqwk,bhiwkd->bhiqd", probs,
                      vg.astype(jnp.float32),

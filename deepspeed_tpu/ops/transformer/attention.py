@@ -69,9 +69,12 @@ def xla_attention(q, k, v, causal=True, bias=None, dropout_rate=0.0,
         scores = jnp.where(qi >= ki, scores, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(scores, axis=-1)
     if train and dropout_rate > 0.0 and dropout_rng is not None:
-        keep = 1.0 - dropout_rate
-        mask = jax.random.bernoulli(dropout_rng, keep, probs.shape)
-        probs = jnp.where(mask, probs / keep, 0.0)
+        # counter-hash mask (dropout.py): the [B,H,S,S] probability
+        # tensor is the single largest per-element threefry bill in the
+        # model — the hash mask costs ~6 fused int ops instead
+        from .dropout import hash_dropout
+
+        probs = hash_dropout(probs, dropout_rate, dropout_rng)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
 

@@ -1,0 +1,46 @@
+"""Counter-hash activation dropout — the TPU-cheap mask generator.
+
+jax.random.bernoulli runs the full threefry block cipher per element;
+on the VPU that is a long serial multiply/rotate chain that can rival
+the surrounding matmul at BERT-recipe activation sizes. The reference
+never pays this: its fused kernels draw from curand Philox — one cheap
+per-launch seed plus a counter (csrc/transformer/dropout_kernels.cu).
+This is the same design in XLA: ONE tiny threefry call derives a scalar
+seed from the caller's PRNG key (so jax.random semantics — split,
+fold_in — still govern stream independence), then a murmur3-finalizer
+hash over the element counter produces the mask in ~6 fused integer ops
+per element. Mixing constants shared with the flash kernels' in-kernel
+masks (ops/transformer/flash_attention.py _keep_mask).
+
+Determinism: same key -> same mask (the hash is pure); backward sees
+the identical mask through ordinary AD of the where().
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def hash_dropout(x, rate, rng, train: bool = True):
+    """Inverted dropout on x: zero with probability `rate`, survivors
+    scaled by 1/keep. No-op when not training / rate 0 / rng None."""
+    if not train or rate <= 0.0 or rng is None:
+        return x
+    if not 0.0 <= rate < 1.0:
+        raise ValueError(f"dropout rate must be in [0, 1), got {rate}")
+    keep = 1.0 - rate
+    if x.size >= 1 << 32:
+        # the uint32 element counter would wrap and repeat masks across
+        # the tensor; tensors this large (>4.3e9 elements) are rare
+        # enough that the threefry path's cost is acceptable
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+    from .flash_attention import derive_seed, fmix32, keep_threshold
+
+    seed, _ = derive_seed(rate, rng)
+    u = jnp.uint32
+    idx = jax.lax.iota(u, x.size)
+    h = fmix32((seed[0].astype(u) * u(0x9E3779B1)) ^ (idx * u(0x85EBCA6B)))
+    mask = (h < keep_threshold(rate)).reshape(x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)

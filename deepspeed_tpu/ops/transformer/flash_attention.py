@@ -48,24 +48,38 @@ def _interpret() -> bool:
 # hardware-PRNG state to thread across grid programs. The hash is over
 # GLOBAL indices, so the mask is invariant to block-size tuning.
 
-def _keep_mask(seed, bh, q0, k0, bq, bk, rate):
-    """fp32 {0, 1/keep} matrix for the (bq, bk) tile at rows q0+, cols k0+.
-
-    murmur3-finalizer-style mixing; keep iff hash < keep·2^32. E[mask] = 1,
-    so attention stays unbiased (inverted-dropout scaling)."""
-    keep = 1.0 - rate
+def fmix32(h):
+    """THE murmur3-style finalizer — one definition for every hash mask
+    (in-kernel tile masks here and in flash_sparse.py, activation
+    dropout in dropout.py). Changing the mixing changes which elements
+    drop everywhere at once, never in one site only."""
     u = jnp.uint32
-    qi = q0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 0)
-    ki = k0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 1)
-    h = (seed.astype(u) * u(0x9E3779B1)) ^ (bh.astype(u) * u(0x7FEB352D)) \
-        ^ (qi * u(0x85EBCA6B)) ^ (ki * u(0xC2B2AE35))
     h = h ^ (h >> 15)
     h = h * u(0x2C1B3C6D)
     h = h ^ (h >> 12)
     h = h * u(0x297A2D39)
     h = h ^ (h >> 15)
-    thresh = u(min(0xFFFFFFFF, int(keep * 4294967296.0)))
-    return (h < thresh).astype(jnp.float32) * (1.0 / keep)
+    return h
+
+
+def keep_threshold(rate) -> "jnp.uint32":
+    """uint32 threshold: keep iff hash < keep·2^32."""
+    return jnp.uint32(min(0xFFFFFFFF, int((1.0 - rate) * 4294967296.0)))
+
+
+def _keep_mask(seed, bh, q0, k0, bq, bk, rate):
+    """fp32 {0, 1/keep} matrix for the (bq, bk) tile at rows q0+, cols k0+.
+
+    E[mask] = 1, so attention stays unbiased (inverted-dropout
+    scaling)."""
+    u = jnp.uint32
+    qi = q0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 0)
+    ki = k0.astype(u) + jax.lax.broadcasted_iota(u, (bq, bk), 1)
+    h = fmix32((seed.astype(u) * u(0x9E3779B1))
+               ^ (bh.astype(u) * u(0x7FEB352D))
+               ^ (qi * u(0x85EBCA6B)) ^ (ki * u(0xC2B2AE35)))
+    return (h < keep_threshold(rate)).astype(jnp.float32) * \
+        (1.0 / (1.0 - rate))
 
 
 def derive_seed(dropout_rate, dropout_rng):

@@ -107,11 +107,10 @@ def _layer_norm(x, w, b, eps):
 
 
 def _dropout(x, rate, rng, train):
-    if not train or rate <= 0.0 or rng is None:
-        return x
-    keep = 1.0 - rate
-    mask = jax.random.bernoulli(rng, keep, x.shape)
-    return jnp.where(mask, x / keep, jnp.zeros_like(x))
+    # counter-hash mask, not bernoulli/threefry — see dropout.py for why
+    from .dropout import hash_dropout
+
+    return hash_dropout(x, rate, rng, train)
 
 
 def init_transformer_params(config: DeepSpeedTransformerConfig, rng,

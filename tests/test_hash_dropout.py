@@ -1,0 +1,64 @@
+"""Counter-hash activation dropout (ops/transformer/dropout.py) — the
+threefry-free mask generator used by the transformer layer and the GPT
+family's residual/embedding dropout."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.transformer.dropout import hash_dropout
+
+
+def test_noop_paths():
+    x = jnp.ones((8, 16))
+    assert hash_dropout(x, 0.0, jax.random.PRNGKey(0)) is x
+    assert hash_dropout(x, 0.5, None) is x
+    assert hash_dropout(x, 0.5, jax.random.PRNGKey(0), train=False) is x
+    with pytest.raises(ValueError):
+        hash_dropout(x, 1.0, jax.random.PRNGKey(0))
+
+
+def test_statistics_and_scaling():
+    x = jnp.ones((512, 512))
+    rate = 0.3
+    y = np.asarray(hash_dropout(x, rate, jax.random.PRNGKey(1)))
+    kept = y != 0.0
+    # empirical drop rate tracks `rate`
+    assert abs((~kept).mean() - rate) < 0.01
+    # survivors carry the inverted-dropout scale -> E[y] == E[x]
+    np.testing.assert_allclose(y[kept], 1.0 / (1.0 - rate), rtol=1e-6)
+    assert abs(y.mean() - 1.0) < 0.02
+
+
+def test_deterministic_per_key_and_key_sensitive():
+    x = jnp.ones((64, 64))
+    a = hash_dropout(x, 0.5, jax.random.PRNGKey(2))
+    b = hash_dropout(x, 0.5, jax.random.PRNGKey(2))
+    c = hash_dropout(x, 0.5, jax.random.PRNGKey(3))
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+def test_backward_uses_same_mask():
+    x = jnp.ones((32, 32))
+    key = jax.random.PRNGKey(4)
+    g = jax.grad(lambda x: jnp.sum(hash_dropout(x, 0.4, key)))(x)
+    y = hash_dropout(x, 0.4, key)
+    # dy/dx is 1/keep exactly where the forward kept the element
+    np.testing.assert_array_equal(np.asarray(g) != 0,
+                                  np.asarray(y) != 0)
+    kept = np.asarray(g)[np.asarray(g) != 0]
+    np.testing.assert_allclose(kept, 1.0 / 0.6, rtol=1e-6)
+
+
+def test_rows_decorrelated():
+    """Flat-counter hashing must not produce row-aligned masks (a stride
+    artifact would drop the same feature across all positions)."""
+    x = jnp.ones((128, 128))
+    y = np.asarray(hash_dropout(x, 0.5, jax.random.PRNGKey(5))) != 0
+    col_rates = y.mean(axis=0)
+    row_rates = y.mean(axis=1)
+    assert col_rates.std() < 0.1 and row_rates.std() < 0.1
+    assert 0.3 < col_rates.min() and col_rates.max() < 0.7
