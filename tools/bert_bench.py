@@ -54,6 +54,11 @@ def main():
     ap.add_argument("--micro", type=int, default=0,
                     help="micro batch/chip (0: reference-recipe default)")
     ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--attn-impl", default="auto",
+                    choices=("auto", "pallas", "xla"),
+                    help="A/B the attention path; 'pallas' forces the "
+                         "flash kernel even below the auto min-seq gate "
+                         "(seq 128)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny model/CPU shapes (plumbing check only)")
     args = ap.parse_args()
@@ -70,10 +75,12 @@ def main():
     n_dev = jax.device_count()
     if args.smoke:
         cfg = bert_config("bert-base", num_layers=2, num_heads=4, d_model=64,
-                          vocab_size=512, max_seq_len=128)
+                          vocab_size=512, max_seq_len=128,
+                          attn_impl=args.attn_impl)
         seq, micro, steps = 64, 4, 3
     else:
-        cfg = bert_config("bert-large", max_seq_len=args.seq)
+        cfg = bert_config("bert-large", max_seq_len=args.seq,
+                          attn_impl=args.attn_impl)
         # reference seq-128 recipe uses micro 64/GPU on 32 GB V100
         # (bert-pretraining.md); 16 at seq 512
         seq = args.seq
@@ -121,6 +128,7 @@ def main():
            "tflops_per_chip": round(tflops, 2),
            "step_ms": round(dt / steps * 1000, 1),
            "compile_s": round(compile_s, 1),
+           "attn_impl": args.attn_impl,
            "loss": round(float(loss), 4)}
     ref = REFERENCE.get(seq)
     if ref and not args.smoke:

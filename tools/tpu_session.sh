@@ -78,6 +78,14 @@ persist /tmp/tpu_bert128.json
 run bert512  1800 python tools/bert_bench.py --seq 512 > /tmp/tpu_bert512.json 2>/tmp/tpu_bert512.log
 persist /tmp/tpu_bert512.json
 
+# attention-path A/B at both anchors: flash forced below the auto gate
+# (128) and the XLA fallback at 512 — quantifies the in-kernel
+# dropout/flash win on real hardware
+run bert128_flash 1800 python tools/bert_bench.py --seq 128 --attn-impl pallas > /tmp/tpu_bert128_flash.json 2>/tmp/tpu_bert128_flash.log
+persist /tmp/tpu_bert128_flash.json
+run bert512_xla   1800 python tools/bert_bench.py --seq 512 --attn-impl xla > /tmp/tpu_bert512_xla.json 2>/tmp/tpu_bert512_xla.log
+persist /tmp/tpu_bert512_xla.json
+
 run sweep_batch  3000 python tools/perf_sweep.py --phase batch --steps 10 > /tmp/tpu_sweep_batch.txt 2>&1
 persist /tmp/tpu_sweep_batch.txt
 run headroom 2400 env DSTPU_BENCH_MODE=headroom python bench.py > /tmp/tpu_headroom.json 2>/tmp/tpu_headroom.log
