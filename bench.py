@@ -191,9 +191,41 @@ def run_bench(on_tpu: bool) -> dict:
                 and os.environ.get("DSTPU_BENCH_AUTOTUNE", "1") != "0")
 
     probes = []
+    cached_hit = False
+    cache_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_artifacts", "autotune.json")
+    # invalidation key: a cache probed under different candidates, seq,
+    # or backend must not pin this run (e.g. new TPU generation)
+    def _cache_fingerprint():
+        import jax
+
+        return {"candidates": [list(c) for c in AUTOTUNE_CANDIDATES],
+                "seq": seq, "backend": jax.default_backend()}
+
+    if autotune and os.path.exists(cache_path):
+        # a previous on-TPU session already probed: reuse its winner so
+        # the driver's end-of-round run doesn't pay 3 extra compiles
+        # against an unknown timeout budget
+        try:
+            cached = json.load(open(cache_path))
+            c_size = cached["size"]
+            c_micro = int(cached["micro"])
+            c_remat = bool(cached["remat"])
+            if cached.get("fingerprint") == _cache_fingerprint():
+                size, micro, remat = c_size, c_micro, c_remat
+                autotune = False
+                cached_hit = True
+        except Exception:
+            pass  # unreadable/foreign cache: re-probe below
     if autotune:
         best = None
+        t_probe0 = time.perf_counter()
+        budget_s = float(os.environ.get("DSTPU_AUTOTUNE_BUDGET_S", "420"))
         for c_size, c_micro, c_remat in AUTOTUNE_CANDIDATES:
+            if time.perf_counter() - t_probe0 > budget_s:
+                probes.append({"size": c_size, "micro": c_micro,
+                               "remat": c_remat, "skipped": "budget"})
+                continue
             try:
                 r = _time_config(c_size, seq, c_micro, c_remat, steps=3,
                                  warmup=1)
@@ -216,8 +248,29 @@ def run_bench(on_tpu: bool) -> dict:
                 best = r
         if best is not None:
             size, micro, remat = best["size"], best["micro"], best["remat"]
+            complete = not any("skipped" in p or "failed" in p
+                               for p in probes)
+            if complete:  # never pin future rounds to a degraded probe
+                try:
+                    os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+                    with open(cache_path, "w") as f:
+                        json.dump({"size": size, "micro": micro,
+                                   "remat": remat, "probes": probes,
+                                   "fingerprint": _cache_fingerprint()},
+                                  f)
+                except Exception:
+                    pass  # read-only checkout: probing still worked
 
-    r = _time_config(size, seq, micro, remat, steps=steps)
+    try:
+        r = _time_config(size, seq, micro, remat, steps=steps)
+    except Exception:
+        # a cached/probed winner that no longer runs (chip change, OOM)
+        # must not kill the headline: fall back to the known-good default
+        if (size, micro, remat) == ("small", 8, False) or not on_tpu:
+            raise
+        size, micro, remat = "small", 8, False
+        cached_hit = False
+        r = _time_config(size, seq, micro, remat, steps=steps)
     tokens_per_sec_chip = r["tok_s_chip"]
     achieved_tflops = r["tflops"]
     peak = _dense_peak_tflops() if on_tpu else 0.0
@@ -237,6 +290,8 @@ def run_bench(on_tpu: bool) -> dict:
         out["remat"] = True
     if probes:
         out["autotune_probes"] = probes
+    if cached_hit:
+        out["autotune_cached"] = True  # config provenance: prior session
     if peak:
         # MFU against this chip's MEASURED dense bf16 matmul rate (the
         # vs_baseline denominator stays the reference's published 64
