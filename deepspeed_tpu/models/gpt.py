@@ -44,6 +44,10 @@ class GPTConfig:
     d_ff: Optional[int] = None       # default 4*d_model
     dropout: float = 0.0
     embed_dropout: float = 0.0
+    attn_dropout: float = -1.0       # attention-probability dropout;
+                                     # -1 -> follow `dropout` (reference
+                                     # transformer config keeps the two
+                                     # ratios separate too)
     layer_norm_eps: float = 1e-5
     tie_embeddings: bool = True
     loss_chunks: int = 0             # CE chunking: 0 auto, 1 off, n chunks
@@ -238,6 +242,7 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         r1, r2, r3 = jax.random.split(rng, 3)
 
     h = layer_norm(x, p["ln1"], cfg.layer_norm_eps)
+    attn_rate = cfg.dropout if cfg.attn_dropout < 0 else cfg.attn_dropout
     qkv = h @ p["attn"]["qkv"]["w"].astype(h.dtype) + \
         p["attn"]["qkv"]["b"].astype(h.dtype)
     q, kk, v = jnp.split(qkv, 3, axis=-1)
@@ -250,7 +255,7 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         attn = ulysses_attention(
             split_heads(q), split_heads(kk), split_heads(v),
             multihead_attention, causal=True, impl=cfg.attn_impl,
-            dropout_rate=cfg.dropout, dropout_rng=r1, train=train,
+            dropout_rate=attn_rate, dropout_rng=r1, train=train,
             block_q=cfg.flash_block_q or None,
             block_k=cfg.flash_block_k or None)
     elif cfg.sequence_parallel:
@@ -259,6 +264,17 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
                 f"unknown sequence_parallel_impl "
                 f"{cfg.sequence_parallel_impl!r}; use 'ring', "
                 f"'ring_zigzag' or 'ulysses'")
+        if train and attn_rate > 0.0 and r1 is not None:
+            # the ring formulation has no attention-probability dropout
+            # (its block walk keeps probabilities implicit and carries no
+            # mask state) — failing is honest, silently skipping is not;
+            # ulysses runs dropout in-kernel. rng=None configs (e.g. the
+            # SPMD pipeline trunk) treat dropout as inert on every path.
+            raise ValueError(
+                "attention-probability dropout is not supported on the "
+                "ring/ring_zigzag sequence-parallel path; use "
+                "sequence_parallel_impl='ulysses', or attn_dropout=0.0 "
+                "to keep residual/MLP dropout without it")
         from ..parallel.ring_attention import ring_attention
 
         # ring_zigzag: the trunk permuted the sequence into the zigzag
@@ -275,7 +291,7 @@ def gpt_block(x, p, cfg: GPTConfig, rng=None, train=True):
         attn = multihead_attention(split_heads(q), split_heads(kk),
                                    split_heads(v), causal=True,
                                    impl=cfg.attn_impl,
-                                   dropout_rate=cfg.dropout,
+                                   dropout_rate=attn_rate,
                                    dropout_rng=r1, train=train,
                                    block_q=cfg.flash_block_q or None,
                                    block_k=cfg.flash_block_k or None)

@@ -46,6 +46,13 @@ def ulysses_attention(q, k, v, attention_fn, causal: bool = True,
     attention_fn(q, k, v, causal=..., **kwargs) -> [B, S, H, D] — any
     dense attention (ops.transformer.attention.multihead_attention).
     Inputs arrive sequence-sharded; outputs return sequence-sharded.
+
+    Dropout note: with in-kernel hash dropout, the mask indexes by the
+    kernel-local (batch·head) coordinate; if XLA partitions the kernel
+    over the head dim, head-shards on different devices draw the same
+    mask pattern for their local head slots. Per-head statistics are
+    unaffected (correct rate and scaling per head) — only cross-device
+    mask IDENTITY correlates, which dense-path training never observes.
     """
     head_spec = P(DATA_AXIS, None, seq_axis, None)
     seq_spec = P(DATA_AXIS, seq_axis, None, None)

@@ -251,3 +251,19 @@ def test_moe_composes_with_zigzag_sp_through_engine():
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0] and np.isfinite(losses).all(), losses
+
+
+def test_ring_sp_rejects_attention_dropout():
+    """The ring path carries no attention-probability dropout; a config
+    asking for both must fail loudly, not silently skip the dropout."""
+    cfg = gpt2_config("nano", max_seq_len=64, vocab_size=128, dropout=0.1,
+                      sequence_parallel=True, sequence_parallel_impl="ring")
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    tok = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, 128)
+    with pytest.raises(ValueError, match="ring"):
+        model.loss(params, (tok, tok), rng=jax.random.PRNGKey(2),
+                   train=True)
+    # eval (train=False) must still run: dropout is inert there
+    out = model.loss(params, (tok, tok), train=False)
+    assert jnp.isfinite(out)
