@@ -4,7 +4,7 @@ Mirrors the reference's version stamping (/root/reference/version.txt,
 deepspeed/git_version_info.py) without requiring a build step.
 """
 
-__version__ = "0.2.0"  # round 4: multi-host pipe, NVMe masters, zigzag SP, int8 wire, BERT oracle
+__version__ = "0.3.0"  # round 5: in-kernel dropout/masks, host-TCP 1-bit wire, streamed BERT CE, on-chip autotune, first TPU-measured BERT rows
 version = __version__
 git_hash = "unknown"
 git_branch = "main"
