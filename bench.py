@@ -168,7 +168,8 @@ def _time_config(size, seq, micro, remat, steps, warmup=2):
 AUTOTUNE_CANDIDATES = (
     ("small", 8, False),   # the historical headline config
     ("small", 32, False),  # bigger batch, same model
-    ("medium", 16, True),  # bigger matmuls, remat for headroom
+    ("medium", 8, False),  # bigger matmuls, no recompute (if it fits)
+    ("medium", 16, True),  # bigger matmuls + batch, remat for headroom
 )
 
 
