@@ -15,6 +15,7 @@ CPU smoke:     JAX_PLATFORMS=cpu python tools/bert_bench.py --smoke
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -87,16 +88,22 @@ def main():
         micro = args.micro or (64 if seq == 128 else 16)
         steps = args.steps
 
-    model = Bert(cfg)
-    engine, *_ = ds.initialize(model=model, config={
-        "train_batch_size": micro * n_dev,
-        "train_micro_batch_size_per_gpu": micro,
-        "bf16": {"enabled": True},
-        "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
-        "zero_optimization": {"stage": 2},
-        "mesh": {"data": n_dev},
-        "steps_per_print": 0,
-    })
+    attn_impl = args.attn_impl
+
+    def build(impl):
+        m = Bert(dataclasses.replace(cfg, attn_impl=impl))
+        e, *_ = ds.initialize(model=m, config={
+            "train_batch_size": micro * n_dev,
+            "train_micro_batch_size_per_gpu": micro,
+            "bf16": {"enabled": True},
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-4}},
+            "zero_optimization": {"stage": 2},
+            "mesh": {"data": n_dev},
+            "steps_per_print": 0,
+        })
+        return e
+
+    engine = build(attn_impl)
     n_params = sum(l.size for l in jax.tree_util.tree_leaves(engine.params))
     rng = np.random.RandomState(0)
     batch = mlm_batch(rng, micro * n_dev, seq, cfg.vocab_size)
@@ -107,8 +114,22 @@ def main():
         engine.step()
         return loss
 
+    fell_back = False
     t0 = time.perf_counter()
-    step().block_until_ready()
+    try:
+        step().block_until_ready()
+    except Exception as exc:
+        if attn_impl == "xla":
+            raise
+        # a Mosaic lowering/compile failure on the flash path must not
+        # lose the anchor row — re-measure on the XLA path and say so
+        print(f"attn_impl={attn_impl} failed ({type(exc).__name__}); "
+              f"falling back to xla", file=sys.stderr)
+        attn_impl = "xla"
+        fell_back = True
+        engine = build("xla")
+        t0 = time.perf_counter()
+        step().block_until_ready()
     compile_s = time.perf_counter() - t0
     step().block_until_ready()
     t0 = time.perf_counter()
@@ -128,8 +149,10 @@ def main():
            "tflops_per_chip": round(tflops, 2),
            "step_ms": round(dt / steps * 1000, 1),
            "compile_s": round(compile_s, 1),
-           "attn_impl": args.attn_impl,
+           "attn_impl": attn_impl,
            "loss": round(float(loss), 4)}
+    if fell_back:
+        out["attn_impl_fallback"] = True
     ref = REFERENCE.get(seq)
     if ref and not args.smoke:
         out["ref_v100_tflops"] = ref["tflops"]
