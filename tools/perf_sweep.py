@@ -212,7 +212,10 @@ def main():
 
     runs = []
     if args.phase in ("all", "ce"):
-        runs += [dict(loss_chunks=1), dict(loss_chunks=0),
+        # auto (0) now resolves to 1 at these shapes (4 GB threshold), so
+        # sweep explicit chunk counts to price the backward logit
+        # recompute that chunking pays
+        runs += [dict(loss_chunks=1), dict(loss_chunks=4),
                  dict(loss_chunks=8), dict(loss_impl="pallas")]
     if args.phase in ("all", "flash") and backend != "cpu":
         runs += [dict(attn_impl="xla"),
