@@ -97,7 +97,8 @@ def _dense_peak_tflops(n=4096, iters=100) -> float:
     return iters * 2 * n**3 / best / 1e12
 
 
-def _time_config(size, seq, micro, remat, steps, warmup=2):
+def _time_config(size, seq, micro, remat, steps, warmup=2,
+                 attn_impl="auto"):
     """Build an engine for one config and time `steps` steps. Returns the
     measurement dict, with every engine reference dropped afterwards so
     the next (possibly larger) config starts from a clean HBM."""
@@ -110,7 +111,8 @@ def _time_config(size, seq, micro, remat, steps, warmup=2):
 
     n_dev = jax.device_count()
     cfg = gpt2_config(size, max_seq_len=seq,
-                      shard_activations=n_dev > 1, remat=remat)
+                      shard_activations=n_dev > 1, remat=remat,
+                      attn_impl=attn_impl)
     model = GPT(cfg)
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, config_params={
         "train_batch_size": micro * n_dev,
@@ -154,6 +156,7 @@ def _time_config(size, seq, micro, remat, steps, warmup=2):
     tok_s_chip = steps * global_batch * seq / dt / n_dev
     return {
         "size": size, "seq": seq, "micro": micro, "remat": remat,
+        "attn_impl": attn_impl,
         "n_params": n_params, "n_dev": n_dev,
         "tok_s_chip": tok_s_chip,
         "tflops": 6.0 * n_params * tok_s_chip / 1e12,
@@ -190,6 +193,7 @@ def run_bench(on_tpu: bool) -> dict:
     micro = int(os.environ.get("DSTPU_BENCH_MICRO", micro))
     autotune = (on_tpu and not pinned
                 and os.environ.get("DSTPU_BENCH_AUTOTUNE", "1") != "0")
+    attn_impl = "auto"
 
     probes = []
     cached_hit = False
@@ -212,8 +216,10 @@ def run_bench(on_tpu: bool) -> dict:
             c_size = cached["size"]
             c_micro = int(cached["micro"])
             c_remat = bool(cached["remat"])
+            c_attn = cached.get("attn_impl", "auto")
             if cached.get("fingerprint") == _cache_fingerprint():
                 size, micro, remat = c_size, c_micro, c_remat
+                attn_impl = c_attn
                 autotune = False
                 cached_hit = True
         except Exception:
@@ -249,6 +255,23 @@ def run_bench(on_tpu: bool) -> dict:
                 best = r
         if best is not None:
             size, micro, remat = best["size"], best["micro"], best["remat"]
+            # kernel-choice A/B at the winning shape: the flash-vs-XLA
+            # attention question has no hardware datum yet (the 07-31
+            # sweeps were lost to the tunnel drop) — one extra probe
+            # settles it for the final measurement
+            if time.perf_counter() - t_probe0 <= budget_s:
+                try:
+                    r_xla = _time_config(best["size"], seq, best["micro"],
+                                         best["remat"], steps=3, warmup=1,
+                                         attn_impl="xla")
+                    probes.append({k: (round(v, 2) if isinstance(v, float)
+                                       else v) for k, v in r_xla.items()
+                                   if k not in ("n_params", "n_dev")})
+                    if r_xla["tflops"] > best["tflops"]:
+                        attn_impl = "xla"
+                except Exception as exc:
+                    probes.append({"attn_impl": "xla",
+                                   "failed": type(exc).__name__})
             complete = not any("skipped" in p or "failed" in p
                                for p in probes)
             if complete:  # never pin future rounds to a degraded probe
@@ -256,14 +279,16 @@ def run_bench(on_tpu: bool) -> dict:
                     os.makedirs(os.path.dirname(cache_path), exist_ok=True)
                     with open(cache_path, "w") as f:
                         json.dump({"size": size, "micro": micro,
-                                   "remat": remat, "probes": probes,
+                                   "remat": remat, "attn_impl": attn_impl,
+                                   "probes": probes,
                                    "fingerprint": _cache_fingerprint()},
                                   f)
                 except Exception:
                     pass  # read-only checkout: probing still worked
 
     try:
-        r = _time_config(size, seq, micro, remat, steps=steps)
+        r = _time_config(size, seq, micro, remat, steps=steps,
+                         attn_impl=attn_impl)
     except Exception:
         # a cached/probed winner that no longer runs (chip change, OOM)
         # must not kill the headline: fall back to the known-good default
@@ -271,6 +296,7 @@ def run_bench(on_tpu: bool) -> dict:
             raise
         size, micro, remat = "small", 8, False
         cached_hit = False
+        attn_impl = "auto"
         r = _time_config(size, seq, micro, remat, steps=steps)
     tokens_per_sec_chip = r["tok_s_chip"]
     achieved_tflops = r["tflops"]
@@ -289,6 +315,8 @@ def run_bench(on_tpu: bool) -> dict:
     }
     if r["remat"]:
         out["remat"] = True
+    if r["attn_impl"] != "auto":
+        out["attn_impl"] = r["attn_impl"]
     if probes:
         out["autotune_probes"] = probes
     if cached_hit:
