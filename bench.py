@@ -97,6 +97,57 @@ def _dense_peak_tflops(n=4096, iters=100) -> float:
     return iters * 2 * n**3 / best / 1e12
 
 
+def _last_tpu_artifact():
+    """Newest committed hardware datum, for cpu-smoke fallbacks.
+
+    Scans `BENCH_r*.json` (driver round captures) and
+    `bench_artifacts/*.json` next to this file for the NEWEST entry (by
+    file mtime) whose platform is a real accelerator, so a smoke-mode
+    JSON line carries the last on-TPU measurement instead of silently
+    erasing hardware history (VERDICT r5 #3)."""
+    import glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    best_mtime = -1.0
+    for path in (glob.glob(os.path.join(here, "BENCH_r*.json")) +
+                 glob.glob(os.path.join(here, "bench_artifacts",
+                                        "*.json"))):
+        try:
+            mtime = os.path.getmtime(path)
+            if mtime <= best_mtime:
+                continue
+            with open(path) as f:
+                d = json.load(f)
+        except Exception:
+            continue
+        if not isinstance(d, dict):
+            continue
+        r = d.get("parsed", d)
+        if not isinstance(r, dict):
+            continue
+        plat = r.get("platform")
+        if not plat or str(plat).startswith("cpu"):
+            continue
+        best = (path, r)
+        best_mtime = mtime
+    if best is None:
+        return None
+    path, r = best
+    return {k: r.get(k) for k in ("metric", "value", "unit", "platform",
+                                  "vs_baseline", "tflops_per_chip",
+                                  "mfu_pct") if r.get(k) is not None} | {
+        "source": os.path.basename(path)}
+
+
+def _attach_last_tpu(out: dict) -> dict:
+    if out.get("platform") == "cpu-smoke":
+        last = _last_tpu_artifact()
+        if last:
+            out["last_tpu"] = last
+    return out
+
+
 def _time_config(size, seq, micro, remat, steps, warmup=2,
                  attn_impl="auto"):
     """Build an engine for one config and time `steps` steps. Returns the
@@ -330,7 +381,7 @@ def run_bench(on_tpu: bool) -> dict:
     if r["n_dev"] == 1:
         out["note"] = ("world_size=1: ZeRO dp-sharding inactive; measures "
                        "the fused single-chip step only")
-    return out
+    return _attach_last_tpu(out)
 
 
 def run_headroom(on_tpu: bool) -> dict:
@@ -416,7 +467,7 @@ def run_headroom(on_tpu: bool) -> dict:
         out["mfu_pct"] = round(100 * achieved / peak, 1)
     if search_capped:
         out["search_capped"] = True  # largest TRIED batch fit; not an OOM ceiling
-    return out
+    return _attach_last_tpu(out)
 
 
 def main():

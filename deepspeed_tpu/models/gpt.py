@@ -341,8 +341,8 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
     single biggest HBM cost of the naive CE at GPT-2 vocab (N·V·4 bytes,
     ~1.6 GB at micro 8 / seq 1024). With n_chunks > 1 the rows are processed
     by a rematerialised lax.scan, so peak memory holds one [N/c, V] chunk;
-    backward recomputes each chunk's logits (flash-attention-style
-    recompute, applied to the LM head).
+    backward recomputes each chunk's logits (flash-attention-style,
+    applied to the LM head).
 
     n_chunks: 0 = auto (chunks of ~2048 rows for large-vocab models),
     1 = single fused matmul, n = explicit chunk count (must divide N).
@@ -396,6 +396,11 @@ def _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=0,
         # at small N / huge V
         total = N * V * 4
         n_chunks = -(-total // (2 << 30)) if total > 4 << 30 else 1
+    # clamp BEFORE the fix-up walk: a requested count above N (e.g.
+    # loss_chunks=100 at N=32) has no divisor of N above it, so the
+    # upward search below would spin forever at trace time; N itself is
+    # always reachable (chunks of one row)
+    n_chunks = min(n_chunks, N)
     # fix up to a divisor of N by adding chunks (smaller chunks — never
     # backslide below the byte-derived count, which could silently undo
     # the chunking decision at awkward N)

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..transformer.flash_attention import _keep_mask, derive_seed
+from ..transformer.flash_attention import (_compiler_params, _keep_mask,
+                                           derive_seed)
 
 NEG_INF = -1e30
 
@@ -157,9 +158,7 @@ def _fwd(q, k, v, tbl, seed, causal, scale, blk, H, rate):
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, S, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY)),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(tbl, seed, q, k, v)
     return out, lse
@@ -293,9 +292,7 @@ def _bwd(causal, scale, blk, H, rate, tables, res, dout):
                           W=W, H=H, rate=rate),
         grid_spec=dq_spec,
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY)),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(fwd_tbl, seed, q, k, v, dout, lse, delta)
 
@@ -335,9 +332,7 @@ def _bwd(causal, scale, blk, H, rate, tables, res, dout):
             jax.ShapeDtypeStruct((BH, S, D), k.dtype),
             jax.ShapeDtypeStruct((BH, S, D), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL,
-                                 pltpu.ARBITRARY)),
+        compiler_params=_compiler_params(),
         interpret=_interpret(),
     )(rev_tbl, seed, q, k, v, dout, lse, delta)
     return dq, dk, dv

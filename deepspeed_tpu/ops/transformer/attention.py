@@ -30,23 +30,39 @@ def _on_tpu() -> bool:
         return False
 
 
+_AD_TRACER_NAMES = ("JVPTracer", "LinearizeTracer")
+
+
 def _is_ad_tracer(x) -> bool:
-    """True when x is being differentiated (a JVP/linearize tracer).
+    """True when x is being differentiated (a JVP/linearize tracer at ANY
+    nesting depth).
 
     The flash kernel's VJP returns no cotangent for its key-bias operand,
     so a bias that itself needs gradients (e.g. a learnable per-key bias)
     must stay on the XLA path; a constant padding mask — even inside jit
     or under grad-w.r.t.-params, where it is an ArrayImpl or a plain
-    DynamicJaxprTracer — still takes the kernel."""
-    name = type(x).__name__
-    if name in ("JVPTracer", "LinearizeTracer"):
-        return True
-    try:
-        from jax.interpreters import ad
+    DynamicJaxprTracer — still takes the kernel.
 
-        return isinstance(x, getattr(ad, "JVPTracer", ()))
-    except Exception:
-        return False
+    Transform stacks WRAP the AD tracer: under vmap(grad(f)) the bias is
+    a BatchTracer whose payload is the JVPTracer, so checking only the
+    outermost type would silently route a differentiated bias to the
+    kernel and return a zero cotangent.  Walk the nesting (BatchTracer
+    carries `.val`, JVP/Linearize carry `.primal`) until an AD tracer is
+    found or the payload stops being a tracer."""
+    from jax.core import Tracer
+
+    for _ in range(32):  # transform stacks are shallow; bound the walk
+        if type(x).__name__ in _AD_TRACER_NAMES:
+            return True
+        if not isinstance(x, Tracer):
+            return False
+        inner = getattr(x, "val", None)
+        if inner is None:
+            inner = getattr(x, "primal", None)
+        if inner is None or inner is x:
+            return False
+        x = inner
+    return False
 
 
 def xla_attention(q, k, v, causal=True, bias=None, dropout_rate=0.0,
@@ -84,7 +100,8 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
                         dropout_rng=None, train: bool = False,
                         scale: Optional[float] = None,
                         block_q: Optional[int] = None,
-                        block_k: Optional[int] = None):
+                        block_k: Optional[int] = None,
+                        bh_offset=0):
     """Dispatching attention entry point used by the GPT family and the
     DeepSpeedTransformerLayer.
 
@@ -124,7 +141,7 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
                 q, k, v, causal=causal, scale=scale, block_q=bq, block_k=bk,
                 dropout_rate=dropout_rate if want_dropout else 0.0,
                 dropout_rng=dropout_rng if want_dropout else None,
-                key_bias=key_bias)
+                key_bias=key_bias, bh_offset=bh_offset)
         if block_q or block_k:
             # explicit tuning request that cannot tile: say so instead of
             # silently paying the O(S^2) XLA path
@@ -133,6 +150,20 @@ def multihead_attention(q, k, v, causal: bool = True, impl: str = "auto",
             logger.warning(
                 f"flash blocks ({bq},{bk}) do not divide seq lens "
                 f"({S},{k.shape[1]}); falling back to XLA attention")
+    try:
+        offset_zero = int(bh_offset) == 0  # any concrete zero is a no-op
+    except Exception:  # traced (e.g. axis_index): unknowable at dispatch
+        offset_zero = False
+    if want_dropout and not offset_zero:
+        # the XLA path's dropout has no shard-offset notion — silently
+        # dropping it would re-correlate the shard masks the caller is
+        # explicitly decorrelating
+        raise ValueError(
+            "bh_offset is only honored by the flash kernel; this call "
+            "dispatched to XLA attention (non-TPU platform, untileable "
+            "shapes, a full bias, or a differentiated bias) with dropout "
+            "active — use impl='pallas' with tileable shapes, or drop "
+            "bh_offset")
     return xla_attention(q, k, v, causal=causal, bias=bias,
                          dropout_rate=dropout_rate, dropout_rng=dropout_rng,
                          train=train, scale=scale)

@@ -93,8 +93,16 @@ def derive_seed(dropout_rate, dropout_rng):
     return jnp.zeros((1,), jnp.int32), 0.0
 
 
+def compiler_params_cls():
+    # jax renamed TPUCompilerParams -> CompilerParams; accept either so
+    # the kernels run across the jax versions the repo supports (shared
+    # by every Pallas kernel in the repo — fix renames HERE only)
+    return (getattr(pltpu, "CompilerParams", None)
+            or getattr(pltpu, "TPUCompilerParams"))
+
+
 def _compiler_params():
-    return pltpu.CompilerParams(
+    return compiler_params_cls()(
         dimension_semantics=(pltpu.PARALLEL, pltpu.PARALLEL, pltpu.ARBITRARY))
 
 
@@ -148,7 +156,7 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, *rest, scale, causal, bq,
         # dropped, 1/keep-rescaled probabilities
         l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
         if rate > 0.0:
-            p = p * _keep_mask(seed_ref[0], bh,
+            p = p * _keep_mask(seed_ref[0], bh + seed_ref[1],
                                qi * bq, ki * bk, bq, bk, rate)
         acc[:] = acc[:] * alpha + jnp.dot(
             p.astype(v_ref.dtype), v_ref[0],
@@ -256,7 +264,7 @@ def _dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if rate > 0.0:
             # dS = P ∘ (mask/keep ∘ dPd − delta); delta = rowsum(dO∘O)
             # equals rowsum(Pd∘dPd), so the no-dropout delta trick holds
-            dp = dp * _keep_mask(seed_ref[0], bh,
+            dp = dp * _keep_mask(seed_ref[0], bh + seed_ref[1],
                                  qi * bq, ki * bk, bq, bk, rate)
         ds = p * (dp - delta_ref[0][:, :1])
         dq_acc[:] += scale * jnp.dot(ds.astype(k_ref.dtype), k_ref[0],
@@ -306,7 +314,7 @@ def _dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             # same (seed, bh, global q, global k) hash as the forward —
             # this kernel's grid swaps (ki, qi) but the mask arguments
             # stay in global-index order, so the tiles agree
-            mask = _keep_mask(seed_ref[0], bh,
+            mask = _keep_mask(seed_ref[0], bh + seed_ref[1],
                               qi * bq, ki * bk, bq, bk, rate)
             pd = p * mask
             dp_scale = mask
@@ -438,7 +446,8 @@ def flash_attention(q, k, v, causal: bool = True,
                     block_k: int = DEFAULT_BLOCK_K,
                     dropout_rate: float = 0.0,
                     dropout_rng=None,
-                    key_bias=None):
+                    key_bias=None,
+                    bh_offset=0):
     """Flash attention over [B, S, H, D] inputs (BSHD), causal or full.
 
     Requires S % block_q == 0 and S_k % block_k == 0 (the dispatcher in
@@ -455,6 +464,16 @@ def flash_attention(q, k, v, causal: bool = True,
     reference adds it pre-softmax in softmax_kernels.cu). Rows whose keys
     are ALL masked produce zero output (the XLA path's softmax yields a
     uniform don't-care row there instead).
+
+    bh_offset shifts the dropout hash's batch·head coordinate to the
+    GLOBAL index: the in-kernel mask hashes (seed, bh, q, k) with bh the
+    kernel-local program id, so when the inputs are a shard of a larger
+    batch/head space (DP batch shards, Ulysses head shards under
+    shard_map) every shard would otherwise draw the IDENTICAL mask
+    pattern for its local slots.  Manual-partition callers pass
+    `jax.lax.axis_index(axis) * local_BH` (may be traced — it rides the
+    SMEM seed operand) and shards become decorrelated while matching
+    the unsharded run bit-for-bit.
     """
     B, S, H, D = q.shape
     Sk = k.shape[1]
@@ -466,6 +485,9 @@ def flash_attention(q, k, v, causal: bool = True,
                          f"{dropout_rate}")
     scale = (D ** -0.5) if scale is None else scale
     seed, rate = derive_seed(dropout_rate, dropout_rng)
+    # seed row 1 carries the global batch·head offset for the hash
+    seed = jnp.concatenate(
+        [seed, jnp.asarray(bh_offset, jnp.int32).reshape(1)])
     kb = None
     if key_bias is not None:
         kb = jnp.asarray(key_bias, jnp.float32).reshape(-1, Sk)

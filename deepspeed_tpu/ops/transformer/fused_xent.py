@@ -39,7 +39,9 @@ def _interpret() -> bool:
 
 
 def _params():
-    return pltpu.CompilerParams(
+    from .flash_attention import compiler_params_cls
+
+    return compiler_params_cls()(
         dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY))
 
 

@@ -53,6 +53,12 @@ def ulysses_attention(q, k, v, attention_fn, causal: bool = True,
     mask pattern for their local head slots. Per-head statistics are
     unaffected (correct rate and scaling per head) — only cross-device
     mask IDENTITY correlates, which dense-path training never observes.
+    Manual-partition callers (shard_map over batch or heads) decorrelate
+    shards by passing `bh_offset=jax.lax.axis_index(axis) * local_BH`
+    through to flash_attention — the hash then uses the GLOBAL
+    batch·head coordinate and matches the unsharded run bit-for-bit
+    (tests/test_flash_attention.py pins it); this SPMD-constraint path
+    has no manual axis in scope, so the note above stands here.
     """
     head_spec = P(DATA_AXIS, None, seq_axis, None)
     seq_spec = P(DATA_AXIS, seq_axis, None, None)

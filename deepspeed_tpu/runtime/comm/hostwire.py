@@ -29,11 +29,25 @@ bandwidth contender — same positioning as the reference's MPI path.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Tuple
 
 import numpy as np
 
 DEFAULT_TIMEOUT_MS = 120_000
+
+# -- scaling envelope (documented contract) ---------------------------------
+# The KV store relays every value THROUGH the coordinator as one gRPC
+# message, so a single huge value both hits the transport's message cap
+# (4 MiB default gRPC, raised but not unbounded in the coordination
+# service) and serializes the relay.  Payloads above CHUNK_BYTES are
+# split into part keys and reassembled on the readers — transparent to
+# callers.  Payloads above MAX_PAYLOAD_BYTES are refused loudly: at that
+# size the host wire is the wrong substrate (coordinator upload is
+# ~W × payload per step), use the XLA-collective backend or shrink the
+# wire format (sign instead of int8).
+CHUNK_BYTES = 2 << 20          # 2 MiB: safely under gRPC message caps
+MAX_PAYLOAD_BYTES = 128 << 20  # 128 MiB/rank/step: the envelope edge
 
 
 def _client():
@@ -45,6 +59,26 @@ def _client():
     return state.client, state.process_id, state.num_processes
 
 
+def _kv_set(client, key: str, payload: bytes) -> None:
+    """Store bytes under `key` via the STRING KV entry points.
+
+    The *_bytes variants segfault in some jaxlib builds (0.4.36
+    observed, flat keys included), while key_value_set /
+    blocking_key_value_get are stable everywhere — so the wire rides the
+    string API with base64 framing.  The 4/3 expansion is priced into
+    CHUNK_BYTES: a 2 MiB raw chunk is ~2.7 MiB encoded, still under the
+    4 MiB gRPC message cap."""
+    import base64
+
+    client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
+
+
+def _kv_get(client, key: str, timeout_ms: int) -> bytes:
+    import base64
+
+    return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+
+
 class HostWire:
     """Allgather of byte payloads over the coordination-service KV store.
 
@@ -53,31 +87,73 @@ class HostWire:
     after a barrier, so coordinator memory stays bounded."""
 
     def __init__(self, tag: str = "dstpu-hostwire",
-                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
-        self.client, self.rank, self.world = _client()
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                 chunk_bytes: int = CHUNK_BYTES,
+                 max_payload_bytes: int = MAX_PAYLOAD_BYTES,
+                 _endpoint=None):
+        # _endpoint=(client, rank, world) lets tests drive the wire over
+        # a fake in-memory KV store without jax.distributed processes
+        self.client, self.rank, self.world = (
+            _endpoint if _endpoint is not None else _client())
         self.tag = tag
         self.timeout_ms = timeout_ms
+        self.chunk_bytes = int(chunk_bytes)
+        self.max_payload_bytes = int(max_payload_bytes)
         self._step = 0
 
     def allgather_bytes(self, payload: bytes) -> list:
-        """payload from every process, in rank order."""
+        """payload from every process, in rank order.
+
+        Payloads above `chunk_bytes` ride multiple part keys (the KV
+        relay's message envelope — see module constants); above
+        `max_payload_bytes` the call refuses with a clear error instead
+        of wedging the coordinator."""
+        if len(payload) > self.max_payload_bytes:
+            raise ValueError(
+                f"hostwire payload of {len(payload)} bytes exceeds the "
+                f"host-wire envelope ({self.max_payload_bytes} bytes/rank/"
+                f"step): the coordination-service KV relay is for SMALL "
+                f"compressed payloads — use the XLA-collective backend "
+                f"(runtime/comm/compressed.py) or a denser wire format "
+                f"for tensors this large")
         if self.client is None or self.world == 1:
             self._step += 1
             return [payload]
         key = f"{self.tag}/{self._step}"
-        self.client.key_value_set_bytes(f"{key}/{self.rank}", payload)
-        out = [
-            payload if r == self.rank else
-            self.client.blocking_key_value_get_bytes(
-                f"{key}/{r}", self.timeout_ms)
-            for r in range(self.world)
-        ]
+        cb = self.chunk_bytes
+        nparts = max(1, -(-len(payload) // cb))
+        _kv_set(self.client, f"{key}/{self.rank}/n",
+                str(nparts).encode())
+        for i in range(nparts):
+            _kv_set(self.client, f"{key}/{self.rank}/{i}",
+                    payload[i * cb:(i + 1) * cb])
+        # ONE deadline for the whole gather: timeout_ms bounds the call,
+        # not each of the W x nparts gets (a dead peer must surface in
+        # ~timeout_ms regardless of payload size)
+        deadline = time.monotonic() + self.timeout_ms / 1000.0
+
+        def remaining_ms():
+            return max(1, int((deadline - time.monotonic()) * 1000))
+
+        out = []
+        counts = {self.rank: nparts}
+        for r in range(self.world):
+            if r == self.rank:
+                out.append(payload)
+                continue
+            counts[r] = int(_kv_get(self.client, f"{key}/{r}/n",
+                                    remaining_ms()))
+            out.append(b"".join(
+                _kv_get(self.client, f"{key}/{r}/{i}", remaining_ms())
+                for i in range(counts[r])))
         # nobody may delete until everyone has read; nobody may proceed
         # to the NEXT step's set() until this step's keys are gone
         self.client.wait_at_barrier(f"{key}/read", self.timeout_ms)
         if self.rank == 0:
             for r in range(self.world):
-                self.client.key_value_delete(f"{key}/{r}")
+                self.client.key_value_delete(f"{key}/{r}/n")
+                for i in range(counts[r]):
+                    self.client.key_value_delete(f"{key}/{r}/{i}")
         self.client.wait_at_barrier(f"{key}/clean", self.timeout_ms)
         self._step += 1
         return out
@@ -111,10 +187,16 @@ class HostWireBackend:
     INT8_GROUP = 2048
 
     def __init__(self, tag: str = "dstpu-onebit", wire: str = "sign",
-                 timeout_ms: int = DEFAULT_TIMEOUT_MS):
+                 timeout_ms: int = DEFAULT_TIMEOUT_MS,
+                 chunk_bytes: int = CHUNK_BYTES,
+                 max_payload_bytes: int = MAX_PAYLOAD_BYTES,
+                 _endpoint=None):
         if wire not in ("sign", "int8"):
             raise ValueError(f"wire must be 'sign' or 'int8', got {wire!r}")
-        self.wire = HostWire(tag=tag, timeout_ms=timeout_ms)
+        self.wire = HostWire(tag=tag, timeout_ms=timeout_ms,
+                             chunk_bytes=chunk_bytes,
+                             max_payload_bytes=max_payload_bytes,
+                             _endpoint=_endpoint)
         self.mode = wire
         self._errors: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
 

@@ -179,6 +179,11 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         # dryrun runs the real cross-process code path this way)
         self.pipe_use_p2p_channels = bool(
             (pd.get("pipeline") or {}).get("use_p2p_channels", False))
+        # debug_schedule selects the per-event interpreted schedule walk
+        # (the parity oracle / bring-up executor) instead of the default
+        # precompiled flat program (runtime/pipe/compiler.py)
+        self.pipe_debug_schedule = bool(
+            (pd.get("pipeline") or {}).get("debug_schedule", False))
 
         self.activation_checkpointing_config = \
             DeepSpeedActivationCheckpointingConfig(pd)

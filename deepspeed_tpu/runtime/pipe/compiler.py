@@ -1,0 +1,431 @@
+"""Pipeline schedule compiler — flat per-rank programs for the 1F1B walk.
+
+BENCH.md round-5 measured the interpreted canonical walk at ~300 µs of
+serialized Python per schedule event (schedule-stream regeneration +
+dependency re-simulation + isinstance dispatch + counter/dict/mail
+bookkeeping, every train_batch), 12-16 % of step time on CPU-mesh grains
+and projected ~150 ms/step at 8 stages x 16 micro batches. This module
+removes the interpreter from that inner loop:
+
+* `compile_schedule` lowers the canonical event order (the output of
+  engine._simulate_order — identical on every process, the property that
+  keeps the channel handoffs deadlock-free) ONCE into a flat, immutable
+  program: parallel tuples of opcode / model-chunk / micro-id / buffer
+  slots.  Micro ids are precomputed, so the run-time recv/send/fwd/bwd
+  counters disappear entirely.
+
+* every Send+Recv pair is FUSED into a single transfer op placed at the
+  send's position.  The data transfer already happens at the send event
+  in the interpreted walk (the recv is pure mail-dict bookkeeping), so
+  the collective entry order across processes is unchanged — only the
+  Python disappears.  Fusion is made unconditionally safe by giving the
+  fused write a liveness-fresh buffer slot (below) instead of the
+  schedule's recv-time slot.
+
+* buffer slots are resolved once by liveness analysis into preallocated
+  per-stage pools (plain lists — the double-buffered pool): each
+  (chunk, micro) value gets a slot live from its writing event to its
+  last reading event.  No dict hashing, no (mc, mb) tuple keys, no mail
+  dict at run time.
+
+* `bind_program` turns the flat program into a list of zero-argument
+  closures with every static decision (stage runtime, slot indices, rng
+  fold constants, transfer plans/shardings) resolved at bind time.  The
+  executor loop in engine.py is then `for f in steps: f()` — it touches
+  no Python objects besides the program list and the pools.  On
+  multi-host ranks, events with no local role are pruned at bind time
+  (the interpreted walk pays Python for every remote event).
+
+The interpreted walk stays available as `pipeline.debug_schedule: true`
+— the parity oracle (tests pin bit-identical losses) and the
+reference-shaped executor for new-instruction bring-up.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import numpy as np
+
+import jax
+
+from .p2p import batch_shardable
+from .schedule import (BackwardPass, ForwardPass, LoadMicroBatch,
+                       OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
+                       ReduceTiedGrads, SendActivation, SendGrad)
+
+# opcodes (flat-program ISA)
+OP_LOAD = 0        # (mc, mb, x_slot)
+OP_FWD = 1         # (mc, mb, x_slot, y_slot)   y_slot < 0: output unused
+OP_XFER_ACT = 2    # (src_mc, mb, y_slot, dst_x_slot)    fused send+recv
+OP_BWD = 3         # (mc, mb, x_slot, dy_slot, dx_slot)  dy<0: last stage
+OP_XFER_GRAD = 4   # (src_mc, mb, dx_slot, dst_dy_slot)  fused send+recv
+OP_TIED = 5        # ()
+OP_STEP = 6        # ()
+
+OP_NAMES = {OP_LOAD: "load", OP_FWD: "fwd", OP_XFER_ACT: "xfer_act",
+            OP_BWD: "bwd", OP_XFER_GRAD: "xfer_grad", OP_TIED: "tied",
+            OP_STEP: "step"}
+
+
+class PipeProgram:
+    """Immutable lowered schedule: one entry per executed event.
+
+    events: tuple of tuples — (op, mc, mb, a, b, c) with slot fields per
+    the opcode table above (unused fields -1).  pool_sizes maps
+    (mc, kind) -> required slot count, kind in {x, y, dy, dx}; the `x`
+    pool also carries the forward rng (identical liveness).
+    """
+
+    __slots__ = ("events", "pool_sizes", "n_mc", "micro_batches",
+                 "n_source_events")
+
+    def __init__(self, events, pool_sizes, n_mc, micro_batches,
+                 n_source_events):
+        self.events = tuple(events)
+        self.pool_sizes = dict(pool_sizes)
+        self.n_mc = n_mc
+        self.micro_batches = micro_batches
+        # pre-fusion event count (for dispatch-rate accounting)
+        self.n_source_events = n_source_events
+
+    def __repr__(self):
+        ops = ", ".join(OP_NAMES[e[0]] for e in self.events[:8])
+        return (f"PipeProgram({len(self.events)} events from "
+                f"{self.n_source_events}, n_mc={self.n_mc}, "
+                f"M={self.micro_batches}, [{ops}...])")
+
+
+def compile_schedule(events, mc_of: Callable[[int, Any], int], n_mc: int,
+                     micro_batches: int) -> PipeProgram:
+    """Lower a canonical (stage, instruction) event list to a PipeProgram.
+
+    `events` is engine._simulate_order's output; `mc_of` maps
+    (stage, cmd) to the model-chunk index (engine._mc).  Pure structural
+    lowering — no engine state is touched, so the result is reusable for
+    every train_batch with the same (M, stages, interleave).
+    """
+    # -- pass 1: assign micro ids with the same counters the interpreted
+    # dispatch uses, and drop bookkeeping-only instructions --------------
+    events = list(events)
+    fwd_cnt = [0] * n_mc
+    bwd_cnt = [0] * n_mc
+    sent_act = [0] * n_mc
+    sent_grad = [0] * n_mc
+    recv_act = [0] * n_mc
+    recv_grad = [0] * n_mc
+    load_cnt = 0
+    mid: List[Tuple[int, int, int]] = []   # (kind, mc, mb)
+    # one OP_TIED / OP_STEP per batch, placed at the LAST canonical
+    # occurrence: every stage's stream carries one of each, and only at
+    # the last one (stage 0's, after the globally final backward) are all
+    # gradients complete.  Emitting at the first occurrence would apply
+    # the optimizer while earlier stages' cooldown backwards are still
+    # accumulating — dropped gradients this step, leakage into the next.
+    tied_left = sum(isinstance(c, ReduceTiedGrads) for _, c in events)
+    step_left = sum(isinstance(c, OptimizerStep) for _, c in events)
+    n_source = 0
+    for s, cmd in events:
+        n_source += 1
+        mc = mc_of(s, cmd)
+        if isinstance(cmd, LoadMicroBatch):
+            mid.append((OP_LOAD, mc, load_cnt))
+            load_cnt += 1
+        elif isinstance(cmd, ForwardPass):
+            mid.append((OP_FWD, mc, fwd_cnt[mc]))
+            fwd_cnt[mc] += 1
+        elif isinstance(cmd, SendActivation):
+            mid.append((OP_XFER_ACT, mc, sent_act[mc]))
+            sent_act[mc] += 1
+        elif isinstance(cmd, RecvActivation):
+            # fused into the matching send (the transfer happens at the
+            # send position in the interpreted walk too); assert the
+            # canonical order really delivered before consumption
+            mb = recv_act[mc]
+            recv_act[mc] += 1
+            if sent_act[mc - 1] < mb + 1:
+                raise AssertionError(
+                    f"recv_act before send for chunk {mc} micro {mb}")
+        elif isinstance(cmd, BackwardPass):
+            mid.append((OP_BWD, mc, bwd_cnt[mc]))
+            bwd_cnt[mc] += 1
+        elif isinstance(cmd, SendGrad):
+            mid.append((OP_XFER_GRAD, mc, sent_grad[mc]))
+            sent_grad[mc] += 1
+        elif isinstance(cmd, RecvGrad):
+            mb = recv_grad[mc]
+            recv_grad[mc] += 1
+            if sent_grad[mc + 1] < mb + 1:
+                raise AssertionError(
+                    f"recv_grad before send for chunk {mc} micro {mb}")
+        elif isinstance(cmd, ReduceTiedGrads):
+            tied_left -= 1
+            if tied_left == 0:
+                mid.append((OP_TIED, -1, -1))
+        elif isinstance(cmd, OptimizerStep):
+            step_left -= 1
+            if step_left == 0:
+                mid.append((OP_STEP, -1, -1))
+        elif isinstance(cmd, ReduceGrads):
+            pass  # within-stage dp reduction is implicit in the jitted loss
+        else:
+            raise NotImplementedError(f"instruction {cmd!r}")
+
+    # -- pass 2: find each value's last reader (liveness) ----------------
+    # value keys: ("x"|"y"|"dy"|"dx", mc, mb)
+    last_read: Dict[Tuple[str, int, int], int] = {}
+    for i, (kind, mc, mb) in enumerate(mid):
+        if kind == OP_FWD:
+            last_read[("x", mc, mb)] = i          # read again by BWD below
+        elif kind == OP_XFER_ACT:
+            last_read[("y", mc, mb)] = i
+        elif kind == OP_BWD:
+            last_read[("x", mc, mb)] = i
+            last_read[("dy", mc, mb)] = i
+        elif kind == OP_XFER_GRAD:
+            last_read[("dx", mc, mb)] = i
+
+    # -- pass 3: slot allocation + final event emission ------------------
+    free: Dict[Tuple[int, str], List[int]] = {}
+    high: Dict[Tuple[int, str], int] = {}
+    slot_of: Dict[Tuple[str, int, int], int] = {}
+
+    def alloc(kind, mc, mb):
+        pool = free.setdefault((mc, kind), [])
+        if pool:
+            s = pool.pop()
+        else:
+            s = high.get((mc, kind), 0)
+            high[(mc, kind)] = s + 1
+        slot_of[(kind, mc, mb)] = s
+        return s
+
+    def read(kind, mc, mb, i):
+        s = slot_of[(kind, mc, mb)]
+        if last_read.get((kind, mc, mb)) == i:
+            free.setdefault((mc, kind), []).append(s)
+        return s
+
+    out: List[Tuple[int, int, int, int, int]] = []
+    for i, (kind, mc, mb) in enumerate(mid):
+        if kind == OP_LOAD:
+            out.append((OP_LOAD, mc, mb, alloc("x", mc, mb), -1, -1))
+        elif kind == OP_FWD:
+            x = read("x", mc, mb, i)
+            y = -1
+            if ("y", mc, mb) in last_read:      # someone will send it
+                y = alloc("y", mc, mb)
+            out.append((OP_FWD, mc, mb, x, y, -1))
+        elif kind == OP_XFER_ACT:
+            y = read("y", mc, mb, i)
+            x = alloc("x", mc + 1, mb)
+            out.append((OP_XFER_ACT, mc, mb, y, x, -1))
+        elif kind == OP_BWD:
+            x = read("x", mc, mb, i)
+            dy = (read("dy", mc, mb, i)
+                  if ("dy", mc, mb) in slot_of else -1)
+            dx = (alloc("dx", mc, mb)
+                  if ("dx", mc, mb) in last_read else -1)
+            out.append((OP_BWD, mc, mb, x, dy, dx))
+        elif kind == OP_XFER_GRAD:
+            dx = read("dx", mc, mb, i)
+            dy = alloc("dy", mc - 1, mb)
+            out.append((OP_XFER_GRAD, mc, mb, dx, dy, -1))
+        else:
+            out.append((kind, -1, -1, -1, -1, -1))
+
+    pool_sizes = {k: v for k, v in high.items()}
+    return PipeProgram(out, pool_sizes, n_mc, micro_batches, n_source)
+
+
+# ---------------------------------------------------------------------------
+# binding: flat program -> list of zero-arg closures
+# ---------------------------------------------------------------------------
+
+def _leaf_shardings(rt, avals):
+    """Per-leaf placement tree for a payload landing on stage rt — the
+    SAME batch_shardable rule the interpreted path applies per event,
+    resolved once here."""
+    G = len(rt.devices)
+    return jax.tree_util.tree_map(
+        lambda a: rt.batch_sharding if batch_shardable(a.shape, G)
+        else rt.replicated, avals)
+
+
+def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
+    """Lower a PipeProgram to executable closures against `engine`.
+
+    out_avals[mc] is the output aval tree of model chunk mc (from
+    engine._chunk_out_avals).  Every static decision — stage runtime,
+    slot index, rng fold constant, device_put sharding or channel
+    transfer plan — is resolved here; the returned closures only index
+    pools and call the already-jitted stage programs.  Closures read
+    mutable engine/runtime state (params, scaler, micro-batch cache)
+    through attribute access so checkpoint reloads keep working.
+
+    Multi-host: events with no local role on this process are pruned
+    (channel ops keep their collective entry order — both endpoints bind
+    them at the same program positions).
+    """
+    mh = engine._mh
+    n_mc = prog.n_mc
+    fold_in = jax.random.fold_in
+
+    def rt_of(mc):
+        if mh:
+            return engine._local.get(mc)
+        return engine.stages[mc]
+
+    # preallocated double-buffered pools (the x pool rides rng + x)
+    pools: Dict[Tuple[int, str], List[Any]] = {
+        k: [None] * n for k, n in prog.pool_sizes.items()}
+    rngs: Dict[int, List[Any]] = {
+        mc: [None] * n for (mc, kind), n in prog.pool_sizes.items()
+        if kind == "x"}
+    labels_pool: List[Any] = [None] * prog.micro_batches
+
+    steps: List[Callable[[], None]] = []
+    for op, mc, mb, a, b, c in prog.events:
+        if op == OP_LOAD:
+            rt = rt_of(mc)
+            if rt is None:
+                continue
+            xp, slot = pools[(mc, "x")], a
+            place = rt.place_batch
+
+            def f_load(eng=engine, xp=xp, slot=slot, mb=mb, place=place):
+                xp[slot] = place(eng._mb_cache[mb][0])
+            steps.append(f_load)
+        elif op == OP_FWD:
+            rt = rt_of(mc)
+            if rt is None:
+                continue
+            xp, rp = pools[(mc, "x")], rngs[mc]
+            fold_const = mb * n_mc + mc
+            if rt.is_last:
+                def f_fwd_last(eng=engine, rt=rt, xp=xp, rp=rp, slot=a,
+                               mb=mb, fc=fold_const, fold_in=fold_in,
+                               labels_pool=labels_pool):
+                    rng = fold_in(eng._batch_key, fc)
+                    rp[slot] = rng
+                    labels = rt.place_batch(
+                        np.asarray(eng._mb_cache[mb][1]))
+                    labels_pool[mb] = labels
+                    rt.losses.append(rt.loss_j(rt.own, rt.ro_tied,
+                                               xp[slot], labels, rng))
+                steps.append(f_fwd_last)
+            else:
+                yp = pools.get((mc, "y"))
+                def f_fwd(eng=engine, rt=rt, xp=xp, rp=rp, yp=yp,
+                          xs=a, ys=b, fc=fold_const, fold_in=fold_in):
+                    rng = fold_in(eng._batch_key, fc)
+                    rp[xs] = rng
+                    y = rt.fwd_j(rt.own, rt.ro_tied, xp[xs], rng)
+                    if ys >= 0:
+                        yp[ys] = y
+                steps.append(f_fwd)
+        elif op == OP_BWD:
+            rt = rt_of(mc)
+            if rt is None:
+                continue
+            xp, rp = pools[(mc, "x")], rngs[mc]
+            dxp = pools.get((mc, "dx"))
+            if rt.is_last:
+                def f_bwd_last(eng=engine, rt=rt, xp=xp, rp=rp, dxp=dxp,
+                               xs=a, dxs=c, mb=mb, labels_pool=labels_pool):
+                    x = xp[xs]
+                    xp[xs] = None
+                    rng = rp[xs]
+                    rp[xs] = None
+                    labels = labels_pool[mb]
+                    labels_pool[mb] = None
+                    scale = eng._scaler_state["cur_scale"]
+                    dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                        rt.own, rt.ro_tied, x, labels, rng, scale,
+                        rt.acc, rt.acc_ro)
+                    if dxs >= 0:
+                        dxp[dxs] = dx
+                steps.append(f_bwd_last)
+            else:
+                dyp = pools[(mc, "dy")]
+                def f_bwd(rt=rt, xp=xp, rp=rp, dyp=dyp, dxp=dxp,
+                          xs=a, dys=b, dxs=c):
+                    x = xp[xs]
+                    xp[xs] = None
+                    rng = rp[xs]
+                    rp[xs] = None
+                    dy = dyp[dys]
+                    dyp[dys] = None
+                    dx, rt.acc, rt.acc_ro = rt.bwd_j(
+                        rt.own, rt.ro_tied, x, rng, dy, rt.acc, rt.acc_ro)
+                    if dxs >= 0:
+                        dxp[dxs] = dx
+                steps.append(f_bwd)
+        elif op == OP_XFER_ACT:
+            f = _bind_xfer(engine, mh, src_mc=mc, dst_mc=mc + 1,
+                           avals=out_avals[mc],
+                           src_pool=pools.get((mc, "y")), src_slot=a,
+                           dst_pool=pools[(mc + 1, "x")], dst_slot=b,
+                           chan=(engine._chan_act.get(mc) if mh else None),
+                           rt_of=rt_of)
+            if f is not None:
+                steps.append(f)
+        elif op == OP_XFER_GRAD:
+            f = _bind_xfer(engine, mh, src_mc=mc, dst_mc=mc - 1,
+                           avals=out_avals[mc - 1],
+                           src_pool=pools.get((mc, "dx")), src_slot=a,
+                           dst_pool=pools[(mc - 1, "dy")], dst_slot=b,
+                           chan=(engine._chan_grad.get(mc) if mh else None),
+                           rt_of=rt_of)
+            if f is not None:
+                steps.append(f)
+        elif op == OP_TIED:
+            steps.append(engine._reduce_tied_grads_mh if mh
+                         else engine._reduce_tied_grads)
+        elif op == OP_STEP:
+            steps.append(engine._pipe_optimizer_step_mh if mh
+                         else engine._pipe_optimizer_step)
+        else:
+            raise NotImplementedError(f"opcode {op}")
+    return steps
+
+
+def _bind_xfer(engine, mh, src_mc, dst_mc, avals, src_pool, src_slot,
+               dst_pool, dst_slot, chan, rt_of):
+    """One fused send+recv: returns a closure or None (no local role)."""
+    if not mh:
+        # single-controller: a device_put resharding, target layout
+        # resolved once from the aval (the interpreted path re-derives it
+        # per event from the runtime value's shape)
+        rt_dst = rt_of(dst_mc)
+        sh = _leaf_shardings(rt_dst, avals)
+        device_put = jax.device_put
+
+        def f_put(sp=src_pool, ss=src_slot, dp=dst_pool, ds=dst_slot,
+                  sh=sh, device_put=device_put):
+            y = sp[ss]
+            sp[ss] = None
+            dp[ds] = device_put(y, sh)
+        return f_put
+    if chan is None:
+        return None  # this process is not an endpoint: prune
+    plan = chan.plan(avals)
+    src_local = rt_of(src_mc) is not None
+    dst_local = rt_of(dst_mc) is not None
+    if src_local and dst_local:
+        def f_both(sp=src_pool, ss=src_slot, dp=dst_pool, ds=dst_slot,
+                   plan=plan):
+            y = sp[ss]
+            sp[ss] = None
+            dp[ds] = plan(y)
+        return f_both
+    if src_local:
+        def f_src(sp=src_pool, ss=src_slot, plan=plan):
+            y = sp[ss]
+            sp[ss] = None
+            plan(y)
+        return f_src
+
+    def f_dst(dp=dst_pool, ds=dst_slot, plan=plan):
+        dp[ds] = plan(None)
+    return f_dst

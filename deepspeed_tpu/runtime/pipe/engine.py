@@ -45,6 +45,7 @@ from ...utils.logging import log_dist, logger
 from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
 from ..utils import has_overflow
+from .compiler import bind_program, compile_schedule
 from .module import PipelineModule, TiedLayerSpec
 from .p2p import Channel, GlobalScalars, batch_shardable
 from .schedule import (BackwardPass, ForwardPass, InterleavedTrainSchedule,
@@ -257,6 +258,12 @@ class PipelineEngine(DeepSpeedEngine):
         self._mh = bool(self._staged and (
             jax.process_count() > 1
             or self._config.pipe_use_p2p_channels))
+        # the interpreted per-event walk is the parity oracle and the
+        # bring-up executor; the compiled flat program is the default
+        # (BENCH.md round-5: ~300 us of serialized Python per event)
+        self._debug_schedule = bool(self._config.pipe_debug_schedule)
+        self._pipe_prog = None
+        self._bound_cache: Dict[Any, Any] = {}
         if self._staged:
             if self._mh:
                 self._build_stages_mh()
@@ -289,6 +296,14 @@ class PipelineEngine(DeepSpeedEngine):
         # the layers in sequence; interleaving only changes which device
         # group hosts each chunk (chunk mc -> physical stage mc % P).
         full = jax.tree_util.tree_map(np.asarray, self._params)
+        # abstract param trees: _chunk_out_avals derives every chunk's
+        # output aval from these (shared with the mh build; the compiled
+        # executor resolves transfer layouts from avals at bind time)
+        abst = lambda t: jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), t)
+        self._abs_layers = [abst(lp) for lp in full["layers"]]
+        self._abs_tied = {k: abst(t) for k, t in full["tied"].items()}
+        self._aval_cache: Dict[Any, Any] = {}
         self.stages: List[_StageRuntime] = []
         for mc in range(n_mc):
             s_phys = mc % P
@@ -596,7 +611,6 @@ class PipelineEngine(DeepSpeedEngine):
         self._aval_out = self._chunk_out_avals(
             jax.ShapeDtypeStruct(x0.shape, x0.dtype))
         n = self._n_mc
-        P = self._n_phys
         self._mail_act = {}
         self._mail_grad = {}
         self._sent_act_cnt = [0] * n
@@ -605,18 +619,12 @@ class PipelineEngine(DeepSpeedEngine):
         self._recv_grad_cnt = [0] * n
         self._load_cnt = 0
         self._batch_key = self._next_rng()
-        self._step_applied = False
-        self._tied_reduced = False
+        streams = self._pipe_streams()
+        self._arm_step_guards(streams)
         for rt in self._local.values():
             rt.losses = []
             rt.fwd_count = 0
             rt.bwd_count = 0
-        if self._v > 1:
-            streams = [list(InterleavedTrainSchedule(
-                M, P, s, self._v).steps()) for s in range(P)]
-        else:
-            streams = [list(TrainSchedule(M, P, s).steps())
-                       for s in range(P)]
         for s, cmd in self._simulate_order(streams):
             self._dispatch_mh(s, cmd)
         self.micro_steps += M
@@ -720,10 +728,11 @@ class PipelineEngine(DeepSpeedEngine):
     def _reduce_tied_grads_mh(self):
         """Ship tied grads to the owner chunk: local pairs by direct add,
         cross-process pairs through their dedicated channel, all walked in
-        the same sorted order on every process."""
-        if self._tied_reduced:
+        the same sorted order on every process.  Runs at the LAST
+        canonical ReduceTiedGrads (see _arm_step_guards)."""
+        self._tied_pending -= 1
+        if self._tied_pending > 0:
             return
-        self._tied_reduced = True
         f32 = lambda t: jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, jnp.float32), t)
         for key in sorted(self._tied_users):
@@ -752,10 +761,9 @@ class PipelineEngine(DeepSpeedEngine):
                         jnp.add, ort.acc["tied"][key], res)
 
     def _pipe_optimizer_step_mh(self):
-        if self._step_applied:
+        self._step_pending -= 1
+        if self._step_pending > 0:
             return
-        self._step_applied = True
-        self._tied_reduced = False
         M = self.micro_batches
         denom = jnp.asarray(self._scaler_state["cur_scale"] * M,
                             jnp.float32)
@@ -832,6 +840,89 @@ class PipelineEngine(DeepSpeedEngine):
         c * n_phys + s); plain 1F1B instructions default to chunk 0."""
         return getattr(cmd, "chunk_id", 0) * self._n_phys + s
 
+    def _pipe_streams(self):
+        """Per-stage instruction streams for one train_batch — the ONE
+        place both executors (and the schedule compiler) get them."""
+        M = self.micro_batches
+        P = self._n_phys
+        if self._v > 1:
+            return [list(InterleavedTrainSchedule(M, P, s, self._v).steps())
+                    for s in range(P)]
+        return [list(TrainSchedule(M, P, s).steps()) for s in range(P)]
+
+    def _arm_step_guards(self, streams):
+        """Per-batch countdowns for the interpreted walk: tied-grad
+        reduction and the optimizer step must run at their LAST canonical
+        occurrence (each stage's stream carries one of each; only at the
+        last one — stage 0's, whose cooldown backward is the globally
+        final backward — are every stage's gradients complete).  Acting
+        at the first occurrence, as earlier rounds did, applied the
+        optimizer while later events were still accumulating: those
+        gradients were dropped from the step and leaked into the next
+        batch's accumulators."""
+        cmds = [c for st in streams for tick in st
+                for c in (tick if isinstance(tick, (list, tuple))
+                          else (tick,))]
+        self._tied_pending = sum(isinstance(c, ReduceTiedGrads)
+                                 for c in cmds)
+        self._step_pending = sum(isinstance(c, OptimizerStep)
+                                 for c in cmds)
+
+    def _compiled_steps(self, x_aval):
+        """Bound flat-program executor for this engine's schedule and the
+        given input aval (cached — lowering runs once per engine, binding
+        once per input shape)."""
+        key = (tuple(x_aval.shape), str(x_aval.dtype))
+        steps = self._bound_cache.get(key)
+        if steps is None:
+            if self._pipe_prog is None:
+                events = self._simulate_order(self._pipe_streams())
+                self._pipe_prog = compile_schedule(
+                    events, self._mc, self._n_mc, self.micro_batches)
+            steps = bind_program(self, self._pipe_prog,
+                                 self._chunk_out_avals(x_aval))
+            self._bound_cache[key] = steps
+        return steps
+
+    def _train_batch_compiled(self, data_iter):
+        """Default train_batch executor: an index walk over the bound
+        flat program (compiler.py) — no schedule regeneration, no
+        dependency re-simulation, no isinstance dispatch, no counter or
+        mail-dict bookkeeping per event.  `pipeline.debug_schedule: true`
+        selects the interpreted per-event oracle instead; the two are
+        pinned bit-identical by tests/test_pipe_compiler.py."""
+        self.tput_timer.start()
+        M = self.micro_batches
+        self._mb_cache = [self._next_micro_batch_from(data_iter)
+                          for _ in range(M)]
+        x0 = np.asarray(self._mb_cache[0][0])
+        steps = self._compiled_steps(
+            jax.ShapeDtypeStruct(x0.shape, x0.dtype))
+        self._batch_key = self._next_rng()
+        # the flat program emits exactly one OP_TIED and one OP_STEP (at
+        # the canonical LAST occurrence — all backwards precede them)
+        self._tied_pending = 1
+        self._step_pending = 1
+        for rt in (self._local.values() if self._mh else self.stages):
+            rt.losses = []
+        for f in steps:
+            f()
+        if not self._mh:
+            # mh sets _last_loss inside _pipe_optimizer_step_mh (global
+            # reduction); single-controller averages the local losses the
+            # same way the interpreted walk does
+            last = self.stages[-1]
+            self._last_loss = (jnp.mean(jnp.stack(last.losses))
+                               if last.losses else None)
+        self.micro_steps += M
+        self.global_samples += self.train_batch_size()
+        self.tput_timer.stop(report_speed=False)
+        if self.steps_per_print() and \
+                self.global_steps % self.steps_per_print() == 0:
+            log_dist(f"pipe step={self.global_steps} "
+                     f"loss={float(self._last_loss):.4f}", ranks=[0])
+        return self._last_loss
+
     def train_batch(self, data_iter=None):
         if not self._staged:
             return super().train_batch(data_iter)
@@ -842,18 +933,18 @@ class PipelineEngine(DeepSpeedEngine):
                 from ..dataloader import RepeatingLoader
                 self._train_iter = iter(RepeatingLoader(self.training_dataloader))
             data_iter = self._train_iter
+        if not self._debug_schedule:
+            return self._train_batch_compiled(data_iter)
         if self._mh:
             return self._train_batch_mh(data_iter)
 
         self.tput_timer.start()
         M = self.micro_batches
         n_rt = len(self.stages)
-        P = self._n_phys
         self._mail_act: Dict[Any, Any] = {}
         self._mail_grad: Dict[Any, Any] = {}
         self._data_iter = data_iter
         self._batch_key = self._next_rng()
-        self._step_applied = False
         self._recv_act_cnt = [0] * n_rt
         self._recv_grad_cnt = [0] * n_rt
         self._sent_act_cnt = [0] * n_rt
@@ -863,15 +954,11 @@ class PipelineEngine(DeepSpeedEngine):
             rt.fwd_count = 0
             rt.bwd_count = 0
 
-        if self._v > 1:
-            streams = [list(InterleavedTrainSchedule(
-                M, P, s, self._v).steps()) for s in range(P)]
-        else:
-            streams = [list(TrainSchedule(M, P, s).steps())
-                       for s in range(P)]
         # the single-controller executor consumes the same canonical
         # event order the multi-host executor derives — one dependency
         # resolver for both (see _simulate_order)
+        streams = self._pipe_streams()
+        self._arm_step_guards(streams)
         for s, cmd in self._simulate_order(streams):
             self._dispatch_train(s, cmd)
 
@@ -973,10 +1060,11 @@ class PipelineEngine(DeepSpeedEngine):
     def _reduce_tied_grads(self):
         """Ship non-owner tied grads to the owner stage and sum (the
         single-controller form of reference pipe/engine.py's
-        _all_reduce_tied_weight_gradients)."""
-        if getattr(self, "_tied_reduced", False):
+        _all_reduce_tied_weight_gradients).  Runs at the LAST canonical
+        ReduceTiedGrads (see _arm_step_guards)."""
+        self._tied_pending -= 1
+        if self._tied_pending > 0:
             return
-        self._tied_reduced = True
         for key, users in self._tied_users.items():
             owner = self.stages[self._tied_owner[key]]
             total = owner.acc["tied"][key]
@@ -989,10 +1077,9 @@ class PipelineEngine(DeepSpeedEngine):
             owner.acc["tied"][key] = total
 
     def _pipe_optimizer_step(self):
-        if self._step_applied:
+        self._step_pending -= 1
+        if self._step_pending > 0:
             return
-        self._step_applied = True
-        self._tied_reduced = False
         denom = jnp.asarray(
             self._scaler_state["cur_scale"] * self.micro_batches,
             jnp.float32)

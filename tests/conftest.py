@@ -28,6 +28,10 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
+# install jax.shard_map on older jax BEFORE test modules import it
+# (`from jax import shard_map` at module scope in e.g. test_dist.py)
+from deepspeed_tpu import _compat  # noqa: E402,F401
+
 # NOTE: a persistent XLA compilation cache was tried here and reverted:
 # XLA:CPU AOT reload warns about mismatched machine features on this host
 # ("could lead to execution errors such as SIGILL") and produced small

@@ -317,3 +317,63 @@ def test_differentiated_bias_gets_real_gradients():
 
     g = jax.grad(loss)(bias0)
     assert float(jnp.abs(g).max()) > 0.0, "bias gradient silently zero"
+
+
+def test_vmap_grad_bias_gets_real_gradients():
+    """Under vmap(grad(...)) the bias is a BatchTracer WRAPPING the
+    JVPTracer: the old outermost-type check saw only the BatchTracer,
+    routed the differentiated bias to the flash kernel and returned a
+    silent zero cotangent. The nested walk must catch it and take the
+    XLA path."""
+    B, S, H, D = 1, 256, 2, 64
+    q, k, v = _make_qkv(jax.random.PRNGKey(17), B=B, S=S, H=H, D=D)
+
+    def loss(b, impl):
+        out = multihead_attention(q, k, v, causal=False, impl=impl,
+                                  bias=b)
+        return jnp.sum(out ** 2)
+
+    biases = jnp.zeros((3, B, 1, 1, S), jnp.float32)
+    gs = jax.vmap(jax.grad(lambda b: loss(b, "pallas")))(biases)
+    assert float(jnp.abs(gs).max()) > 0.0, "bias cotangent silently zero"
+    gx = jax.vmap(jax.grad(lambda b: loss(b, "xla")))(biases)
+    np.testing.assert_allclose(np.asarray(gs), np.asarray(gx), rtol=1e-5)
+
+
+def test_dropout_shard_offset_decorrelates_and_matches_global():
+    """Two-shard mesh: shards passing bh_offset = axis_index * local_BH
+    draw the GLOBAL hash mask, so the sharded run equals the unsharded
+    run bit-for-bit; without the offset both batch shards draw the
+    IDENTICAL local mask pattern (the correlation this fixes)."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    # jax.shard_map: native on current jax; installed by
+    # deepspeed_tpu._compat (with check_vma translation) on older jax
+    shard_map = jax.shard_map
+
+    B, S, H, D = 2, 256, 2, 64  # batch of 2 -> one row per shard
+    q, k, v = _make_qkv(jax.random.PRNGKey(18), B=B, S=S, H=H, D=D)
+    rng = jax.random.PRNGKey(7)
+    rate = 0.3
+    full = flash_attention(q, k, v, causal=False, dropout_rate=rate,
+                           dropout_rng=rng)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("dp",))
+
+    def run(with_offset):
+        def f(q, k, v):
+            off = (jax.lax.axis_index("dp") * (q.shape[0] * H)
+                   if with_offset else 0)
+            return flash_attention(q, k, v, causal=False,
+                                   dropout_rate=rate, dropout_rng=rng,
+                                   bh_offset=off)
+
+        return shard_map(f, mesh=mesh,
+                         in_specs=(P("dp"), P("dp"), P("dp")),
+                         out_specs=P("dp"), check_vma=False)(q, k, v)
+
+    with_off = np.asarray(run(True))
+    np.testing.assert_array_equal(with_off, np.asarray(full))
+    without = np.asarray(run(False))
+    # shard 0 (offset 0 either way) still matches the global run...
+    np.testing.assert_array_equal(without[:1], np.asarray(full)[:1])
+    # ...but shard 1 reused shard 0's mask pattern instead of its own
+    assert not np.array_equal(without[1:], np.asarray(full)[1:])

@@ -117,6 +117,27 @@ def test_gpt_tensor_parallel_matches_single():
     np.testing.assert_allclose(tp_loss, ref, rtol=1e-5)
 
 
+def test_chunk_count_above_rows_clamps_instead_of_hanging():
+    """loss_chunks=100 at N=32 rows: the divisor fix-up walk only moves
+    UPWARD, so a request above N used to spin forever at trace time
+    (there is no divisor of N above N). It must clamp to N and agree
+    with the unchunked loss."""
+    from deepspeed_tpu.models.gpt import _softmax_xent_from_hidden
+
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    N, D, V = 32, 8, 16
+    x = jax.random.normal(k1, (N, D), jnp.float32)
+    w = jax.random.normal(k2, (D, V), jnp.float32) * 0.1
+    labels = jax.random.randint(jax.random.PRNGKey(1), (N,), 0, V)
+    valid = jnp.ones((N,), bool)
+    full = _softmax_xent_from_hidden(x, w, labels, valid, n_chunks=1)
+    # traced too (the hang was at trace time, inside jit)
+    chunked = jax.jit(
+        lambda *a: _softmax_xent_from_hidden(*a, n_chunks=100))(
+        x, w, labels, valid)
+    np.testing.assert_allclose(float(chunked), float(full), rtol=1e-5)
+
+
 @pytest.mark.slow
 def test_chunked_ce_matches_full_logits():
     """loss_chunks={1,4} and the materialized log_softmax reference all

@@ -11,12 +11,51 @@ import os
 import socket
 import subprocess
 import sys
+import threading
 
 import numpy as np
 import pytest
 
 from deepspeed_tpu.runtime.comm.hostwire import (HostWire, HostWireBackend,
                                                  _pack_sign, _unpack_sign)
+
+
+class FakeCoordClient:
+    """In-memory twin of the jax.distributed coordination-service client
+    (set/get/delete/barrier) — lets W ranks run the REAL HostWire logic
+    in threads without spawning jax.distributed processes, so W=4 wire
+    semantics (chunked part keys, barriers, deletion) sit in the fast
+    tier."""
+
+    def __init__(self, world):
+        self.world = world
+        self._kv = {}
+        self._cv = threading.Condition()
+        self._barriers = {}
+
+    def key_value_set(self, key, value):
+        with self._cv:
+            self._kv[key] = str(value)
+            self._cv.notify_all()
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        deadline = timeout_ms / 1000.0
+        with self._cv:
+            ok = self._cv.wait_for(lambda: key in self._kv,
+                                   timeout=deadline)
+            if not ok:
+                raise TimeoutError(f"key {key} never set")
+            return self._kv[key]
+
+    def key_value_delete(self, key):
+        with self._cv:
+            self._kv.pop(key, None)
+
+    def wait_at_barrier(self, name, timeout_ms):
+        with self._cv:
+            b = self._barriers.setdefault(
+                name, threading.Barrier(self.world))
+        b.wait(timeout=timeout_ms / 1000.0)
 
 
 def _two_stage_oracle(xs, we, se, mode, world):
@@ -95,6 +134,82 @@ def test_int8_single_process_close_to_identity():
     assert rel < 0.03, rel
 
 
+def _run_ranks(world, fn):
+    """Run fn(rank) on `world` threads over one FakeCoordClient; returns
+    results in rank order, re-raising the first worker exception."""
+    client = FakeCoordClient(world)
+    results = [None] * world
+    errors = []
+
+    def run(r):
+        try:
+            results[r] = fn(r, client)
+        except BaseException as e:  # noqa: BLE001 — surface to the test
+            errors.append((r, e))
+
+    threads = [threading.Thread(target=run, args=(r,), daemon=True)
+               for r in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    hung = [r for r, t in enumerate(threads) if t.is_alive()]
+    assert not hung, f"ranks {hung} still blocked after 60s (wedged wire)"
+    if errors:
+        raise AssertionError(f"rank {errors[0][0]} failed") from errors[0][1]
+    return results
+
+
+def test_fourway_allgather_chunked_fast():
+    """W=4 allgather through the real HostWire over the fake KV store,
+    with chunk_bytes forced tiny so every payload rides multiple part
+    keys — the scaling-guard path itself."""
+    payloads = [bytes([r]) * (300 + 70 * r) for r in range(4)]
+
+    def fn(r, client):
+        w = HostWire(tag="t4", chunk_bytes=128,
+                     _endpoint=(client, r, 4))
+        out1 = w.allgather_bytes(payloads[r])
+        out2 = w.allgather_bytes(payloads[r][::-1])  # second step: keys
+        return out1, out2                            # were cleaned up
+
+    for out1, out2 in _run_ranks(4, fn):
+        assert out1 == payloads
+        assert out2 == [p[::-1] for p in payloads]
+
+
+def test_fourway_backend_matches_oracle_fast():
+    """W=4 compressed allreduce (threads over the fake KV): every rank
+    converges on one identical reduction matching the W=4 numpy oracle,
+    including the ragged server-chunk split."""
+    world = 4
+    n = 1001  # NOT divisible by 4: ragged last server chunk
+    xs = [np.random.RandomState(7 + r).rand(n).astype(np.float32) - 0.5
+          for r in range(world)]
+
+    def fn(r, client):
+        backend = HostWireBackend(wire="sign", chunk_bytes=256,
+                                  _endpoint=(client, r, world))
+        return backend.compressed_allreduce(xs[r], name="g")
+
+    outs = _run_ranks(world, fn)
+    for out in outs[1:]:
+        np.testing.assert_array_equal(out, outs[0])
+    want, _, _ = _two_stage_oracle(
+        xs, [np.zeros(n, np.float32)] * world,
+        [np.zeros(n, np.float32)] * world, "sign", world)
+    np.testing.assert_allclose(outs[0].ravel(), want, rtol=1e-5)
+
+
+def test_payload_above_envelope_raises():
+    w = HostWire(max_payload_bytes=1024,
+                 _endpoint=(FakeCoordClient(1), 0, 1))
+    with pytest.raises(ValueError, match="envelope"):
+        w.allgather_bytes(b"x" * 2048)
+    # at the edge: accepted
+    assert w.allgather_bytes(b"x" * 1024) == [b"x" * 1024]
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -104,9 +219,9 @@ def _free_port():
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("wire", ["sign", "int8"])
-def test_two_process_hostwire_allreduce(wire):
-    nprocs = 2
+@pytest.mark.parametrize("wire,nprocs", [("sign", 2), ("int8", 2),
+                                         ("sign", 4)])
+def test_multiprocess_hostwire_allreduce(wire, nprocs):
     coord = f"127.0.0.1:{_free_port()}"
     worker = os.path.join(os.path.dirname(__file__), "hostwire_worker.py")
     env = dict(os.environ)

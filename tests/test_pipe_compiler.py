@@ -1,0 +1,241 @@
+"""Pipeline schedule compiler (runtime/pipe/compiler.py): structural
+lowering invariants + executor parity.
+
+The compiled flat program is the DEFAULT train_batch executor; the
+interpreted per-event walk stays as `pipeline.debug_schedule: true` —
+the parity oracle.  These tests pin (a) the lowering itself (micro-id
+assignment, send+recv fusion, buffer-slot liveness) by symbolic replay,
+and (b) bit-identical loss curves between the two executors on every
+engine mode (single-controller, p2p channels, interleaved)."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.pipe.compiler import (OP_BWD, OP_FWD, OP_LOAD,
+                                                 OP_STEP, OP_TIED,
+                                                 OP_XFER_ACT, OP_XFER_GRAD,
+                                                 compile_schedule)
+from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+from deepspeed_tpu.runtime.pipe.schedule import (InferenceSchedule,
+                                                 InterleavedTrainSchedule,
+                                                 TrainSchedule)
+
+
+class _Shim:
+    """Just enough engine surface for _simulate_order/_mc: the canonical
+    order derivation is pure schedule structure."""
+
+    _mc = PipelineEngine._mc
+    _simulate_order = PipelineEngine._simulate_order
+
+    def __init__(self, n_phys, v=1):
+        self._n_phys = n_phys
+        self._n_mc = n_phys * v
+
+
+def _compile(P, M, v=1, schedule=None):
+    shim = _Shim(P, v)
+    if schedule is None:
+        if v > 1:
+            streams = [list(InterleavedTrainSchedule(M, P, s, v).steps())
+                       for s in range(P)]
+        else:
+            streams = [list(TrainSchedule(M, P, s).steps())
+                       for s in range(P)]
+    else:
+        streams = [list(schedule(M, P, s).steps()) for s in range(P)]
+    events = shim._simulate_order(streams)
+    return compile_schedule(events, shim._mc, shim._n_mc, M), len(events)
+
+
+@pytest.mark.parametrize("P,M,v", [(2, 4, 1), (4, 4, 1), (4, 16, 1),
+                                   (2, 4, 2), (4, 8, 2)])
+def test_lowering_structure_and_slot_liveness(P, M, v):
+    """Symbolic replay of the flat program with exactly the executor's
+    read/clear semantics: every read must see the value its micro id
+    names, every write must land on a free slot, and every pool must be
+    empty when the program ends (no leaked buffers)."""
+    prog, n_events = _compile(P, M, v)
+    n_mc = P * v
+    assert prog.n_source_events == n_events
+    ops = [e[0] for e in prog.events]
+    # one fwd+bwd per (chunk, micro); every send fused to ONE transfer
+    assert ops.count(OP_FWD) == n_mc * M
+    assert ops.count(OP_BWD) == n_mc * M
+    assert ops.count(OP_LOAD) == M
+    assert ops.count(OP_XFER_ACT) == (n_mc - 1) * M
+    assert ops.count(OP_XFER_GRAD) == (n_mc - 1) * M
+    assert ops.count(OP_TIED) == 1 and ops.count(OP_STEP) == 1
+    assert ops.index(OP_TIED) < ops.index(OP_STEP)
+    # the step must see COMPLETE gradients: tied-reduce and optimizer
+    # land after the globally final backward (the first-occurrence
+    # placement applied the step mid-cooldown and leaked the remainder
+    # into the next batch's accumulators)
+    last_bwd = max(i for i, op in enumerate(ops) if op == OP_BWD)
+    assert ops.index(OP_TIED) > last_bwd
+
+    pools = {k: [None] * n for k, n in prog.pool_sizes.items()}
+
+    def write(kind, mc, slot, mb):
+        assert pools[(mc, kind)][slot] is None, \
+            f"clobbered live {kind}[{mc}][{slot}]"
+        pools[(mc, kind)][slot] = mb
+
+    def read(kind, mc, slot, mb, clear):
+        got = pools[(mc, kind)][slot]
+        assert got == mb, f"{kind}[{mc}][{slot}]: want {mb}, got {got}"
+        if clear:
+            pools[(mc, kind)][slot] = None
+
+    for op, mc, mb, a, b, c in prog.events:
+        if op == OP_LOAD:
+            write("x", mc, a, mb)
+        elif op == OP_FWD:
+            read("x", mc, a, mb, clear=False)  # bwd reads it again
+            if b >= 0:
+                write("y", mc, b, mb)
+        elif op == OP_XFER_ACT:
+            read("y", mc, a, mb, clear=True)
+            write("x", mc + 1, b, mb)
+        elif op == OP_BWD:
+            read("x", mc, a, mb, clear=True)
+            if b >= 0:
+                read("dy", mc, b, mb, clear=True)
+            if c >= 0:
+                write("dx", mc, c, mb)
+        elif op == OP_XFER_GRAD:
+            read("dx", mc, a, mb, clear=True)
+            write("dy", mc - 1, b, mb)
+    leaked = {k: p for k, p in pools.items() if any(v is not None
+                                                    for v in p)}
+    assert not leaked, f"slots still live at program end: {leaked}"
+
+
+def test_x_pool_bounded_by_1f1b_buffer_count():
+    """Liveness-derived x pools must not exceed the 1F1B in-flight bound
+    (distance to the last stage + 1) by more than the one extra slot the
+    send-time fusion can add — the compiled executor keeps the 1F1B
+    memory property."""
+    P, M = 4, 16
+    prog, _ = _compile(P, M)
+    for mc in range(P):
+        bound = TrainSchedule(M, P, mc).num_pipe_buffers()
+        got = prog.pool_sizes.get((mc, "x"), 0)
+        assert got <= bound + 1, (mc, got, bound)
+
+
+def test_inference_stream_lowers():
+    """The forward-only ISA lowers through the same compiler: loads,
+    forwards, fused transfers — no backward, no optimizer."""
+    P, M = 4, 6
+    prog, n_events = _compile(P, M, schedule=InferenceSchedule)
+    ops = [e[0] for e in prog.events]
+    assert ops.count(OP_LOAD) == M
+    assert ops.count(OP_FWD) == P * M
+    assert ops.count(OP_XFER_ACT) == (P - 1) * M
+    assert set(ops) == {OP_LOAD, OP_FWD, OP_XFER_ACT}
+    assert prog.n_source_events == n_events
+
+
+def test_recv_before_send_is_rejected():
+    """The canonical-order contract is asserted during lowering: a recv
+    whose matching send has not been issued is a compiler error, not a
+    silent miscompile."""
+    from deepspeed_tpu.runtime.pipe.schedule import (ForwardPass,
+                                                     LoadMicroBatch,
+                                                     RecvActivation)
+
+    events = [(0, LoadMicroBatch(0)), (0, ForwardPass(0)),
+              (1, RecvActivation(0))]  # no SendActivation before it
+    shim = _Shim(2)
+    with pytest.raises(AssertionError, match="recv_act before send"):
+        compile_schedule(events, shim._mc, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# executor parity: compiled (default) vs interpreted oracle
+# ---------------------------------------------------------------------------
+
+def _losses(use_channels, debug, interleave=1, num_stages=2, steps=2):
+    import deepspeed_tpu
+    from pipe_parity_common import M, build_module, config, data
+
+    cfg = config(use_channels)
+    cfg.setdefault("pipeline", {})["debug_schedule"] = debug
+    engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=num_stages, interleave=interleave),
+        config_params=cfg)
+    assert engine._staged and engine._debug_schedule == debug
+    out = [float(engine.train_batch(iter(data(100 + i, M))))
+           for i in range(steps)]
+    out.append(float(engine.eval_batch(iter(data(999, M)))))
+    if not debug:
+        # program lowered once, bound once, reused every batch
+        assert engine._pipe_prog is not None
+        assert len(engine._bound_cache) == 1
+    return out
+
+
+def test_compiled_matches_interpreted_single_controller():
+    assert _losses(False, debug=False) == _losses(False, debug=True)
+
+
+@pytest.mark.parametrize("use_channels", [False, True])
+def test_no_residual_gradients_after_step(use_channels):
+    """Every stage's accumulator is exactly zero after train_batch: the
+    optimizer consumed ALL micro-batch gradients (regression for the
+    first-occurrence step placement, which applied the optimizer before
+    earlier stages' cooldown backwards and leaked the rest forward)."""
+    import deepspeed_tpu
+    from pipe_parity_common import M, build_module, config, data
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=4),
+        config_params=config(use_channels))
+    engine.train_batch(iter(data(7, M)))
+    rts = engine._local.values() if engine._mh else engine.stages
+    for rt in rts:
+        for leaf in jax.tree_util.tree_leaves(rt.acc):
+            assert float(np.abs(np.asarray(leaf)).max()) == 0.0
+
+
+def test_compiled_matches_interpreted_channels():
+    assert _losses(True, debug=False) == _losses(True, debug=True)
+
+
+@pytest.mark.slow
+def test_compiled_matches_interpreted_interleaved_channels():
+    a = _losses(True, debug=False, interleave=2)
+    b = _losses(True, debug=True, interleave=2)
+    assert a == b
+
+
+@pytest.mark.slow
+def test_compiled_matches_interpreted_four_stage():
+    a = _losses(False, debug=False, num_stages=4, steps=3)
+    b = _losses(False, debug=True, num_stages=4, steps=3)
+    assert a == b
+
+
+def test_compiled_survives_checkpoint_reload(tmp_path):
+    """The bound closures read params through the runtime objects, so a
+    checkpoint reload into the same engine must keep training correctly
+    (and identically to a fresh engine resuming from the same file)."""
+    import deepspeed_tpu
+    from pipe_parity_common import M, build_module, config, data
+
+    engine, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=2), config_params=config())
+    engine.train_batch(iter(data(1, M)))
+    engine.save_checkpoint(str(tmp_path), tag="t")
+    l_more = float(engine.train_batch(iter(data(2, M))))
+
+    engine.load_checkpoint(str(tmp_path), tag="t")
+    l_resumed = float(engine.train_batch(iter(data(2, M))))
+    fresh, *_ = deepspeed_tpu.initialize(
+        model=build_module(num_stages=2), config_params=config())
+    fresh.load_checkpoint(str(tmp_path), tag="t")
+    l_fresh = float(fresh.train_batch(iter(data(2, M))))
+    assert l_resumed == l_fresh
+    np.testing.assert_allclose(l_more, l_resumed, rtol=1e-5)
