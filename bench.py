@@ -100,11 +100,12 @@ def _dense_peak_tflops(n=4096, iters=100) -> float:
 def _last_tpu_artifact():
     """Newest committed hardware datum, for cpu-smoke fallbacks.
 
-    Scans `BENCH_r*.json` (driver round captures) and
-    `bench_artifacts/*.json` next to this file for the NEWEST entry (by
-    file mtime) whose platform is a real accelerator, so a smoke-mode
-    JSON line carries the last on-TPU measurement instead of silently
-    erasing hardware history (VERDICT r5 #3)."""
+    Scans `BENCH_r*.json` (driver round captures) and every json under
+    `bench_artifacts/` (incl. the telemetry-manifest `runs/` dir and the
+    restored round dirs) for the NEWEST entry (by file mtime) whose
+    platform is a real accelerator, so a smoke-mode JSON line carries
+    the last on-TPU measurement instead of silently erasing hardware
+    history (VERDICT r5 #3)."""
     import glob
 
     here = os.path.dirname(os.path.abspath(__file__))
@@ -112,7 +113,7 @@ def _last_tpu_artifact():
     best_mtime = -1.0
     for path in (glob.glob(os.path.join(here, "BENCH_r*.json")) +
                  glob.glob(os.path.join(here, "bench_artifacts",
-                                        "*.json"))):
+                                        "**", "*.json"), recursive=True)):
         try:
             mtime = os.path.getmtime(path)
             if mtime <= best_mtime:
@@ -123,7 +124,9 @@ def _last_tpu_artifact():
             continue
         if not isinstance(d, dict):
             continue
-        r = d.get("parsed", d)
+        # unwrap driver captures ({"parsed": ...}) and telemetry
+        # artifacts ({"result": ...}) to the raw bench line
+        r = d.get("parsed", d.get("result", d))
         if not isinstance(r, dict):
             continue
         plat = r.get("platform")
@@ -470,6 +473,22 @@ def run_headroom(on_tpu: bool) -> dict:
     return _attach_last_tpu(out)
 
 
+def _record_artifact(result: dict) -> dict:
+    """Land the result in the committed, manifest-indexed artifact dir
+    (deepspeed_tpu/monitor/artifacts.py) so a hardware measurement
+    survives the session that produced it — the round-5 failure mode
+    (on-TPU artifacts later deleted from the tree, docs pointing at
+    nothing) cannot recur when every run writes through the manifest.
+    Telemetry must never kill the headline: best-effort only."""
+    try:
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        result["artifact"] = record_bench_result(result)
+    except Exception:
+        pass
+    return result
+
+
 def main():
     on_tpu = _probe_tpu()
     if not on_tpu:
@@ -501,7 +520,7 @@ def main():
             result = {"metric": "bench_error", "value": 0.0,
                       "unit": "error", "vs_baseline": 0.0,
                       "error": f"{type(exc).__name__}: {exc}"}
-    print(json.dumps(result))
+    print(json.dumps(_record_artifact(result)))
 
 
 if __name__ == "__main__":

@@ -152,7 +152,22 @@ def axis_index(axis: str):
     return lax.axis_index(axis)
 
 
+def _record_volume(kind: str, x) -> None:
+    """Collective-volume counter (monitor/counters.py).  These wrappers
+    execute under jit/shard_map TRACING, so each record counts one traced
+    occurrence per compiled program (the per-program collective volume),
+    not one per device execution — hence the `dist.` prefix, distinct
+    from the per-dispatch `p2p.*` counters.  Never raises into a trace."""
+    try:
+        from ..monitor.counters import COUNTERS, tree_bytes
+
+        COUNTERS.add(f"dist.{kind}", tree_bytes(x))
+    except Exception:
+        pass
+
+
 def all_reduce(x, axis: str, op: str = ReduceOp.SUM):
+    _record_volume("all_reduce", x)
     if op == ReduceOp.SUM:
         return lax.psum(x, axis)
     if op == ReduceOp.AVG:
@@ -169,17 +184,20 @@ def all_reduce(x, axis: str, op: str = ReduceOp.SUM):
 def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
     """Gather shards along `axis`; tiled=True concatenates along gather_axis
     (torch all_gather + cat), False stacks a new leading dim."""
+    _record_volume("all_gather", x)
     return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 
 def reduce_scatter(x, axis: str, *, scatter_axis: int = 0, tiled: bool = True):
     """Sum across `axis` then keep this shard's slice — the ZeRO gradient
     primitive (reference zero/stage1.py:629 reduce_scatter_gradients)."""
+    _record_volume("reduce_scatter", x)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=tiled)
 
 
 def broadcast(x, axis: str, src: int = 0):
     """Every shard gets shard `src`'s value (reference dist.broadcast)."""
+    _record_volume("broadcast", x)
     gathered = lax.all_gather(x, axis, axis=0, tiled=False)
     return jax.tree_util.tree_map(lambda g: g[src], gathered)
 
@@ -188,17 +206,20 @@ def ppermute(x, axis: str, perm):
     """Point-to-point ring/pair exchange — replaces the reference's
     2-rank-broadcast-group p2p (pipe/p2p.py:31-75) with ICI collective
     permute."""
+    _record_volume("ppermute", x)
     return lax.ppermute(x, axis, perm)
 
 
 def send_recv_next(x, axis: str):
     """Shift +1 along a ring: stage i -> stage i+1 (pipeline activations)."""
+    _record_volume("ppermute", x)
     n = lax.axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
 
 
 def send_recv_prev(x, axis: str):
     """Shift -1 along a ring (pipeline gradients)."""
+    _record_volume("ppermute", x)
     n = lax.axis_size(axis)
     return lax.ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
 
@@ -206,5 +227,6 @@ def send_recv_prev(x, axis: str):
 def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
     """reference dist.all_to_all_single (comm/nccl.py:99) — Ulysses-style
     head<->sequence scatter rides this on ICI."""
+    _record_volume("all_to_all", x)
     return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis,
                           tiled=True)

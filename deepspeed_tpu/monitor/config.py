@@ -1,0 +1,71 @@
+"""Monitor config block (TPU addition — no reference analogue; the
+reference's observability is TensorBoard scalars + rank-0 log lines).
+
+JSON schema:
+
+    "monitor": {
+        "enabled": true,
+        "output_path": "runs",          # run dirs land under here
+        "job_name": "my_run",           # -> <output_path>/<job_name>/
+        "flush_interval": 10,           # steps between event-file flushes
+        "sync_timing": true,            # block_until_ready before reading
+                                        # span clocks (real step time; costs
+                                        # one device sync per step)
+        "flops": true,                  # achieved-TFLOPs via the flops
+                                        # profiler's cost analysis (one
+                                        # lowering at first step)
+        "tokens_per_sample": 1024,      # optional: emit tokens/s
+        "heartbeat_interval": 0,        # steps; >0 enables multi-host
+                                        # heartbeats over the hostwire KV
+        "straggler_factor": 1.5,        # rank-0 straggler flag threshold
+        "profiler": {                   # jax.profiler.trace window
+            "start_step": -1,           # -1: disabled
+            "num_steps": 1,
+            "output_dir": ""            # default: <run_dir>/profile
+        }
+    }
+"""
+
+from ..runtime.config_utils import DeepSpeedConfigObject, get_scalar_param
+
+MONITOR = "monitor"
+MONITOR_ENABLED = "enabled"
+MONITOR_OUTPUT_PATH = "output_path"
+MONITOR_JOB_NAME = "job_name"
+MONITOR_FLUSH_INTERVAL = "flush_interval"
+MONITOR_SYNC_TIMING = "sync_timing"
+MONITOR_FLOPS = "flops"
+MONITOR_TOKENS_PER_SAMPLE = "tokens_per_sample"
+MONITOR_HEARTBEAT_INTERVAL = "heartbeat_interval"
+MONITOR_STRAGGLER_FACTOR = "straggler_factor"
+MONITOR_PROFILER = "profiler"
+MONITOR_PROFILER_START_STEP = "start_step"
+MONITOR_PROFILER_NUM_STEPS = "num_steps"
+MONITOR_PROFILER_OUTPUT_DIR = "output_dir"
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigObject):
+    def __init__(self, param_dict):
+        super().__init__()
+        d = param_dict.get(MONITOR, {}) or {}
+        self.enabled = bool(get_scalar_param(d, MONITOR_ENABLED, False))
+        self.output_path = get_scalar_param(d, MONITOR_OUTPUT_PATH, "runs")
+        self.job_name = get_scalar_param(d, MONITOR_JOB_NAME, "run")
+        self.flush_interval = int(get_scalar_param(
+            d, MONITOR_FLUSH_INTERVAL, 10))
+        self.sync_timing = bool(get_scalar_param(
+            d, MONITOR_SYNC_TIMING, True))
+        self.flops = bool(get_scalar_param(d, MONITOR_FLOPS, True))
+        self.tokens_per_sample = get_scalar_param(
+            d, MONITOR_TOKENS_PER_SAMPLE, None)
+        self.heartbeat_interval = int(get_scalar_param(
+            d, MONITOR_HEARTBEAT_INTERVAL, 0))
+        self.straggler_factor = float(get_scalar_param(
+            d, MONITOR_STRAGGLER_FACTOR, 1.5))
+        prof = d.get(MONITOR_PROFILER, {}) or {}
+        self.profiler_start_step = int(get_scalar_param(
+            prof, MONITOR_PROFILER_START_STEP, -1))
+        self.profiler_num_steps = int(get_scalar_param(
+            prof, MONITOR_PROFILER_NUM_STEPS, 1))
+        self.profiler_output_dir = get_scalar_param(
+            prof, MONITOR_PROFILER_OUTPUT_DIR, "")

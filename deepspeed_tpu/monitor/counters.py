@@ -1,0 +1,90 @@
+"""Process-global comm/dispatch counters.
+
+The reference attributes step time by reading NCCL byte counts out of
+band; here every hot-path comm primitive increments a named counter
+(calls + bytes) as it dispatches, and the telemetry layer reads *deltas*
+per step (`RunMonitor.step_start` snapshots, `step_end` diffs).  The
+increment is two integer adds on a plain dict entry — cheap enough to
+stay unconditional, so the counters are always truthful whether or not
+a monitor is attached.
+
+Instrumented sites:
+
+* `runtime/pipe/p2p.py` — `Channel.transfer` (interpreted walk),
+  `ChannelPlan.__call__` (fused compiled-executor transfer),
+  `GlobalScalars.sum`: per-dispatch send/recv bytes.
+* `runtime/pipe/compiler.py` — the single-controller xfer closures
+  (`pipe.xfer_act` / `pipe.xfer_grad` device_put reshards).
+* `comm/dist.py` — the in-jit collective wrappers.  Those run under
+  `jit`/`shard_map` tracing, so each record is a *traced* occurrence
+  (once per compiled program), not a per-execution count; the name
+  prefix `dist.` marks that distinction.
+* `runtime/comm/hostwire.py` — KV-wire payload bytes per allgather.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total byte size of a pytree of arrays / ShapeDtypeStructs /
+    tracers (anything with .shape and .dtype). Best-effort: leaves
+    without a static shape contribute 0 — a counter must never raise
+    into the hot path."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        try:
+            shape = getattr(leaf, "shape", None)
+            dtype = getattr(leaf, "dtype", None)
+            if shape is None or dtype is None:
+                continue
+            total += int(np.prod(shape, dtype=np.int64)) * \
+                np.dtype(dtype).itemsize
+        except Exception:
+            continue
+    return total
+
+
+class CounterRegistry:
+    """Named (calls, bytes) accumulators with snapshot/delta reads."""
+
+    __slots__ = ("_c",)
+
+    def __init__(self):
+        self._c: Dict[str, list] = {}
+
+    def add(self, name: str, nbytes: int = 0, calls: int = 1) -> None:
+        e = self._c.get(name)
+        if e is None:
+            self._c[name] = [calls, nbytes]
+        else:
+            e[0] += calls
+            e[1] += nbytes
+
+    def snapshot(self) -> Dict[str, tuple]:
+        return {k: (v[0], v[1]) for k, v in self._c.items()}
+
+    def delta_since(self, snap: Optional[Dict[str, tuple]]) -> Dict[str, dict]:
+        snap = snap or {}
+        out = {}
+        for k, v in self._c.items():
+            c0, b0 = snap.get(k, (0, 0))
+            dc, db = v[0] - c0, v[1] - b0
+            if dc or db:
+                out[k] = {"calls": dc, "bytes": db}
+        return out
+
+    def totals(self) -> Dict[str, dict]:
+        return {k: {"calls": v[0], "bytes": v[1]} for k, v in self._c.items()}
+
+    def reset(self) -> None:
+        self._c.clear()
+
+
+# THE process-global registry every instrumented site writes to.
+COUNTERS = CounterRegistry()

@@ -1,0 +1,210 @@
+"""Run-report rendering: JSONL event stream -> BENCH.md-style table.
+
+Shared by `tools/run_report.py` (CLI) and the tests; keeps every schema
+assumption in one place next to the writer (monitor.py)."""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+# required keys per event type; value is the required python type(s)
+_STEP_REQUIRED = {"v": int, "type": str, "rank": int, "t": (int, float),
+                  "step": int}
+
+
+def validate_event(event: Dict[str, Any]) -> List[str]:
+    """Return a list of schema violations (empty = valid)."""
+    errs = []
+    if not isinstance(event, dict):
+        return ["event is not an object"]
+    for key, typ in _STEP_REQUIRED.items():
+        if event.get("type") != "step" and key == "step":
+            continue
+        if key not in event:
+            errs.append(f"missing key {key!r}")
+        elif not isinstance(event[key], typ):
+            errs.append(f"key {key!r} has type {type(event[key]).__name__}")
+    if isinstance(event.get("v"), int) and event["v"] > SCHEMA_VERSION:
+        errs.append(f"schema version {event['v']} is newer than reader "
+                    f"({SCHEMA_VERSION})")
+    return errs
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{i + 1}: invalid JSON: {e}")
+    return events
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """Load a run directory: manifest (optional) + every rank's events."""
+    manifest = None
+    mpath = os.path.join(run_dir, "manifest.json")
+    if os.path.exists(mpath):
+        with open(mpath) as f:
+            manifest = json.load(f)
+    ranks: Dict[int, List[Dict[str, Any]]] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir,
+                                              "events.rank*.jsonl"))):
+        events = read_events(path)
+        rank = int(os.path.basename(path)[len("events.rank"):-len(".jsonl")])
+        ranks[rank] = events
+    if not ranks:
+        raise FileNotFoundError(
+            f"no events.rank*.jsonl under {run_dir!r}")
+    return {"dir": run_dir, "manifest": manifest, "ranks": ranks}
+
+
+def _mean(xs):
+    xs = [x for x in xs if x is not None]
+    return sum(xs) / len(xs) if xs else None
+
+
+def summarize(events: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate one rank's event list."""
+    steps = [e for e in events if e.get("type") == "step"]
+    hbs = [e for e in events if e.get("type") == "heartbeat"]
+    comm: Dict[str, Dict[str, int]] = {}
+    for e in steps:
+        for name, d in (e.get("comm") or {}).items():
+            acc = comm.setdefault(name, {"calls": 0, "bytes": 0})
+            acc["calls"] += int(d.get("calls", 0))
+            acc["bytes"] += int(d.get("bytes", 0))
+    spans: Dict[str, float] = {}
+    for e in steps:
+        for name, ms in (e.get("spans_ms") or {}).items():
+            spans[name] = spans.get(name, 0.0) + float(ms)
+    losses = [e.get("loss") for e in steps if e.get("loss") is not None]
+    mems = [e.get("memory") for e in steps if e.get("memory")]
+    peak = max((m.get("peak_bytes_in_use_sum", 0) for m in mems),
+               default=None) if mems else None
+    pipe = next((e.get("pipe") for e in reversed(steps)
+                 if e.get("pipe")), None)
+    stragglers = sorted({r for e in hbs for r in (e.get("stragglers") or [])})
+    return {
+        "n_steps": len(steps),
+        "first_step": steps[0]["step"] if steps else None,
+        "last_step": steps[-1]["step"] if steps else None,
+        "mean_wall_ms": _mean([e.get("wall_ms") for e in steps]),
+        "mean_samples_per_sec": _mean([e.get("samples_per_sec")
+                                       for e in steps]),
+        "mean_tokens_per_sec": _mean([e.get("tokens_per_sec")
+                                      for e in steps]),
+        "mean_tflops": _mean([e.get("tflops") for e in steps]),
+        "first_loss": losses[0] if losses else None,
+        "last_loss": losses[-1] if losses else None,
+        "skipped_steps": max((e.get("skipped_steps", 0) for e in steps),
+                             default=0),
+        "comm": comm,
+        "spans_ms_total": spans,
+        "peak_bytes_in_use_sum": peak,
+        "pipe": pipe,
+        "stragglers": stragglers,
+    }
+
+
+def _fmt(x, nd=2, unit=""):
+    if x is None:
+        return "—"
+    if isinstance(x, float):
+        return f"{x:,.{nd}f}{unit}"
+    return f"{x:,}{unit}"
+
+
+def _fmt_bytes(b):
+    if b is None:
+        return "—"
+    for mag, suffix in ((1 << 30, "GiB"), (1 << 20, "MiB"), (1 << 10, "KiB")):
+        if b >= mag:
+            return f"{b / mag:.2f} {suffix}"
+    return f"{b} B"
+
+
+def render_markdown(run: Dict[str, Any]) -> str:
+    """BENCH.md-style report for a loaded run (load_run output)."""
+    lines = [f"# Run report: `{run['dir']}`", ""]
+    man = run.get("manifest")
+    if man:
+        lines.append(f"schema v{man.get('schema_version', '?')} · "
+                     f"backend {man.get('backend', '?')} · "
+                     f"{man.get('device_count', '?')} device(s) · "
+                     f"world {man.get('world_size', '?')}")
+        lines.append("")
+    lines.append("| rank | steps | wall ms/step | samples/s | tokens/s | "
+                 "TFLOPs | loss first→last | skipped | peak mem |")
+    lines.append("|---|---|---|---|---|---|---|---|---|")
+    summaries = {}
+    for rank in sorted(run["ranks"]):
+        s = summarize(run["ranks"][rank])
+        summaries[rank] = s
+        loss = (f"{_fmt(s['first_loss'], 4)} → {_fmt(s['last_loss'], 4)}"
+                if s["first_loss"] is not None else "—")
+        lines.append(
+            f"| {rank} | {s['n_steps']} | {_fmt(s['mean_wall_ms'])} | "
+            f"{_fmt(s['mean_samples_per_sec'], 1)} | "
+            f"{_fmt(s['mean_tokens_per_sec'], 1)} | "
+            f"{_fmt(s['mean_tflops'])} | {loss} | {s['skipped_steps']} | "
+            f"{_fmt_bytes(s['peak_bytes_in_use_sum'])} |")
+    lines.append("")
+
+    any_comm = {}
+    for s in summaries.values():
+        for name, d in s["comm"].items():
+            acc = any_comm.setdefault(name, {"calls": 0, "bytes": 0})
+            acc["calls"] += d["calls"]
+            acc["bytes"] += d["bytes"]
+    if any_comm:
+        lines.append("## Comm counters (all ranks, whole run)")
+        lines.append("")
+        lines.append("| counter | calls | bytes |")
+        lines.append("|---|---|---|")
+        for name in sorted(any_comm):
+            d = any_comm[name]
+            lines.append(f"| `{name}` | {d['calls']:,} | "
+                         f"{_fmt_bytes(d['bytes'])} |")
+        lines.append("")
+
+    pipe = next((s["pipe"] for s in summaries.values() if s["pipe"]), None)
+    if pipe and pipe.get("occupancy"):
+        lines.append("## Pipeline occupancy (schedule ticks)")
+        lines.append("")
+        lines.append("| stage | ticks | compute ticks | bubble |")
+        lines.append("|---|---|---|---|")
+        for st in pipe["occupancy"]:
+            lines.append(f"| {st['stage']} | {st['ticks']} | "
+                         f"{st['compute_ticks']} | "
+                         f"{100.0 * st['bubble_frac']:.1f}% |")
+        lines.append("")
+
+    spans = {}
+    for s in summaries.values():
+        for name, ms in s["spans_ms_total"].items():
+            spans[name] = spans.get(name, 0.0) + ms
+    if spans:
+        lines.append("## Wall-time by span (all ranks, whole run)")
+        lines.append("")
+        lines.append("| span | total ms |")
+        lines.append("|---|---|")
+        for name in sorted(spans, key=lambda k: -spans[k]):
+            lines.append(f"| `{name}` | {spans[name]:,.1f} |")
+        lines.append("")
+
+    stragglers = sorted({r for s in summaries.values()
+                         for r in s["stragglers"]})
+    if stragglers:
+        lines.append(f"**Stragglers flagged:** ranks {stragglers}")
+        lines.append("")
+    return "\n".join(lines)

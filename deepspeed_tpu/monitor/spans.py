@@ -1,0 +1,132 @@
+"""Async-dispatch-aware timing spans + the config-driven profiler window.
+
+JAX dispatch is asynchronous: wall-clocking a region that ends in device
+work measures *dispatch* unless the caller blocks on that work's output.
+`_Timer.stop(sync=)` (utils/timer.py) hard-codes that pattern for two
+named timers; `Span` generalizes it — any region, any sink, close on a
+`block_until_ready` marker — and `TraceWindow` turns the hand-edited
+`jax.profiler.trace` scripts into a config key (start step / num steps /
+output dir).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional
+
+import jax
+
+from ..utils.logging import log_dist, logger
+
+
+class Span:
+    """One timed region, started at construction.
+
+    close(sync=x) blocks on x (jax.block_until_ready) before reading the
+    clock, so the span covers the device work that produced x, not just
+    its dispatch.  close(sync=None) reads the clock immediately — the
+    honest measurement is then host/dispatch time, which is what you
+    want for regions that are pure Python.  Also usable as a context
+    manager (no sync on exit — pass the marker to close() instead for
+    device-bounded regions)."""
+
+    __slots__ = ("name", "t0", "elapsed", "_sink", "_closed")
+
+    def __init__(self, name: str,
+                 sink: Optional[Callable[[str, float], None]] = None):
+        self.name = name
+        self._sink = sink
+        self.elapsed = 0.0
+        self._closed = False
+        self.t0 = time.perf_counter()
+
+    def close(self, sync=None) -> float:
+        if self._closed:
+            return self.elapsed
+        if sync is not None:
+            jax.block_until_ready(sync)
+        self.elapsed = time.perf_counter() - self.t0
+        self._closed = True
+        if self._sink is not None:
+            self._sink(self.name, self.elapsed)
+        return self.elapsed
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class SpanSet:
+    """Step-scoped span accumulator: name -> (seconds, count).  The
+    monitor drains it into each step event."""
+
+    __slots__ = ("_acc",)
+
+    def __init__(self):
+        self._acc: Dict[str, list] = {}
+
+    def record(self, name: str, seconds: float) -> None:
+        e = self._acc.get(name)
+        if e is None:
+            self._acc[name] = [seconds, 1]
+        else:
+            e[0] += seconds
+            e[1] += 1
+
+    def span(self, name: str) -> Span:
+        return Span(name, sink=self.record)
+
+    def drain_ms(self) -> Dict[str, float]:
+        out = {k: round(v[0] * 1000.0, 3) for k, v in self._acc.items()}
+        self._acc.clear()
+        return out
+
+
+class TraceWindow:
+    """Config-driven `jax.profiler.trace` capture: starts at
+    `start_step`, stops after `num_steps` steps (or at close()).  Feed
+    it every step via tick(step); it is a no-op outside the window and
+    after completion, and any profiler failure disables it loudly rather
+    than killing the run."""
+
+    def __init__(self, start_step: int, num_steps: int, output_dir: str):
+        self.start_step = int(start_step)
+        self.num_steps = max(1, int(num_steps))
+        self.output_dir = output_dir
+        self.active = False
+        self.done = self.start_step < 0
+
+    def tick(self, step: int) -> None:
+        if self.done:
+            return
+        if not self.active and step >= self.start_step:
+            try:
+                os.makedirs(self.output_dir, exist_ok=True)
+                jax.profiler.start_trace(self.output_dir)
+                self.active = True
+                log_dist(f"profiler trace started at step {step} -> "
+                         f"{self.output_dir}", ranks=[0])
+            except Exception as e:
+                logger.warning(f"profiler trace failed to start: {e}")
+                self.done = True
+                return
+        elif self.active and step >= self.start_step + self.num_steps:
+            self._stop(step)
+
+    def _stop(self, step) -> None:
+        try:
+            jax.profiler.stop_trace()
+            log_dist(f"profiler trace stopped at step {step} "
+                     f"({self.output_dir})", ranks=[0])
+        except Exception as e:
+            logger.warning(f"profiler trace failed to stop: {e}")
+        self.active = False
+        self.done = True
+
+    def close(self) -> None:
+        if self.active:
+            self._stop(self.start_step + self.num_steps)

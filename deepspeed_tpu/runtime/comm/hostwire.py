@@ -108,6 +108,9 @@ class HostWire:
         relay's message envelope — see module constants); above
         `max_payload_bytes` the call refuses with a clear error instead
         of wedging the coordinator."""
+        from ...monitor.counters import COUNTERS
+
+        COUNTERS.add("hostwire.allgather", len(payload))
         if len(payload) > self.max_payload_bytes:
             raise ValueError(
                 f"hostwire payload of {len(payload)} bytes exceeds the "

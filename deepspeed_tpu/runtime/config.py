@@ -16,6 +16,7 @@ from ..elasticity import (
     ensure_immutable_elastic_config,
 )
 from ..elasticity import constants as ec
+from ..monitor.config import DeepSpeedMonitorConfig
 from ..profiling.config import DeepSpeedFlopsProfilerConfig
 from ..utils.logging import logger
 from . import constants as c
@@ -232,6 +233,9 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
             pd, c.WALL_CLOCK_BREAKDOWN, c.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get_scalar_param(pd, c.MEMORY_BREAKDOWN,
                                                  c.MEMORY_BREAKDOWN_DEFAULT)
+        # structured run telemetry (monitor/): JSONL event stream,
+        # profiler capture window, heartbeats — TensorBoard is one sink
+        self.monitor_config = DeepSpeedMonitorConfig(pd)
         tb = pd.get(c.TENSORBOARD, {})
         self.tensorboard_enabled = get_scalar_param(tb, c.TENSORBOARD_ENABLED,
                                                     c.TENSORBOARD_ENABLED_DEFAULT)

@@ -188,6 +188,7 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._pending_overflow = None
         self._pending_full = None
+        self.run_monitor = self._init_run_monitor()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -259,6 +260,7 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._pending_overflow = None
         self._pending_full = None
+        self.run_monitor = self._init_run_monitor()
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
         mesh_dict = {}
@@ -355,6 +357,104 @@ class DeepSpeedEngine:
         if warn_hook is not None:
             warn_hook()
         return sched
+
+    # ------------------------------------------------------------------
+    # structured run telemetry (monitor/)
+    # ------------------------------------------------------------------
+
+    def _init_run_monitor(self):
+        """Per-rank JSONL event stream + profiler capture window +
+        multi-host heartbeats (monitor/monitor.py).  The TensorBoard
+        monitor (if configured) becomes one sink beside the stream."""
+        mc = getattr(self._config, "monitor_config", None)
+        if mc is None or not mc.enabled:
+            return None
+        from ..monitor import RunMonitor
+
+        extra = {
+            "train_batch_size": self.train_batch_size(),
+            "micro_batch_size": self.train_micro_batch_size_per_gpu(),
+            "gradient_accumulation_steps":
+                self.gradient_accumulation_steps(),
+            "precision": self._config.precision,
+            "zero_stage": self._config.zero_optimization_stage,
+            "model": type(self.module).__name__,
+        }
+        return RunMonitor(mc, tensorboard=self.monitor,
+                          manifest_extra=extra)
+
+    def _maybe_monitor_flops(self, fn, *args, per_step_mult=1.0):
+        """Resolve flops-per-step ONCE via the flops profiler's cost
+        analysis (AOT lowering against the jit cache); the monitor then
+        derives achieved TFLOPs from it every step.  Any failure turns
+        the feature off rather than retrying per step."""
+        rm = self.run_monitor
+        if rm is None or rm.flops_per_step is not None \
+                or not rm.config.flops:
+            return
+        try:
+            from ..profiling.flops_profiler.profiler import analyze_fn
+
+            stats = analyze_fn(fn, *args)
+            rm.flops_per_step = float(stats["flops"]) * per_step_mult
+            rm.emit("flops", {"flops_per_step": rm.flops_per_step,
+                              "per_step_mult": per_step_mult})
+        except Exception as e:
+            rm.config.flops = False
+            logger.warning(f"monitor: flops analysis disabled: {e}")
+
+    def _monitor_scalar(self, x):
+        """Device scalar -> python float for a step event.  With
+        sync_timing false the user opted out of per-step syncs (the
+        deferred-overflow design exists to avoid exactly that stall), so
+        a device value still in flight is SKIPPED (is_ready check)
+        rather than blocked on — the event omits it."""
+        if x is None:
+            return None
+        ready = getattr(x, "is_ready", None)
+        if ready is not None and not self.run_monitor.sync_timing:
+            try:
+                if not ready():
+                    return None
+            except Exception:
+                return None
+        try:
+            return float(x)
+        except (TypeError, ValueError):
+            return None
+
+    def _emit_run_event(self, grad_norm=None, overflow=None, **extra):
+        """One schema-versioned step event on this rank (called from
+        every step-bookkeeping path once counters are settled)."""
+        rm = self.run_monitor
+        if rm is None:
+            return
+        metrics = {
+            "loss": self._monitor_scalar(self._last_loss),
+            "lr": self._current_lr(),
+            "loss_scale": self._monitor_scalar(
+                self._scaler_state["cur_scale"]),
+            "skipped_steps": self._skipped_steps,
+            "samples_per_sec": round(
+                self.tput_timer.avg_samples_per_sec(), 2),
+        }
+        ov = self._monitor_scalar(overflow)
+        if ov is not None:
+            metrics["overflow"] = bool(ov)
+        gn = self._monitor_scalar(grad_norm)
+        if gn is not None:
+            metrics["grad_norm"] = gn
+        metrics.update(extra)
+        rm.step_end(self.global_steps, **metrics)
+
+    def finalize_monitoring(self):
+        """Flush the event stream and write end-of-run summaries.  Under
+        multi-host the summary merge is collective — call on every rank
+        (or skip entirely; per-step events are already durable)."""
+        if self.run_monitor is not None:
+            self.run_monitor.close()
+        if self.monitor is not None:
+            self.monitor.flush()
 
     # ------------------------------------------------------------------
     # jitted step programs
@@ -714,10 +814,23 @@ class DeepSpeedEngine:
 
         gas==1 fast path: the whole step (fwd+bwd+optimizer+scaler) runs as
         one fused program here; step() then only does host bookkeeping."""
+        rm = self.run_monitor
+        if rm is not None and self.is_gradient_accumulation_boundary():
+            rm.step_start(self.global_steps)
+        sp = rm.span("forward") if rm is not None else None
         if self._infinity is not None:
-            return self._infinity_forward(batch)
-        if "full" in self._step_fns:
-            return self._fused_forward(batch, rng)
+            loss = self._infinity_forward(batch)
+        elif "full" in self._step_fns:
+            loss = self._fused_forward(batch, rng)
+        else:
+            loss = self._micro_forward(batch, rng)
+        if sp is not None:
+            sp.close(sync=loss if rm.sync_timing else None)
+        return loss
+
+    def _micro_forward(self, batch, rng):
+        """Split-path micro step: fused fwd+bwd into the gradient
+        accumulator; apply runs at the boundary in step()."""
         if self._grad_acc is None:
             self._grad_acc = self._zero_grad_acc()
         if self.is_gradient_accumulation_boundary():
@@ -728,6 +841,12 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop else 1.0, jnp.float32)
         profiling = self._maybe_profile_flops(batch, rng, theta)
+        # split path: flops/step ~= micro flops x gas (the apply program
+        # is optimizer-bound, negligible FLOPs next to fwd+bwd)
+        self._maybe_monitor_flops(
+            self._step_fns["micro"], self._params, self._grad_acc, batch,
+            rng, self._scaler_state["cur_scale"], theta,
+            per_step_mult=float(self.gradient_accumulation_steps()))
         if self._wall_clock_breakdown:
             self.timers("forward").start()
         loss, self._grad_acc, extras = self._step_fns["micro"](
@@ -789,6 +908,9 @@ class DeepSpeedEngine:
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         profiling = self._maybe_profile_flops(batch, rng, theta, lr=lr)
+        self._maybe_monitor_flops(
+            self._step_fns["full"], self._params, self._opt_state,
+            self._scaler_state, batch, rng, lr, theta)
         if self._wall_clock_breakdown:
             self.timers("forward").start()
         (self._params, self._opt_state, new_scaler, loss,
@@ -959,6 +1081,8 @@ class DeepSpeedEngine:
             return self._fused_step_bookkeeping()
         if self._wall_clock_breakdown:
             self.timers("step").start()
+        rsp = (self.run_monitor.span("step")
+               if self.run_monitor is not None else None)
         self._resolve_pending_overflow()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
@@ -979,10 +1103,15 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
         if self.progressive_layer_drop is not None:
             self.progressive_layer_drop.update_state(self.global_steps)
+        if rsp is not None:
+            rsp.close(sync=grad_norm if self.run_monitor.sync_timing
+                      else None)
         if self._wall_clock_breakdown:
             self.timers("step").stop(sync=grad_norm)
             self._log_timers()
-        if self.monitor is not None:
+        if self.monitor is not None or (
+                self.run_monitor is not None
+                and self.run_monitor.sync_timing):
             # Monitoring already syncs (float(loss)), so settle the deferred
             # overflow first — else the emitted lr scalar is one scheduler
             # step ahead on an overflowed step. Without a monitor the
@@ -1000,6 +1129,7 @@ class DeepSpeedEngine:
                 f"loss_scale={float(self._scaler_state['cur_scale'])}, "
                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
                 ranks=[0])
+        self._emit_run_event(grad_norm=grad_norm, overflow=overflow)
 
     def _fused_step_bookkeeping(self):
         """Host-side tail of the fused (gas==1) step: the device update was
@@ -1016,7 +1146,9 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.update_state(self.global_steps)
         if self._wall_clock_breakdown:
             self._log_timers()
-        if self.monitor is not None:
+        if self.monitor is not None or (
+                self.run_monitor is not None
+                and self.run_monitor.sync_timing):
             self._resolve_pending_overflow()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
@@ -1029,6 +1161,7 @@ class DeepSpeedEngine:
                 f"loss_scale={float(self._scaler_state['cur_scale'])}, "
                 f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
                 ranks=[0])
+        self._emit_run_event(grad_norm=_grad_norm, overflow=overflow)
 
     def _resolve_pending_overflow(self):
         """Apply the host-side bookkeeping for the PREVIOUS step's overflow
@@ -1113,6 +1246,7 @@ class DeepSpeedEngine:
             self._log_timers()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
+        self._emit_run_event(overflow=overflow)
 
     def train_batch(self, data_iter=None):
         """Convenience: run a full global batch (gas micro steps + update).
@@ -1154,6 +1288,9 @@ class DeepSpeedEngine:
             self.step()
             return self._last_loss
         self._resolve_pending_overflow()
+        rm = self.run_monitor
+        if rm is not None:
+            rm.step_start(self.global_steps)
         self.tput_timer.start()
         stacked = self._shard_batch_stacked(stacked)
         rngs = jnp.stack([self._next_rng() for _ in range(gas)])
@@ -1162,10 +1299,16 @@ class DeepSpeedEngine:
             if self.progressive_layer_drop else 1.0, jnp.float32)
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
+        self._maybe_monitor_flops(
+            self._step_fns["full_scan"], self._params, self._opt_state,
+            self._scaler_state, stacked, rngs, lr, theta)
+        sp = rm.span("forward") if rm is not None else None
         (self._params, self._opt_state, new_scaler, loss, overflow,
          grad_norm, extras) = self._step_fns["full_scan"](
             self._params, self._opt_state, self._scaler_state, stacked,
             rngs, lr, theta)
+        if sp is not None:
+            sp.close(sync=loss if rm.sync_timing else None)
         self._consume_extras(extras)
         self.micro_steps += gas
         self.global_samples += self.train_micro_batch_size_per_gpu() * \

@@ -43,12 +43,14 @@ reference-shaped executor for new-instruction bring-up.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Tuple
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 
+from ...monitor.counters import COUNTERS, tree_bytes
 from .p2p import batch_shardable
 from .schedule import (BackwardPass, ForwardPass, LoadMicroBatch,
                        OptimizerStep, RecvActivation, RecvGrad, ReduceGrads,
@@ -94,6 +96,72 @@ class PipeProgram:
         return (f"PipeProgram({len(self.events)} events from "
                 f"{self.n_source_events}, n_mc={self.n_mc}, "
                 f"M={self.micro_batches}, [{ops}...])")
+
+
+def schedule_occupancy(streams) -> List[Dict[str, Any]]:
+    """Per-physical-stage bubble/occupancy accounting from the canonical
+    per-stage tick streams (`engine._pipe_streams()` output — the same
+    object `compile_schedule` lowers).  A tick is `compute` when it
+    carries a Forward/BackwardPass; the bubble fraction is the idle-tick
+    share of the stage's stream — the schedule-theoretic pipeline bubble
+    ((P-1)/(M+P-1) for plain 1F1B), independent of hardware timing.
+    Emitted into every step event by the pipeline engine so a run's
+    JSONL records how much of its step is schedule-structural."""
+    out = []
+    for stage, stream in enumerate(streams):
+        ticks = len(stream)
+        compute = 0
+        for tick in stream:
+            cmds = tick if isinstance(tick, (list, tuple)) else (tick,)
+            if any(isinstance(c, (ForwardPass, BackwardPass))
+                   for c in cmds):
+                compute += 1
+        out.append({"stage": stage, "ticks": ticks,
+                    "compute_ticks": compute,
+                    "bubble_frac": round(1.0 - compute / max(1, ticks), 4)})
+    return out
+
+
+class PipeInstrument:
+    """Measured per-op dispatch-time accounting for the bound executor.
+
+    Wraps every bound closure in a perf_counter pair, accumulating
+    seconds by opcode and by model chunk.  This measures HOST dispatch
+    time (dispatch is async); the engine closes the whole batch on a
+    block_until_ready marker, so batch wall minus dispatch total bounds
+    the device-side remainder — both land in the step event.  Only
+    attached when a
+    RunMonitor is active: the unmonitored executor keeps its bare
+    `for f in steps: f()` loop."""
+
+    __slots__ = ("op_s", "stage_s")
+
+    def __init__(self):
+        self.op_s: Dict[str, float] = {}
+        self.stage_s: Dict[int, float] = {}
+
+    def wrap(self, opname: str, mc: int, fn: Callable[[], None]):
+        op_s, stage_s, clock = self.op_s, self.stage_s, time.perf_counter
+
+        def timed():
+            t0 = clock()
+            fn()
+            dt = clock() - t0
+            op_s[opname] = op_s.get(opname, 0.0) + dt
+            if mc >= 0:
+                stage_s[mc] = stage_s.get(mc, 0.0) + dt
+        return timed
+
+    def drain(self) -> Dict[str, Any]:
+        out = {
+            "op_ms": {k: round(v * 1000.0, 3)
+                      for k, v in sorted(self.op_s.items())},
+            "stage_ms": {str(k): round(v * 1000.0, 3)
+                         for k, v in sorted(self.stage_s.items())},
+        }
+        self.op_s.clear()
+        self.stage_s.clear()
+        return out
 
 
 def compile_schedule(events, mc_of: Callable[[int, Any], int], n_mc: int,
@@ -252,7 +320,9 @@ def _leaf_shardings(rt, avals):
         else rt.replicated, avals)
 
 
-def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
+def bind_program(engine, prog: PipeProgram, out_avals,
+                 instrument: Optional[PipeInstrument] = None
+                 ) -> List[Callable]:
     """Lower a PipeProgram to executable closures against `engine`.
 
     out_avals[mc] is the output aval tree of model chunk mc (from
@@ -266,6 +336,10 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
     Multi-host: events with no local role on this process are pruned
     (channel ops keep their collective entry order — both endpoints bind
     them at the same program positions).
+
+    instrument: optional PipeInstrument — wraps every bound closure in
+    per-op dispatch timing (attached by the engine when a RunMonitor is
+    active; None keeps the closures bare).
     """
     mh = engine._mh
     n_mc = prog.n_mc
@@ -285,6 +359,11 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
     labels_pool: List[Any] = [None] * prog.micro_batches
 
     steps: List[Callable[[], None]] = []
+
+    def push(f, opname, mc):
+        steps.append(f if instrument is None
+                     else instrument.wrap(opname, mc, f))
+
     for op, mc, mb, a, b, c in prog.events:
         if op == OP_LOAD:
             rt = rt_of(mc)
@@ -295,7 +374,7 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
 
             def f_load(eng=engine, xp=xp, slot=slot, mb=mb, place=place):
                 xp[slot] = place(eng._mb_cache[mb][0])
-            steps.append(f_load)
+            push(f_load, "load", mc)
         elif op == OP_FWD:
             rt = rt_of(mc)
             if rt is None:
@@ -313,7 +392,7 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
                     labels_pool[mb] = labels
                     rt.losses.append(rt.loss_j(rt.own, rt.ro_tied,
                                                xp[slot], labels, rng))
-                steps.append(f_fwd_last)
+                push(f_fwd_last, "fwd", mc)
             else:
                 yp = pools.get((mc, "y"))
                 def f_fwd(eng=engine, rt=rt, xp=xp, rp=rp, yp=yp,
@@ -323,7 +402,7 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
                     y = rt.fwd_j(rt.own, rt.ro_tied, xp[xs], rng)
                     if ys >= 0:
                         yp[ys] = y
-                steps.append(f_fwd)
+                push(f_fwd, "fwd", mc)
         elif op == OP_BWD:
             rt = rt_of(mc)
             if rt is None:
@@ -345,7 +424,7 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
                         rt.acc, rt.acc_ro)
                     if dxs >= 0:
                         dxp[dxs] = dx
-                steps.append(f_bwd_last)
+                push(f_bwd_last, "bwd", mc)
             else:
                 dyp = pools[(mc, "dy")]
                 def f_bwd(rt=rt, xp=xp, rp=rp, dyp=dyp, dxp=dxp,
@@ -360,39 +439,42 @@ def bind_program(engine, prog: PipeProgram, out_avals) -> List[Callable]:
                         rt.own, rt.ro_tied, x, rng, dy, rt.acc, rt.acc_ro)
                     if dxs >= 0:
                         dxp[dxs] = dx
-                steps.append(f_bwd)
+                push(f_bwd, "bwd", mc)
         elif op == OP_XFER_ACT:
             f = _bind_xfer(engine, mh, src_mc=mc, dst_mc=mc + 1,
                            avals=out_avals[mc],
                            src_pool=pools.get((mc, "y")), src_slot=a,
                            dst_pool=pools[(mc + 1, "x")], dst_slot=b,
                            chan=(engine._chan_act.get(mc) if mh else None),
-                           rt_of=rt_of)
+                           rt_of=rt_of, kind="act")
             if f is not None:
-                steps.append(f)
+                push(f, "xfer_act", mc)
         elif op == OP_XFER_GRAD:
             f = _bind_xfer(engine, mh, src_mc=mc, dst_mc=mc - 1,
                            avals=out_avals[mc - 1],
                            src_pool=pools.get((mc, "dx")), src_slot=a,
                            dst_pool=pools[(mc - 1, "dy")], dst_slot=b,
                            chan=(engine._chan_grad.get(mc) if mh else None),
-                           rt_of=rt_of)
+                           rt_of=rt_of, kind="grad")
             if f is not None:
-                steps.append(f)
+                push(f, "xfer_grad", mc)
         elif op == OP_TIED:
-            steps.append(engine._reduce_tied_grads_mh if mh
-                         else engine._reduce_tied_grads)
+            push(engine._reduce_tied_grads_mh if mh
+                 else engine._reduce_tied_grads, "tied", -1)
         elif op == OP_STEP:
-            steps.append(engine._pipe_optimizer_step_mh if mh
-                         else engine._pipe_optimizer_step)
+            push(engine._pipe_optimizer_step_mh if mh
+                 else engine._pipe_optimizer_step, "step", -1)
         else:
             raise NotImplementedError(f"opcode {op}")
     return steps
 
 
 def _bind_xfer(engine, mh, src_mc, dst_mc, avals, src_pool, src_slot,
-               dst_pool, dst_slot, chan, rt_of):
-    """One fused send+recv: returns a closure or None (no local role)."""
+               dst_pool, dst_slot, chan, rt_of, kind="act"):
+    """One fused send+recv: returns a closure or None (no local role).
+    Payload bytes are resolved from the avals ONCE here and counted per
+    dispatch (`pipe.xfer_{kind}`); the channel (mh) paths count inside
+    ChannelPlan instead."""
     if not mh:
         # single-controller: a device_put resharding, target layout
         # resolved once from the aval (the interpreted path re-derives it
@@ -400,9 +482,12 @@ def _bind_xfer(engine, mh, src_mc, dst_mc, avals, src_pool, src_slot,
         rt_dst = rt_of(dst_mc)
         sh = _leaf_shardings(rt_dst, avals)
         device_put = jax.device_put
+        nbytes = tree_bytes(avals)
+        cname = f"pipe.xfer_{kind}"
 
         def f_put(sp=src_pool, ss=src_slot, dp=dst_pool, ds=dst_slot,
-                  sh=sh, device_put=device_put):
+                  sh=sh, device_put=device_put, nbytes=nbytes, cname=cname):
+            COUNTERS.add(cname, nbytes)
             y = sp[ss]
             sp[ss] = None
             dp[ds] = device_put(y, sh)

@@ -45,7 +45,8 @@ from ...utils.logging import log_dist, logger
 from .. import checkpointing as ckpt_io
 from ..engine import DeepSpeedEngine
 from ..utils import has_overflow
-from .compiler import bind_program, compile_schedule
+from .compiler import (PipeInstrument, bind_program, compile_schedule,
+                       schedule_occupancy)
 from .module import PipelineModule, TiedLayerSpec
 from .p2p import Channel, GlobalScalars, batch_shardable
 from .schedule import (BackwardPass, ForwardPass, InterleavedTrainSchedule,
@@ -264,6 +265,10 @@ class PipelineEngine(DeepSpeedEngine):
         self._debug_schedule = bool(self._config.pipe_debug_schedule)
         self._pipe_prog = None
         self._bound_cache: Dict[Any, Any] = {}
+        # telemetry: dispatch-time instrument (attached at bind time when
+        # a RunMonitor is active) + cached schedule-bubble accounting
+        self._pipe_instrument = None
+        self._pipe_occupancy = None
         if self._staged:
             if self._mh:
                 self._build_stages_mh()
@@ -600,6 +605,8 @@ class PipelineEngine(DeepSpeedEngine):
                     f"pipeline schedule deadlock in simulation at {pos}")
 
     def _train_batch_mh(self, data_iter):
+        if self.run_monitor is not None:
+            self.run_monitor.step_start(self.global_steps)
         self.tput_timer.start()
         M = self.micro_batches
         # the multi-host data contract (same as the DP engines'): every
@@ -634,6 +641,7 @@ class PipelineEngine(DeepSpeedEngine):
                 self.global_steps % self.steps_per_print() == 0:
             log_dist(f"pipe step={self.global_steps} "
                      f"loss={float(self._last_loss):.4f}", ranks=[0])
+        self._emit_pipe_run_event()
         return self._last_loss
 
     def _dispatch_mh(self, s: int, cmd):
@@ -879,10 +887,39 @@ class PipelineEngine(DeepSpeedEngine):
                 events = self._simulate_order(self._pipe_streams())
                 self._pipe_prog = compile_schedule(
                     events, self._mc, self._n_mc, self.micro_batches)
+            if self.run_monitor is not None and \
+                    self._pipe_instrument is None:
+                self._pipe_instrument = PipeInstrument()
             steps = bind_program(self, self._pipe_prog,
-                                 self._chunk_out_avals(x_aval))
+                                 self._chunk_out_avals(x_aval),
+                                 instrument=self._pipe_instrument)
             self._bound_cache[key] = steps
         return steps
+
+    def _pipe_occupancy_stats(self):
+        """Schedule-tick bubble/occupancy per physical stage (cached —
+        pure function of (M, stages, interleave))."""
+        if self._pipe_occupancy is None:
+            self._pipe_occupancy = schedule_occupancy(self._pipe_streams())
+        return self._pipe_occupancy
+
+    def _emit_pipe_run_event(self):
+        """Per-batch telemetry event for the pipeline executors: step
+        bookkeeping (loss/lr/scale via the base emitter) + pipeline
+        bubble accounting + measured per-op dispatch time + the comm
+        counter deltas picked up by step_end."""
+        rm = self.run_monitor
+        if rm is None:
+            return
+        if rm.sync_timing and self._last_loss is not None:
+            jax.block_until_ready(self._last_loss)
+        pipe: Dict[str, Any] = {"occupancy": self._pipe_occupancy_stats()}
+        if self._pipe_prog is not None:
+            pipe["events"] = len(self._pipe_prog.events)
+            pipe["source_events"] = self._pipe_prog.n_source_events
+        if self._pipe_instrument is not None:
+            pipe.update(self._pipe_instrument.drain())
+        self._emit_run_event(pipe=pipe)
 
     def _train_batch_compiled(self, data_iter):
         """Default train_batch executor: an index walk over the bound
@@ -891,6 +928,8 @@ class PipelineEngine(DeepSpeedEngine):
         mail-dict bookkeeping per event.  `pipeline.debug_schedule: true`
         selects the interpreted per-event oracle instead; the two are
         pinned bit-identical by tests/test_pipe_compiler.py."""
+        if self.run_monitor is not None:
+            self.run_monitor.step_start(self.global_steps)
         self.tput_timer.start()
         M = self.micro_batches
         self._mb_cache = [self._next_micro_batch_from(data_iter)
@@ -921,6 +960,7 @@ class PipelineEngine(DeepSpeedEngine):
                 self.global_steps % self.steps_per_print() == 0:
             log_dist(f"pipe step={self.global_steps} "
                      f"loss={float(self._last_loss):.4f}", ranks=[0])
+        self._emit_pipe_run_event()
         return self._last_loss
 
     def train_batch(self, data_iter=None):
@@ -938,6 +978,8 @@ class PipelineEngine(DeepSpeedEngine):
         if self._mh:
             return self._train_batch_mh(data_iter)
 
+        if self.run_monitor is not None:
+            self.run_monitor.step_start(self.global_steps)
         self.tput_timer.start()
         M = self.micro_batches
         n_rt = len(self.stages)
@@ -972,6 +1014,7 @@ class PipelineEngine(DeepSpeedEngine):
                 self.global_steps % self.steps_per_print() == 0:
             log_dist(f"pipe step={self.global_steps} "
                      f"loss={float(loss):.4f}", ranks=[0])
+        self._emit_pipe_run_event()
         return loss
 
     # -- instruction handlers ------------------------------------------

@@ -36,6 +36,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ...monitor.counters import COUNTERS, tree_bytes
+
 
 def batch_shardable(shape, group_size: int) -> bool:
     """THE shard-vs-replicate rule for pipeline payloads: batch-shard over
@@ -157,6 +159,11 @@ class Channel:
         process is not a receiver."""
         if not (self.is_src or self.is_dst):
             return None
+        nbytes = tree_bytes(avals)
+        if self.is_src:
+            COUNTERS.add("p2p.send", nbytes)
+        if self.is_dst:
+            COUNTERS.add("p2p.recv", nbytes)
         leaves, treedef = jax.tree_util.tree_flatten(avals)
         vleaves = (treedef.flatten_up_to(values)
                    if self.is_src else [None] * len(leaves))
@@ -186,11 +193,12 @@ class ChannelPlan:
 
     __slots__ = ("treedef", "n", "is_src", "is_dst", "gshapes",
                  "in_shardings", "src_shardings", "zero_rows", "dst_ids",
-                 "out_shapes", "out_shardings", "fused")
+                 "out_shapes", "out_shardings", "fused", "payload_bytes")
 
     def __init__(self, chan: "Channel", avals):
         leaves, self.treedef = jax.tree_util.tree_flatten(avals)
         self.n = len(leaves)
+        self.payload_bytes = tree_bytes(avals)
         self.is_src = chan.is_src
         self.is_dst = chan.is_dst
         me = jax.process_index()
@@ -235,6 +243,10 @@ class ChannelPlan:
                 for sh in flags))
 
     def __call__(self, values=None):
+        if self.is_src:
+            COUNTERS.add("p2p.plan.send", self.payload_bytes)
+        if self.is_dst:
+            COUNTERS.add("p2p.plan.recv", self.payload_bytes)
         from_rows = jax.make_array_from_single_device_arrays
         garrs = []
         if self.is_src:
@@ -281,6 +293,7 @@ class GlobalScalars:
 
     def sum(self, vec) -> np.ndarray:
         vec = np.asarray(vec, np.float32)
+        COUNTERS.add("p2p.global_scalars", vec.nbytes)
         if self.nprocs == 1:
             return vec
         garr = jax.make_array_from_process_local_data(

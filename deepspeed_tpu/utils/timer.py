@@ -73,12 +73,21 @@ class SynchronizedWallClockTimer:
 
     @staticmethod
     def memory_usage() -> str:
-        """Device-memory line (reference reports cuda alloc/cache peaks)."""
+        """Device-memory line (reference reports cuda alloc/cache peaks),
+        aggregated over ALL local devices: total (sum) and the hottest
+        single device (max) — one device's stats alone under-reports
+        every multi-chip host."""
         try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-            used = stats.get("bytes_in_use", 0) / 2**30
-            peak = stats.get("peak_bytes_in_use", 0) / 2**30
-            return f"mem: in_use {used:.2f} GB | peak {peak:.2f} GB"
+            from ..monitor.monitor import device_memory_stats
+
+            stats = device_memory_stats()
+            if not stats:
+                return "mem: unavailable"
+            gb = 2 ** 30
+            return (f"mem: in_use {stats['bytes_in_use_sum'] / gb:.2f} GB "
+                    f"(max/dev {stats['bytes_in_use_max'] / gb:.2f}) | "
+                    f"peak {stats['peak_bytes_in_use_sum'] / gb:.2f} GB "
+                    f"(max/dev {stats['peak_bytes_in_use_max'] / gb:.2f})")
         except Exception:
             return "mem: unavailable"
 
@@ -92,6 +101,8 @@ class SynchronizedWallClockTimer:
                 ms = self.timers[name].elapsed(reset=reset) * 1000.0 / \
                     normalizer
                 parts.append(f"{name}: {ms:.2f}")
+        if not parts and not memory_breakdown:
+            return  # nothing matched: no bare "time (ms) |" line
         line = "time (ms) | " + " | ".join(parts)
         if memory_breakdown:
             line += " | " + self.memory_usage()

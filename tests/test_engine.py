@@ -233,6 +233,89 @@ def test_scan_fused_train_batch_matches_manual_accumulation():
         scan_engine.params, manual_engine.params)
 
 
+class StubSummaryWriter:
+    """SummaryWriter-shaped sink (utils/tensorboard.py writer injection)."""
+
+    def __init__(self):
+        self.scalars = []
+        self.flushes = 0
+
+    def add_scalar(self, tag, value, step):
+        self.scalars.append((tag, float(value), step))
+
+    def flush(self):
+        self.flushes += 1
+
+
+def test_wall_clock_breakdown_timers_log_and_scalars(monkeypatch):
+    """wall_clock_breakdown path: forward/step timers fire, the windowed
+    log line renders, and monitor scalars reach a stubbed SummaryWriter
+    (previously zero tier-1 coverage)."""
+    import deepspeed_tpu.utils.tensorboard as tb_mod
+    import deepspeed_tpu.utils.timer as timer_mod
+
+    stub = StubSummaryWriter()
+    orig_tb = tb_mod.TensorBoardMonitor
+    monkeypatch.setattr(
+        tb_mod, "TensorBoardMonitor",
+        lambda path, job, **kw: orig_tb(path, job, writer=stub))
+    lines = []
+    monkeypatch.setattr(timer_mod, "log_dist",
+                        lambda msg, ranks=None, **kw: lines.append(msg))
+
+    cfg = base_config(wall_clock_breakdown=True,
+                      gradient_accumulation_steps=4,
+                      tensorboard={"enabled": True, "job_name": "t"})
+    engine, *_ = ds.initialize(model=SimpleModel(), config=cfg)
+    assert engine.wall_clock_breakdown()
+    for b in random_batches(8, batch_size=8):
+        engine.forward(b)
+        engine.backward()
+        engine.step()
+
+    # the split path arms both named timers
+    assert engine.timers.has("forward") and engine.timers.has("step")
+    # the windowed breakdown line rendered with both timer entries
+    assert lines, "no wall-clock breakdown line was logged"
+    assert any(ln.startswith("time (ms) | ") and "forward:" in ln
+               and "step:" in ln for ln in lines)
+    # monitor scalars reached the stubbed writer
+    tags = {t for t, _, _ in stub.scalars}
+    assert "Train/Samples/train_loss" in tags
+    assert "Train/Samples/lr" in tags
+    assert "Train/Samples/loss_scale" in tags
+
+
+def test_timer_log_skips_when_no_timer_matched(monkeypatch):
+    import deepspeed_tpu.utils.timer as timer_mod
+    from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer
+
+    lines = []
+    monkeypatch.setattr(timer_mod, "log_dist",
+                        lambda msg, ranks=None, **kw: lines.append(msg))
+    timers = SynchronizedWallClockTimer()
+    timers.log(["never_started"])  # used to print a bare "time (ms) |"
+    assert lines == []
+    timers("hit").start()
+    timers("hit").stop()
+    timers.log(["hit", "never_started"])
+    assert len(lines) == 1 and "hit:" in lines[0]
+
+
+def test_tensorboard_monitor_drops_nonfinite_and_flushes_on_interval():
+    from deepspeed_tpu.utils.tensorboard import TensorBoardMonitor
+
+    stub = StubSummaryWriter()
+    mon = TensorBoardMonitor(writer=stub, flush_interval=5)
+    mon.add_scalar("loss", float("nan"), 0)  # silently poisoned before
+    mon.add_scalar("loss", float("inf"), 1)
+    assert stub.scalars == []
+    for step in range(12):
+        mon.add_scalar("loss", 1.0, step)
+    assert len(stub.scalars) == 12
+    assert stub.flushes >= 2  # interval flushes, not never-except-explicit
+
+
 def test_save_fp16_model_and_consolidated_state(tmp_path):
     cfg = base_config(bf16={"enabled": True},
                       zero_optimization={"stage": 3}, mesh={"data": 8})

@@ -1,0 +1,32 @@
+"""Import sanity: every deepspeed_tpu module must import cleanly.
+
+Collection-time breakage (a bad import chain, a missing optional-dep
+guard, a circular import introduced by a refactor) otherwise surfaces as
+a wall of unrelated collection errors; this test names the exact broken
+module instead."""
+
+import importlib
+import pkgutil
+
+import deepspeed_tpu
+
+
+def test_all_modules_import():
+    failures = []
+    for mod in pkgutil.walk_packages(deepspeed_tpu.__path__,
+                                     prefix="deepspeed_tpu."):
+        try:
+            importlib.import_module(mod.name)
+        except Exception as e:
+            failures.append(f"{mod.name}: {type(e).__name__}: {e}")
+    assert not failures, "modules failed to import:\n" + "\n".join(failures)
+
+
+def test_monitor_package_surface():
+    """The telemetry package's public names (docs/tutorials/monitoring.md
+    contract)."""
+    from deepspeed_tpu import monitor
+
+    for name in ("RunMonitor", "DeepSpeedMonitorConfig", "COUNTERS",
+                 "Span", "TraceWindow", "SCHEMA_VERSION", "tree_bytes"):
+        assert hasattr(monitor, name), name

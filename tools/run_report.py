@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Render a telemetry run (monitor/ JSONL event stream) as a BENCH.md-
+style markdown report.
+
+Usage:
+    python tools/run_report.py runs/my_run            # a run directory
+    python tools/run_report.py runs/my_run -o rep.md  # write to a file
+    python tools/run_report.py --selftest             # synthetic round-trip
+
+The run directory is what `{"monitor": {"enabled": true}}` produces:
+manifest.json + events.rank*.jsonl (+ summaries).  `--selftest` writes a
+synthetic run through the real writer and renders it back — a smoke for
+the whole schema path with no engine involved.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def selftest() -> int:
+    import tempfile
+
+    from deepspeed_tpu.monitor import (COUNTERS, DeepSpeedMonitorConfig,
+                                       RunMonitor)
+    from deepspeed_tpu.monitor.report import (load_run, render_markdown,
+                                              summarize, validate_event)
+
+    with tempfile.TemporaryDirectory() as root:
+        cfg = DeepSpeedMonitorConfig({"monitor": {
+            "enabled": True, "output_path": root, "job_name": "selftest",
+            "flush_interval": 1, "tokens_per_sample": 128}})
+        mon = RunMonitor(cfg, rank=0, world=1)
+        for step in range(1, 4):
+            mon.step_start(step - 1)
+            COUNTERS.add("p2p.send", 1024)
+            sp = mon.span("forward")
+            sp.close()
+            mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
+                         samples_per_sec=100.0, skipped_steps=0,
+                         pipe={"occupancy": [
+                             {"stage": 0, "ticks": 9, "compute_ticks": 8,
+                              "bubble_frac": 0.1111}]})
+        mon.close()
+        run = load_run(os.path.join(root, "selftest"))
+        bad = [err for events in run["ranks"].values()
+               for e in events for err in validate_event(e)]
+        assert not bad, f"schema violations: {bad}"
+        s = summarize(run["ranks"][0])
+        assert s["n_steps"] == 3, s
+        assert s["comm"]["p2p.send"]["bytes"] == 3072, s
+        assert s["mean_tokens_per_sec"] is not None, s
+        md = render_markdown(run)
+        for needle in ("Run report", "p2p.send", "Pipeline occupancy",
+                       "11.1%", "forward"):
+            assert needle in md, f"{needle!r} missing from report"
+    print("run_report selftest ok")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir", nargs="?",
+                    help="run directory (manifest.json + events.rank*.jsonl)")
+    ap.add_argument("-o", "--output", help="write markdown here "
+                    "(default: stdout)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="synthetic write->render round-trip")
+    args = ap.parse_args()
+    if args.selftest:
+        return selftest()
+    if not args.run_dir:
+        ap.error("run_dir is required (or --selftest)")
+
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    md = render_markdown(load_run(args.run_dir))
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(md)
+        print(f"wrote {args.output}")
+    else:
+        print(md)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
