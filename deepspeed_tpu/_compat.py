@@ -125,6 +125,24 @@ def install_cpu_collectives() -> None:
             adflag = getattr(xb, "_CPU_ENABLE_ASYNC_DISPATCH", None)
             if adflag is not None and adflag.value:
                 adflag._set(False)
+            # a mere jax.process_count()/device_count() before this shim
+            # ran already instantiated the CPU client WITH "none"
+            # collectives — the flag flip can't retrofit a live client
+            # (and rebuilding one re-publishes its local topology to the
+            # coordination service, which rejects the duplicate key), so
+            # every multiprocess computation will die with "Multiprocess
+            # computations aren't implemented on the CPU backend".  Warn
+            # with the fix instead of leaving the user to decode that.
+            if "cpu" in (getattr(xb, "_backends", None) or {}):
+                import warnings
+
+                warnings.warn(
+                    "deepspeed_tpu: the CPU backend was created before the "
+                    "gloo collectives flag could be set — multiprocess CPU "
+                    "collectives WILL fail.  Import deepspeed_tpu (or call "
+                    "deepspeed_tpu.comm.init_distributed) immediately after "
+                    "jax.distributed.initialize, before any "
+                    "jax.device_count()/process_count() call.")
     except (ImportError, AttributeError):  # new jax: gloo is the default
         pass
 

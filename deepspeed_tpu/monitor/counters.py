@@ -20,6 +20,10 @@ Instrumented sites:
   (once per compiled program), not a per-execution count; the name
   prefix `dist.` marks that distinction.
 * `runtime/comm/hostwire.py` — KV-wire payload bytes per allgather.
+* `runtime/comm/bucketing.py` — `bucket.*` per-bucket collective payloads
+  (traced occurrences, like `dist.*`); the engine additionally records
+  per-dispatch `grad_wire.reduce` totals from the BucketPlan's static
+  accounting, which tests pin against the plan exactly.
 """
 
 from __future__ import annotations

@@ -1,7 +1,10 @@
+from .bucketing import BucketPlan, WIRE_MODES
 from .compressed import CompressedBackend, compressed_allreduce
-from .compressed_ar import (compressed_all_reduce, decompose, reconstruct)
+from .compressed_ar import (compressed_all_reduce, decompose,
+                            decompose_int8_safe, reconstruct)
 from .hostwire import HostWire, HostWireBackend
 
-__all__ = ["CompressedBackend", "compressed_allreduce",
-           "compressed_all_reduce", "decompose", "reconstruct",
-           "HostWire", "HostWireBackend"]
+__all__ = ["BucketPlan", "WIRE_MODES", "CompressedBackend",
+           "compressed_allreduce", "compressed_all_reduce", "decompose",
+           "decompose_int8_safe", "reconstruct", "HostWire",
+           "HostWireBackend"]

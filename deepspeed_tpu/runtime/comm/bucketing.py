@@ -1,0 +1,268 @@
+"""Bucketed gradient-reduction wire for the dense data-parallel path.
+
+Round-5 measured the dense DP step at 270 ms on the 2-process TCP fabric
+vs 53 ms for the onebit `sign` wire carrying the SAME bytes: the gap is
+~40 per-leaf collectives (XLA's implicit psum at the loss-mean boundary)
+vs one fused buffer, and per-collective latency dominates on
+serialization-bound fabrics.  This module is the reference's bucketing
+recipe (stage2.py:614-745 flatten/reduce machinery, ZeRO §5 of
+1910.02054) rebuilt as a STATIC plan the jitted step consumes:
+
+* `BucketPlan` is computed ONCE at `initialize()` from the gradient tree
+  — dtype-segregated, size-capped flat buckets (honoring the config's
+  `reduce_bucket_size`, in elements like the reference) with precomputed
+  per-leaf offsets.  No per-step Python walks the tree to decide layout.
+* Inside the jitted step (under `shard_map` over the `data` axis) the
+  local gradients concatenate into the plan's buckets and ride ONE
+  collective per bucket instead of one per leaf.
+* Wire modes select what crosses the fabric:
+    - "fp32"  psum of the fp32 bucket (the `fp32_allreduce` /
+              `allreduce_always_fp32` behaviour; default).
+    - "bf16"  bucket cast to bf16 before the psum — half the bytes,
+              ~8-bit mantissa accumulation (XLA sums bf16 natively).
+    - "split" the EleutherAI 24-bit frexp wire (compressed_ar.py) riding
+              GATHER semantics: each rank's bucket decomposes into an
+              fp16 mantissa + int8 exponent (3 bytes/elem), both
+              all-gathered, then ldexp-reconstructed in fp32 and summed
+              locally.  Per-contribution relative error is ≤ 2^-11
+              (fp16 mantissa) — tighter than bf16's 2^-8 — and, unlike
+              an arithmetic reduce (which XLA upcasts BEFORE the
+              transfer, see BENCH.md round-5 methodology note), gather
+              semantics keep the narrow dtype ON the wire.
+* For ZeRO stage >= 2 the bucket reduction lowers to `psum_scatter`
+  (reduce-scatter): each dp rank materializes only the bucket shards its
+  optimizer partition owns; the post-step parameter all-gather rides
+  XLA's sharding propagation exactly as before (zero/partition.py).
+
+Every traced collective records its payload into the monitor COUNTERS
+(`bucket.*`, traced-occurrence semantics like `dist.*`); the engine adds
+per-dispatch `grad_wire.reduce` counts from `wire_bytes_per_reduction` /
+`collectives_per_reduction` so byte accounting is auditable per step
+(tests/test_grad_bucketing.py pins the two against each other).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...comm.mesh import DATA_AXIS
+
+WIRE_MODES = ("fp32", "bf16", "split")
+
+# bytes per element actually handed to the collective, per wire mode
+_WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2, "split": 3}  # fp16 m + int8 e
+
+
+def _record(op: str, nbytes: int) -> None:
+    """Traced-occurrence counter (once per compiled program, like the
+    `dist.*` wrappers) — never raises into a trace."""
+    try:
+        from ...monitor.counters import COUNTERS
+
+        COUNTERS.add(f"bucket.{op}", nbytes)
+    except Exception:
+        pass
+
+
+class LeafSlot(NamedTuple):
+    """Where one gradient leaf lives inside its bucket."""
+
+    leaf_id: int          # index in tree_flatten order
+    offset: int           # element offset into the flat bucket
+    size: int             # element count
+    shape: Tuple[int, ...]
+
+
+class BucketSpec(NamedTuple):
+    dtype: Any            # numpy dtype of the leaves in this bucket
+    slots: Tuple[LeafSlot, ...]
+    n_elems: int          # payload elements (sum of slot sizes)
+    padded: int           # n_elems rounded up for reduce-scatter
+
+
+class BucketPlan:
+    """Static flat-bucket layout + the in-jit reduce that consumes it.
+
+    Built once from the gradient tree STRUCTURE (shapes/dtypes — arrays
+    or ShapeDtypeStructs both work); all methods taking gradient values
+    are pure and trace-safe.
+    """
+
+    def __init__(self, grad_tree, *, dp_size: int, axis: str = DATA_AXIS,
+                 bucket_elems: int, wire: str = "fp32",
+                 scatter: bool = False):
+        if wire not in WIRE_MODES:
+            raise ValueError(
+                f"unknown wire mode {wire!r}; choose from {WIRE_MODES}")
+        if bucket_elems <= 0:
+            raise ValueError(f"reduce_bucket_size must be > 0, "
+                             f"got {bucket_elems}")
+        if scatter and wire == "split":
+            # the split wire is gather-structured; a scattered gather
+            # would re-materialize the full bucket anyway.  Callers
+            # (engine._build_bucket_plan) log the fallback.
+            scatter = False
+        self.axis = axis
+        self.dp_size = int(dp_size)
+        self.wire = wire
+        self.scatter = bool(scatter)
+        self.bucket_elems = int(bucket_elems)
+
+        leaves, self.treedef = jax.tree_util.tree_flatten(grad_tree)
+        self._leaf_shapes = [tuple(l.shape) for l in leaves]
+        self._leaf_dtypes = [np.dtype(l.dtype) for l in leaves]
+
+        self.buckets: List[BucketSpec] = []
+        open_by_dtype = {}  # dtype -> (slots, fill)
+        for lid, leaf in enumerate(leaves):
+            shape = tuple(leaf.shape)
+            size = int(np.prod(shape or (1,), dtype=np.int64))
+            dt = np.dtype(leaf.dtype)
+            slots, fill = open_by_dtype.get(dt, ([], 0))
+            if slots and fill + size > self.bucket_elems:
+                self._close(dt, slots, fill)
+                slots, fill = [], 0
+            slots.append(LeafSlot(lid, fill, size, shape))
+            fill += size
+            open_by_dtype[dt] = (slots, fill)
+            if fill >= self.bucket_elems:
+                self._close(dt, slots, fill)
+                open_by_dtype[dt] = ([], 0)
+        for dt, (slots, fill) in open_by_dtype.items():
+            if slots:
+                self._close(dt, slots, fill)
+
+        # wire accounting, fixed at plan-build time
+        itemsize = _WIRE_ITEMSIZE[self.wire]
+        self.wire_bytes_per_reduction = sum(
+            b.padded * itemsize for b in self.buckets)
+        self.collectives_per_reduction = (
+            (2 if self.wire == "split" else 1) * len(self.buckets))
+
+    def _close(self, dtype, slots, fill):
+        pad = 0
+        if self.scatter and self.dp_size > 1 and fill % self.dp_size:
+            pad = self.dp_size - fill % self.dp_size
+        self.buckets.append(BucketSpec(dtype, tuple(slots), fill,
+                                       fill + pad))
+
+    # -- in-jit layout ops --------------------------------------------
+
+    def flatten(self, grads) -> List[jnp.ndarray]:
+        """Gradient tree -> list of flat buckets (zero-padded for the
+        reduce-scatter lowering)."""
+        leaves = jax.tree_util.tree_leaves(grads)
+        out = []
+        for b in self.buckets:
+            parts = [leaves[s.leaf_id].reshape(-1) for s in b.slots]
+            if b.padded > b.n_elems:
+                parts.append(jnp.zeros((b.padded - b.n_elems,), b.dtype))
+            out.append(jnp.concatenate(parts)
+                       if len(parts) > 1 else parts[0])
+        return out
+
+    def unflatten(self, buckets) -> Any:
+        """List of flat (reduced) buckets -> gradient tree."""
+        leaves: List[Optional[jnp.ndarray]] = [None] * len(self._leaf_shapes)
+        for b, flat in zip(self.buckets, buckets):
+            for s in b.slots:
+                leaves[s.leaf_id] = lax.slice(
+                    flat, (s.offset,), (s.offset + s.size,)).reshape(s.shape)
+        return jax.tree_util.tree_unflatten(self.treedef, leaves)
+
+    # -- in-jit reduction (call inside shard_map over self.axis) ------
+
+    def reduce(self, buckets) -> List[jnp.ndarray]:
+        """Mean-reduce each flat bucket over the data axis: ONE collective
+        per bucket (two for the split wire).  Must run in a manual-mesh
+        region (shard_map) with `self.axis` bound."""
+        return [self._reduce_one(flat, b) for flat, b in
+                zip(buckets, self.buckets)]
+
+    def _reduce_one(self, flat, spec: BucketSpec):
+        axis, dp = self.axis, self.dp_size
+        itemsize = _WIRE_ITEMSIZE[self.wire]
+        nbytes = spec.padded * itemsize
+        if self.wire == "bf16":
+            wired = flat.astype(jnp.bfloat16)
+            if self.scatter:
+                _record("psum_scatter", nbytes)
+                red = lax.psum_scatter(wired, axis, scatter_dimension=0,
+                                       tiled=True)
+            else:
+                _record("psum", nbytes)
+                red = lax.psum(wired, axis)
+            return red.astype(flat.dtype) / dp
+        if self.wire == "split":
+            # 24-bit gather wire: the frexp split
+            # (compressed_ar.decompose_int8_safe — subnormals flushed,
+            # the >= 2^127 tail pushed to inf so overflow checks fire;
+            # the int8 exponent never wraps) rides all_gather so
+            # fp16+int8 stay narrow ON the wire (an arithmetic reduce
+            # upcasts before the transfer — BENCH.md round-5 methodology
+            # note); reconstruction and the cross-rank sum run locally
+            # in fp32.
+            from .compressed_ar import decompose_int8_safe
+
+            mantissa, exponent = decompose_int8_safe(flat)
+            _record("all_gather", spec.padded * 2)
+            m_all = lax.all_gather(mantissa, axis, axis=0, tiled=False)
+            _record("all_gather", spec.padded * 1)
+            e_all = lax.all_gather(exponent.astype(jnp.int8), axis,
+                                   axis=0, tiled=False)
+            contrib = jnp.ldexp(m_all.astype(jnp.float32),
+                                e_all.astype(jnp.int32))
+            return (jnp.sum(contrib, axis=0) / dp).astype(flat.dtype)
+        # fp32-accumulate (allreduce_always_fp32 semantics)
+        wired = flat.astype(jnp.float32)
+        if self.scatter:
+            _record("psum_scatter", nbytes)
+            red = lax.psum_scatter(wired, axis, scatter_dimension=0,
+                                   tiled=True)
+        else:
+            _record("psum", nbytes)
+            red = lax.psum(wired, axis)
+        return (red / dp).astype(flat.dtype)
+
+    # -- shard_map plumbing -------------------------------------------
+
+    def bucket_out_specs(self):
+        """Out specs for the reduced buckets: scattered buckets leave the
+        manual region sharded over the data axis (each rank holds only
+        its shard — the ZeRO-2 wire contract), full reductions leave
+        replicated."""
+        spec = P(self.axis) if self.scatter else P()
+        return [spec for _ in self.buckets]
+
+    # -- introspection ------------------------------------------------
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def n_leaves(self) -> int:
+        return len(self._leaf_shapes)
+
+    @property
+    def total_elems(self) -> int:
+        return sum(b.n_elems for b in self.buckets)
+
+    def describe(self) -> str:
+        sizes = ", ".join(f"{b.n_elems}" + (f"+{b.padded - b.n_elems}pad"
+                                            if b.padded > b.n_elems else "")
+                          for b in self.buckets)
+        lowering = "reduce-scatter" if self.scatter else "allreduce"
+        return (f"BucketPlan: {self.n_leaves} grad leaves -> "
+                f"{self.n_buckets} bucket(s) [{sizes}] elems, "
+                f"wire={self.wire} ({lowering}), "
+                f"{self.wire_bytes_per_reduction} wire bytes / "
+                f"{self.collectives_per_reduction} collective(s) per "
+                f"reduction over dp={self.dp_size}")

@@ -23,9 +23,33 @@ from ...comm.mesh import peek_mesh
 
 
 def decompose(t):
-    """bf16/float -> (fp16 mantissa in [0.5, 1), int8 exponent)."""
+    """bf16/float -> (fp16 mantissa in [0.5, 1), int8 exponent).
+
+    Reference-exact: the int8 cast WRAPS for fp32 frexp exponents
+    outside [-128, 127] (subnormals reach -148, values >= 2^127 carry
+    128), like the reference's wire did.  Callers that must reconstruct
+    faithfully from the int8 exponent use decompose_int8_safe."""
     mantissa, exponent = jnp.frexp(t.astype(jnp.float32))
     return mantissa.astype(jnp.float16), exponent.astype(jnp.int8)
+
+
+def decompose_int8_safe(t):
+    """`decompose` with the int8 exponent range made safe for faithful
+    reconstruction (the bucketed split gradient wire,
+    runtime/comm/bucketing.py): fp32 subnormals flush to zero (their
+    exponents would wrap to ~+108 and reconstruct as ~2^108 monsters),
+    and the >= 2^127 tail pushes the mantissa to inf so downstream
+    overflow checks fire instead of receiving a silently shrunk value.
+    Returns (fp16 mantissa, int8-range int32 exponent)."""
+    f32 = t.astype(jnp.float32)
+    f32 = jnp.where(jnp.abs(f32) < jnp.float32(2.0 ** -126),
+                    jnp.float32(0.0), f32)
+    mantissa, exponent = jnp.frexp(f32)
+    mantissa = jnp.where(exponent > 127,
+                         jnp.sign(mantissa) * jnp.float32(jnp.inf),
+                         mantissa)
+    return (mantissa.astype(jnp.float16),
+            jnp.clip(exponent, -127, 127))
 
 
 def reconstruct(mantissa, exponent, original_dtype=jnp.bfloat16):

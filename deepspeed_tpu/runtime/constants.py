@@ -80,6 +80,23 @@ GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
 GRADIENT_PREDIVIDE_FACTOR_DEFAULT = 1.0
 
 #############################################
+# Gradient-reduction wire (TPU-specific addition; see
+# runtime/comm/bucketing.py and docs/tutorials/comm_tuning.md).
+# FP32_ALLREDUCE is the reference key (engine fp32_allreduce option):
+# when true the wire dtype is forced to fp32 regardless of COMM_WIRE_DTYPE.
+#############################################
+COMM = "comm"
+COMM_GRADIENT_REDUCTION = "gradient_reduction"
+COMM_GRADIENT_REDUCTION_DEFAULT = "implicit"  # or "bucketed"
+COMM_GRADIENT_REDUCTION_MODES = ("implicit", "bucketed")
+COMM_WIRE_DTYPE = "wire_dtype"
+COMM_WIRE_DTYPE_DEFAULT = "fp32"  # "fp32" | "bf16" | "split"
+COMM_REDUCE_BUCKET_SIZE = "reduce_bucket_size"  # elements; falls back to
+                                                # zero_optimization's knob
+FP32_ALLREDUCE = "fp32_allreduce"
+FP32_ALLREDUCE_DEFAULT = False
+
+#############################################
 # Precision: fp16 section doubles as the precision section via "type"
 # (EleutherAI fork: PRECISION, runtime/constants.py:127-161)
 #############################################

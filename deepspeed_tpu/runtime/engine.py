@@ -8,9 +8,15 @@ the execution model is TPU-native:
 
 * One jitted `_micro_step` computes loss+grads for a micro batch and folds
   them into a (possibly ZeRO-sharded) fp32 accumulator. Data parallelism is
-  implicit: the batch is sharded over the `data` mesh axis and the loss is a
-  global mean, so XLA inserts the gradient psum (no bucketed allreduce —
-  contrast reference engine.py:1323-1396).
+  implicit by default: the batch is sharded over the `data` mesh axis and
+  the loss is a global mean, so XLA inserts the gradient psum — right on
+  ICI where the per-leaf psums overlap the backward. With
+  `"comm": {"gradient_reduction": "bucketed"}` the same step instead
+  computes LOCAL grads under shard_map and reduces them through the
+  static BucketPlan (runtime/comm/bucketing.py): one fused collective
+  per dtype bucket — the reference's `reduce_bucket_size` machinery
+  (engine.py:1323-1396, zero/stage2.py:614-745), measured 2x+ faster on
+  serialization-bound fabrics (BENCH.md grad-wire round).
 * One jitted `_apply_step` unscales, checks overflow, clips, runs the fused
   optimizer, applies ZeRO sharding constraints, and updates the loss-scale
   state — the skip-on-overflow decision is a branchless select inside the
@@ -33,7 +39,9 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .. import comm
-from ..comm.mesh import DATA_AXIS, MeshInfo
+from ..comm.mesh import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS, SEQ_AXIS,
+                         MeshInfo)
+from ..monitor.counters import COUNTERS
 from ..ops.adam import DeepSpeedCPUAdam, FusedAdam
 from ..ops.lamb import FusedLamb
 from ..utils.logging import log_dist, logger
@@ -45,6 +53,7 @@ from .fp16.loss_scaler import create_loss_scaler
 from .fp16.onebit import OnebitAdam, OnebitLamb
 from .lr_schedules import SCHEDULERS
 from .module import TrainModule
+from .comm.bucketing import BucketPlan
 from .pipe.p2p import batch_shardable
 from .progressive_layer_drop import ProgressiveLayerDrop
 from .utils import ThroughputTimer, clip_grad_norm, has_overflow
@@ -172,6 +181,7 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print() or 50)
+        self.bucket_plan = self._build_bucket_plan()
         self._step_fns = self._build_step_fns()
         self._last_lr = self._current_lr()
 
@@ -251,6 +261,7 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print() or 50)
+        self.bucket_plan = None  # grads stream host-side, never bucketed
         self._step_fns = {}
         self._last_lr = self._current_lr()
         self.timers = SynchronizedWallClockTimer()
@@ -460,6 +471,62 @@ class DeepSpeedEngine:
     # jitted step programs
     # ------------------------------------------------------------------
 
+    def _build_bucket_plan(self):
+        """Static bucketed-wire plan (runtime/comm/bucketing.py) for the
+        dense DP path, or None when XLA's implicit psum stays in charge.
+        Computed ONCE here — the jitted steps consume precomputed leaf
+        offsets, never a per-step tree walk."""
+        cc = getattr(self._config, "comm_config", None)
+        if cc is None or cc.gradient_reduction != "bucketed":
+            return None
+        dp = self.mesh_info.axis_size(DATA_AXIS)
+        blockers = []
+        if dp <= 1:
+            blockers.append("dp==1 (nothing to reduce)")
+        for ax in (MODEL_AXIS, PIPE_AXIS, SEQ_AXIS):
+            if self.mesh_info.axis_size(ax) > 1:
+                blockers.append(f"{ax} axis > 1 (mixed-axis meshes stay on "
+                                "the implicit wire)")
+        if self._offload is not None:
+            blockers.append("ZeRO-Offload (the step runs host-side)")
+        if self._config.zero_optimization_stage >= 3:
+            blockers.append("ZeRO-3 (gathering the full param tree at the "
+                            "shard_map boundary would defeat param sharding)")
+        if getattr(self.optimizer, "handles_dp_reduction", False) and \
+                self._use_onebit_comm():
+            # only when the compressed hot path actually engages — a
+            # 1-bit optimizer falling back to dense DP reduction (gas>1,
+            # ZeRO, offload) benefits from bucketing like plain Adam
+            blockers.append("1-bit optimizer owns the compressed wire")
+        if blockers:
+            log_dist("bucketed gradient wire requested but unavailable — "
+                     "falling back to implicit XLA reduction: "
+                     + "; ".join(blockers), ranks=[0])
+            return None
+        scatter = (self._config.zero_optimization_stage >= 2
+                   and bool(self._config.zero_config.reduce_scatter))
+        if scatter and cc.wire_dtype == "split":
+            log_dist("split wire is gather-structured; ZeRO>=2 bucket "
+                     "reduction stays allreduce-lowered", ranks=[0])
+        plan = BucketPlan(self._params, dp_size=dp,
+                          bucket_elems=cc.reduce_bucket_size,
+                          wire=cc.wire_dtype, scatter=scatter)
+        log_dist(plan.describe(), ranks=[0])
+        return plan
+
+    def _account_grad_wire(self, events: int = 1):
+        """Per-dispatch wire-byte accounting for the bucketed path: the
+        plan's predicted payload, recorded as the step executes (unlike
+        the traced-occurrence `bucket.*`/`dist.*` counters).  The
+        monitor's per-step counter deltas pick this up unchanged, and
+        tests/test_grad_bucketing.py pins it against the plan exactly."""
+        plan = self.bucket_plan
+        if plan is None or self._capture_layers is not None:
+            return
+        COUNTERS.add("grad_wire.reduce",
+                     plan.wire_bytes_per_reduction * events,
+                     calls=plan.collectives_per_reduction * events)
+
     def _build_step_fns(self):
         model = self.module
         compute_dtype = self.compute_dtype
@@ -498,12 +565,54 @@ class DeepSpeedEngine:
             scale_factor = loss_scale / (predivide if prescale else 1.0)
             return loss.astype(jnp.float32) * scale_factor, (loss, caps)
 
-        def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
-            cparams = cast(params, compute_dtype)
+        # -- gradient production: implicit XLA psum vs the bucketed wire
+        wire_plan = self.bucket_plan if capture is None else None
+        if self.bucket_plan is not None and wire_plan is None:
+            log_dist("layer-output capture active: this step program rides "
+                     "the implicit gradient wire (captures are threaded "
+                     "through the global-loss trace)", ranks=[0])
+
+        def implicit_grads(cparams, batch, rng, pld_theta, loss_scale):
+            """Global-mean loss: XLA inserts one psum per grad leaf."""
             grads, (loss, caps) = jax.grad(
                 lambda p: run_loss(p, batch, rng, pld_theta, loss_scale),
                 has_aux=True)(cparams)
-            grads = cast(grads, jnp.float32)
+            return cast(grads, jnp.float32), loss, caps
+
+        if wire_plan is None:
+            compute_grads = implicit_grads
+        else:
+            mesh = self.mesh_info.mesh
+            P = PartitionSpec
+
+            def _local_step(cp, b, r, ls, th):
+                # per-shard rng decorrelation: the implicit wire draws ONE
+                # global dropout mask; each shard must not repeat it
+                r = jax.random.fold_in(r, jax.lax.axis_index(DATA_AXIS))
+                grads, (loss, _) = jax.grad(
+                    lambda p: run_loss(p, b, r, th, ls), has_aux=True)(cp)
+                buckets = wire_plan.flatten(cast(grads, jnp.float32))
+                buckets = wire_plan.reduce(buckets)
+                return buckets, jax.lax.pmean(loss, DATA_AXIS)
+
+            smapped = jax.shard_map(
+                _local_step, mesh=mesh,
+                in_specs=(P(), P(DATA_AXIS), P(), P(), P()),
+                out_specs=(wire_plan.bucket_out_specs(), P()),
+                axis_names={DATA_AXIS}, check_vma=False)
+
+            def compute_grads(cparams, batch, rng, pld_theta, loss_scale):
+                """LOCAL grads under shard_map, mean-reduced through the
+                BucketPlan: one fused collective per bucket (psum_scatter
+                under ZeRO>=2) instead of one psum per leaf."""
+                buckets, loss = smapped(cparams, batch, rng, loss_scale,
+                                        pld_theta)
+                return wire_plan.unflatten(buckets), loss, {}
+
+        def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
+            cparams = cast(params, compute_dtype)
+            grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
+                                              loss_scale)
             new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
             new_acc = plan.constrain_grads(new_acc)
             return loss, new_acc, {"layer_outputs": caps}
@@ -556,10 +665,8 @@ class DeepSpeedEngine:
             overlap the optimizer with the tail of the backward."""
             loss_scale = scaler_state["cur_scale"]
             cparams = cast(params, compute_dtype)
-            grads, (loss, caps) = jax.grad(
-                lambda p: run_loss(p, batch, rng, pld_theta, loss_scale),
-                has_aux=True)(cparams)
-            grads = cast(grads, jnp.float32)
+            grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
+                                              loss_scale)
             grads = plan.constrain_grads(grads)
             overflow = has_overflow(grads)
             denom = loss_scale
@@ -615,12 +722,9 @@ class DeepSpeedEngine:
             def body(carry, inp):
                 acc, _ = carry
                 batch_i, rng_i = inp
-                grads, (loss, caps) = jax.grad(
-                    lambda p: run_loss(p, batch_i, rng_i, pld_theta,
-                                       loss_scale),
-                    has_aux=True)(cparams)
-                acc = jax.tree_util.tree_map(
-                    lambda a, g: a + g.astype(jnp.float32), acc, grads)
+                grads, loss, caps = compute_grads(cparams, batch_i, rng_i,
+                                                  pld_theta, loss_scale)
+                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
                 return (plan.constrain_grads(acc), caps), loss
 
             acc0 = jax.tree_util.tree_map(
@@ -852,6 +956,7 @@ class DeepSpeedEngine:
         loss, self._grad_acc, extras = self._step_fns["micro"](
             self._params, self._grad_acc, batch, rng,
             self._scaler_state["cur_scale"], theta)
+        self._account_grad_wire()
         self._consume_extras(extras)
         if self._wall_clock_breakdown:
             # one fused fwd+bwd program: this IS forward+backward time
@@ -917,6 +1022,7 @@ class DeepSpeedEngine:
          overflow, grad_norm, extras) = self._step_fns["full"](
             self._params, self._opt_state, self._scaler_state, batch, rng,
             lr, theta)
+        self._account_grad_wire()
         self._consume_extras(extras)
         if self._wall_clock_breakdown:
             # the fused program IS forward+backward+step
@@ -1307,6 +1413,7 @@ class DeepSpeedEngine:
          grad_norm, extras) = self._step_fns["full_scan"](
             self._params, self._opt_state, self._scaler_state, stacked,
             rngs, lr, theta)
+        self._account_grad_wire(events=gas)
         if sp is not None:
             sp.close(sync=loss if rm.sync_timing else None)
         self._consume_extras(extras)
@@ -1410,9 +1517,38 @@ class DeepSpeedEngine:
         self._grad_acc = None
 
     def allreduce_gradients(self, bucket_size=None):
-        """API parity (reference engine.py:1023-1038): DP gradient
-        reduction is fused into the jitted step (XLA psum at the loss-mean
-        boundary), so an explicit allreduce pass does not exist."""
+        """reference engine.py:1023-1038.  DP gradient reduction runs
+        INSIDE the jitted step here — through the BucketPlan's fused
+        collectives when `comm.gradient_reduction=="bucketed"`, else
+        XLA's implicit psum — so by the time this can be called the
+        gradients are already reduced and there is no separate pass to
+        run.  What the call CAN do:
+
+        * `bucket_size` (elements, the reference's meaning) retunes the
+          BucketPlan and recompiles the step programs when the bucketed
+          wire is active — the reference's dynamic-bucket knob.
+        * On paths where globally-reduced gradients never exist (the
+          1-bit compressed wire, ZeRO-Infinity streaming) it raises
+          instead of silently lying about having reduced anything."""
+        if self._infinity is not None or getattr(self, "_onebit_hot", False):
+            raise RuntimeError(
+                "allreduce_gradients: globally-reduced gradients never "
+                "materialize on this path (ZeRO-Infinity streams per-block "
+                "grads; the 1-bit optimizer owns the compressed wire) — "
+                "there is nothing to reduce")
+        if bucket_size is not None and self.bucket_plan is not None and \
+                int(bucket_size) != self.bucket_plan.bucket_elems:
+            self._config.comm_config.reduce_bucket_size = int(bucket_size)
+            self.bucket_plan = self._build_bucket_plan()
+            self._step_fns = self._build_step_fns()
+            log_dist("allreduce_gradients: rebucketed -> "
+                     + self.bucket_plan.describe(), ranks=[0])
+        elif not getattr(self, "_warned_allreduce_noop", False):
+            self._warned_allreduce_noop = True
+            log_dist("allreduce_gradients: reduction already runs in-jit ("
+                     + (self.bucket_plan.describe() if self.bucket_plan
+                        else "implicit XLA psum at the loss-mean boundary")
+                     + "); nothing to do", ranks=[0])
 
     def get_mom(self):
         """First-moment decay (beta1) per param group (reference :525)."""
@@ -1479,8 +1615,14 @@ class DeepSpeedEngine:
         return not self._config.prescale_gradients
 
     def allreduce_always_fp32(self):
-        """Always true here: gradients are cast to fp32 before the fused
-        psum/reduce-scatter (reference fp32_allreduce option)."""
+        """reference fp32_allreduce option.  The implicit wire always
+        accumulates in fp32 (grads are cast before the psum); the
+        bucketed wire reports its configured dtype — bf16/split wires
+        trade accumulation width for bytes (comm_tuning.md).  Active
+        layer-output capture forces the step programs back onto the
+        implicit fp32 wire (_build_step_fns), so report THAT."""
+        if self.bucket_plan is not None and self._capture_layers is None:
+            return self.bucket_plan.wire == "fp32"
         return True
 
     def memory_breakdown(self):

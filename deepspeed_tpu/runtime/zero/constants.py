@@ -16,8 +16,13 @@ MAX_STAGE_ZERO_OPTIMIZATION = ZERO_OPTIMIZATION_WEIGHTS
 ZERO_OPTIMIZATION_STAGE = "stage"
 ZERO_OPTIMIZATION_STAGE_DEFAULT = ZERO_OPTIMIZATION_DISABLED
 
-# Accepted-for-parity knobs. On TPU, XLA handles bucketing/overlap; these
-# are recorded but change nothing (reference zero/constants.py).
+# Bucket/overlap knobs (reference zero/constants.py). overlap_comm /
+# contiguous_gradients stay accepted-for-parity (XLA latency-hides and
+# lays out buffers itself); reduce_bucket_size and reduce_scatter are
+# HONORED since the bucketed gradient wire landed: with
+# "comm": {"gradient_reduction": "bucketed"} the BucketPlan
+# (runtime/comm/bucketing.py) caps fused buckets at reduce_bucket_size
+# elements, and reduce_scatter selects the ZeRO>=2 psum_scatter lowering.
 ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS = "allgather_partitions"
 ZERO_OPTIMIZATION_ALLGATHER_PARTITIONS_DEFAULT = True
 ZERO_OPTIMIZATION_REDUCE_SCATTER = "reduce_scatter"

@@ -9,7 +9,11 @@ sharding annotations and XLA inserts the collectives:
            (reference stage1.py:328-465 sub-partitions -> NamedSharding)
   stage 2  + gradients reduce-scattered to their owner shard
            (reference stage2.py:614-745 bucket machinery ->
-            with_sharding_constraint on grads = psum_scatter)
+            with_sharding_constraint on grads = psum_scatter; with
+            "comm": {"gradient_reduction": "bucketed"} the scatter runs
+            explicitly over the BucketPlan's fused flat buckets instead —
+            runtime/comm/bucketing.py — and these grad specs describe the
+            per-leaf layout the scattered buckets unflatten into)
   stage 3  + parameters sharded; XLA all-gathers on use and discards after
            (reference stage3.py fetch/release hooks + PrefetchCoordinator ->
             XLA scheduling)

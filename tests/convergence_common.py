@@ -43,7 +43,10 @@ def synthetic_batches(steps, micro, seq, vocab, seed):
         yield toks[:, :-1], toks[:, 1:]
 
 
-def run_curve(config=CONFIG):
+def run_curve(config=CONFIG, extra_engine_config=None):
+    """extra_engine_config: dict merged into the engine config_params —
+    lets variant curves (e.g. the bucketed gradient wire) run the SAME
+    canonical recipe and be pinned against the same baseline."""
     import jax
 
     import deepspeed_tpu
@@ -53,7 +56,7 @@ def run_curve(config=CONFIG):
     os.environ["DSTPU_SEED"] = str(config["seed"])
     try:
         return _run_curve_inner(config, jax, deepspeed_tpu, GPT,
-                                gpt2_config)
+                                gpt2_config, extra_engine_config)
     finally:  # never leak the seed into other tests' engine inits
         if prev_seed is None:
             os.environ.pop("DSTPU_SEED", None)
@@ -61,21 +64,23 @@ def run_curve(config=CONFIG):
             os.environ["DSTPU_SEED"] = prev_seed
 
 
-def _run_curve_inner(config, jax, deepspeed_tpu, GPT, gpt2_config):
+def _run_curve_inner(config, jax, deepspeed_tpu, GPT, gpt2_config,
+                     extra_engine_config=None):
     n_dev = jax.device_count()
     cfg = gpt2_config("nano", max_seq_len=config["seq"],
                       vocab_size=config["vocab"],
                       shard_activations=False)
+    config_params = {
+        "train_batch_size": config["micro"] * n_dev,
+        "train_micro_batch_size_per_gpu": config["micro"],
+        "optimizer": {"type": "Adam", "params": {"lr": config["lr"]}},
+        "zero_optimization": {"stage": 2},
+        "mesh": {"data": n_dev},
+        "steps_per_print": 0,
+    }
+    config_params.update(extra_engine_config or {})
     engine, *_ = deepspeed_tpu.initialize(
-        model=GPT(cfg),
-        config_params={
-            "train_batch_size": config["micro"] * n_dev,
-            "train_micro_batch_size_per_gpu": config["micro"],
-            "optimizer": {"type": "Adam", "params": {"lr": config["lr"]}},
-            "zero_optimization": {"stage": 2},
-            "mesh": {"data": n_dev},
-            "steps_per_print": 0,
-        })
+        model=GPT(cfg), config_params=config_params)
     losses = []
     rng = jax.random.PRNGKey(config["seed"])
     import jax.numpy as jnp  # noqa: F401

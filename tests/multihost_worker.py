@@ -19,16 +19,19 @@ def main():
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nprocs, process_id=proc_id)
-    assert jax.process_count() == nprocs
-    assert jax.device_count() == 4 * nprocs
 
     import numpy as np
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     sys.path.insert(0, os.path.join(os.path.dirname(
         os.path.abspath(__file__)), ".."))
+    # import BEFORE any jax.device_count()/process_count(): the _compat
+    # gloo-collectives flag must be set before the CPU client exists
     import deepspeed_tpu
     from simple_model import SimpleModel
+
+    assert jax.process_count() == nprocs
+    assert jax.device_count() == 4 * nprocs
 
     engine, *_ = deepspeed_tpu.initialize(
         model=SimpleModel(hidden_dim=64),

@@ -27,3 +27,22 @@ def test_gpt2_nano_pinned_loss_curve():
     np.testing.assert_allclose(losses, ref, rtol=0.05, atol=0.02)
     # and the curve must actually converge
     assert losses[-1] < 0.5 * losses[0]
+
+
+@pytest.mark.slow
+def test_gpt2_nano_bucketed_zero2_matches_pinned_curve():
+    """The bucketed gradient wire (fused reduce-scatter buckets,
+    runtime/comm/bucketing.py) must train the canonical ZeRO-2 recipe to
+    the SAME curve as the unbucketed seed baseline — only the collective
+    layout changes, not the math."""
+    assert os.path.isfile(BASELINE_PATH), \
+        "missing pinned baseline; run tools/record_convergence.py"
+    with open(BASELINE_PATH) as f:
+        baseline = json.load(f)
+    losses = run_curve(extra_engine_config={
+        "comm": {"gradient_reduction": "bucketed",
+                 "reduce_bucket_size": 50_000}})
+    ref = baseline["losses"]
+    assert len(losses) == len(ref)
+    np.testing.assert_allclose(losses, ref, rtol=0.05, atol=0.02)
+    assert losses[-1] < 0.5 * losses[0]

@@ -1,0 +1,230 @@
+"""Gradient-wire bench: unfused implicit psum vs the bucketed wire.
+
+Measures the dense data-parallel engine step through every wire the
+engine offers (runtime/comm/bucketing.py):
+
+  unfused        implicit XLA psum at the loss-mean boundary — one
+                 collective per grad leaf (~40 for gpt2-nano)
+  bucketed       BucketPlan fp32 allreduce — one fused collective per
+                 dtype bucket
+  bucketed_bf16  same buckets, bf16 on the wire (half the bytes)
+  bucketed_split same buckets, the EleutherAI 24-bit frexp wire
+                 (fp16 mantissa + int8 exponent all-gathers)
+  zero2 / zero2_bucketed   the ZeRO-2 lane: implicit vs the bucketed
+                 reduce-scatter lowering
+
+Two fabrics, following tools/onebit_bench_mp.py:
+
+  --nproc 1  (default) single-process CPU mesh — collectives are memory
+             movement; shows the bucketing overhead floor.
+  --nproc N  N jax.distributed processes on localhost (gloo/TCP): every
+             cross-process payload pays a real byte-proportional
+             serialize/send cost — the fabric where round-5 measured the
+             dense step at 270 ms vs 53 ms for the fused onebit wire.
+
+Results are recorded through monitor/artifacts.py into
+bench_artifacts/runs/ + manifest (the PR-2 durable-artifact rule).
+
+Usage: python tools/grad_wire_bench.py [--nproc 2] [--steps 20]
+           [--size nano] [--seq 32]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+VARIANTS = [
+    ("unfused", 0, None),
+    ("bucketed", 0, {"gradient_reduction": "bucketed"}),
+    ("bucketed_bf16", 0, {"gradient_reduction": "bucketed",
+                          "wire_dtype": "bf16"}),
+    ("bucketed_split", 0, {"gradient_reduction": "bucketed",
+                           "wire_dtype": "split"}),
+    ("zero2", 2, None),
+    ("zero2_bucketed", 2, {"gradient_reduction": "bucketed"}),
+]
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def bench(args, nproc: int, proc_id: int):
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+    from deepspeed_tpu.monitor.counters import COUNTERS
+
+    dp = jax.device_count()
+    model_cfg = gpt2_config(args.size, vocab_size=512,
+                            max_seq_len=args.seq, dropout=0.0,
+                            embed_dropout=0.0)
+    n_params = GPT(model_cfg).num_params()
+    rng = np.random.RandomState(0)  # identical stream on every process
+    tok = rng.randint(0, 512, (dp, args.seq + 1)).astype(np.int32)
+    batch = (tok[:, :-1], tok[:, 1:])
+
+    results = {}
+    for name, stage, comm in VARIANTS:
+        cfg = {
+            "train_batch_size": dp,
+            "zero_optimization": {"stage": stage},
+            "mesh": {"data": dp},
+            "steps_per_print": 0,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-4, "weight_decay": 0.0}},
+        }
+        if comm is not None:
+            cfg["comm"] = comm
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT(model_cfg), dist_init_required=False,
+            config_params=cfg)
+        if comm is not None:
+            assert engine.bucket_plan is not None, \
+                f"{name}: bucketed wire did not engage"
+        for _ in range(5):  # compile + warm
+            engine.forward(batch)
+            engine.backward()
+            engine.step()
+        snap = COUNTERS.snapshot()
+        t = []
+        for _ in range(args.steps):
+            t0 = time.perf_counter()
+            loss = engine.forward(batch)
+            engine.backward()
+            engine.step()
+            loss.block_until_ready()
+            t.append(time.perf_counter() - t0)
+        entry = {"step_ms": round(float(np.median(t)) * 1e3, 2),
+                 "loss": round(float(loss), 4)}
+        if engine.bucket_plan is not None:
+            plan = engine.bucket_plan
+            wire = COUNTERS.delta_since(snap).get("grad_wire.reduce", {})
+            entry.update({
+                "n_buckets": plan.n_buckets,
+                "wire": plan.wire,
+                "lowering": ("reduce-scatter" if plan.scatter
+                             else "allreduce"),
+                "wire_bytes_per_step": plan.wire_bytes_per_reduction,
+                "collectives_per_step": plan.collectives_per_reduction,
+                "counted_wire_bytes": int(wire.get("bytes", 0)),
+            })
+        results[name] = entry
+
+    if proc_id == 0:
+        base = results["unfused"]["step_ms"]
+        for name in results:
+            results[name]["vs_unfused"] = round(
+                base / max(results[name]["step_ms"], 1e-9), 2)
+        print(json.dumps({
+            "metric": ("grad_wire_2proc_tcp" if nproc > 1
+                       else "grad_wire_cpu_mesh"),
+            "platform": "cpu",
+            "n_params": int(n_params),
+            "world": {"processes": nproc, "devices": dp},
+            "steps": args.steps,
+            "value": results["bucketed"]["vs_unfused"],
+            "unit": "x_vs_unfused_dense",
+            **results,
+        }), flush=True)
+
+
+def worker(args):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coord,
+                               num_processes=args.nproc,
+                               process_id=args.proc_id)
+    import deepspeed_tpu  # noqa: F401  (installs the gloo-collectives
+    #                       flag BEFORE the CPU client exists)
+
+    bench(args, args.nproc, args.proc_id)
+
+
+def single_process(args):
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    bench(args, 1, 0)
+
+
+def _record(out: str):
+    """Durable artifact under bench_artifacts/runs/ (PR-2 rule)."""
+    try:
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("{") and "metric" in ln)
+        result = json.loads(line)
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        path = record_bench_result(result)
+        print(f"recorded: {path}", file=sys.stderr)
+    except Exception as e:  # bench output stays usable without the record
+        print(f"artifact recording failed: {e}", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--size", default="nano")
+    ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
+    ap.add_argument("--coord", default="")
+    args = ap.parse_args()
+    if args.worker:
+        worker(args)
+        return
+    if args.nproc <= 1:
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            single_process(args)
+        out = buf.getvalue()
+        sys.stdout.write(out)
+        _record(out)
+        return
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(args.nproc):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--proc-id", str(pid), "--coord", coord,
+             "--nproc", str(args.nproc), "--steps", str(args.steps),
+             "--size", args.size, "--seq", str(args.seq)],
+            stdout=subprocess.PIPE if pid == 0 else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if pid == 0 else subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+    out, _ = procs[0].communicate(timeout=3600)
+    for p in procs[1:]:
+        p.wait(timeout=60)
+    out = out.decode()
+    sys.stdout.write(out)
+    if any(p.returncode for p in procs):
+        sys.exit(1)
+    _record(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,12 +75,18 @@ def worker(args):
             params["freeze_step"] = 8
             params["wire"] = wire
         cfg = dict(cfg_base)
+        if wire == "bucketed":
+            # dense Adam through the fused grad-wire buckets
+            # (runtime/comm/bucketing.py) instead of per-leaf psums
+            cfg["comm"] = {"gradient_reduction": "bucketed"}
         cfg["optimizer"] = {"type": opt, "params": params}
         engine, *_ = deepspeed_tpu.initialize(
             model=GPT(model_cfg), dist_init_required=False,
             config_params=cfg)
         if opt == "OneBitAdam":
             assert getattr(engine, "_onebit_hot", False)
+        if wire == "bucketed":
+            assert engine.bucket_plan is not None
         for _ in range(12):  # compile + freeze_step crossing
             engine.forward(batch); engine.backward(); engine.step()
         t = []
@@ -93,8 +99,8 @@ def worker(args):
         return float(np.median(t)), float(loss)
 
     results = {}
-    for opt, wire in [("Adam", "dense"), ("OneBitAdam", "sign"),
-                      ("OneBitAdam", "int8")]:
+    for opt, wire in [("Adam", "dense"), ("Adam", "bucketed"),
+                      ("OneBitAdam", "sign"), ("OneBitAdam", "int8")]:
         sec, loss = run(opt, wire)
         results[wire] = {"step_ms": round(sec * 1e3, 2),
                          "loss": round(loss, 4)}
@@ -132,6 +138,7 @@ def worker(args):
     if args.proc_id == 0:
         print(json.dumps({
             "metric": "onebit_wire_2proc_tcp",
+            "platform": "cpu",
             "n_params": int(n_params),
             "world": {"processes": args.nproc, "devices": dp},
             **results,
@@ -165,9 +172,21 @@ def main():
     out, _ = procs[0].communicate(timeout=3600)
     for p in procs[1:]:
         p.wait(timeout=60)
-    sys.stdout.write(out.decode())
+    out = out.decode()
+    sys.stdout.write(out)
     if any(p.returncode for p in procs):
         sys.exit(1)
+    # durable artifact under bench_artifacts/runs/ + manifest (the PR-2
+    # rule bench.py follows); the printed JSON stays the primary output
+    try:
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("{") and "metric" in ln)
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        path = record_bench_result(json.loads(line))
+        print(f"recorded: {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"artifact recording failed: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":
