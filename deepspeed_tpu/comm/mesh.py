@@ -20,6 +20,17 @@ DCN (across slices). Process groups in the reference map to mesh axes here:
 Axis order is chosen for ICI locality: "model" is innermost (adjacent
 devices — per-layer collectives ride single-hop ICI), then "seq", then
 "data"; "pipe" is outermost (only nearest-neighbor p2p traffic).
+
+Hierarchical data axis (ZeRO++ / hpZ-style two-level reduction): when a
+pod slice spans DCN (or processes talk over TCP), the `data` axis can be
+factored into `("data_outer", "data_inner")` sub-axes — ICI-adjacent
+ranks inner, cross-slice/cross-process outer — so the gradient wire can
+reduce-scatter on the fast fabric, run the slow-fabric collective on the
+1/inner shard only, and gather back on the fast fabric
+(runtime/comm/bucketing.py).  Every consumer that thinks in terms of
+"the data axis" goes through `MeshInfo.data_spec` / `data_axes`, which
+collapse to plain `"data"` on a flat mesh — `data_outer == 1` is
+EXACTLY today's layout.
 """
 
 from __future__ import annotations
@@ -27,7 +38,7 @@ from __future__ import annotations
 import contextlib
 import math
 from dataclasses import dataclass, field
-from typing import Dict, Optional, Sequence
+from typing import Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -43,7 +54,15 @@ SEQ_AXIS = "seq"
 MODEL_AXIS = "model"
 EXPERT_AXIS = "expert"
 
+# Hierarchical factorization of the data axis (flat meshes never carry
+# these names; `MeshInfo.data_axes` is the portable way to address "the
+# data axis" on either layout).
+DATA_OUTER_AXIS = "data_outer"  # slow fabric: cross-slice / cross-process
+DATA_INNER_AXIS = "data_inner"  # fast fabric: ICI-adjacent ranks
+
 AXIS_ORDER = (PIPE_AXIS, DATA_AXIS, SEQ_AXIS, MODEL_AXIS)
+HIER_AXIS_ORDER = (PIPE_AXIS, DATA_OUTER_AXIS, DATA_INNER_AXIS, SEQ_AXIS,
+                   MODEL_AXIS)
 
 _CURRENT_MESH: Optional["MeshInfo"] = None
 
@@ -59,13 +78,54 @@ class MeshInfo:
 
     mesh: Mesh
     axis_sizes: Dict[str, int] = field(default_factory=dict)
+    # (outer, inner) factorization of the data axis; None on flat meshes.
+    # axis_sizes always keeps the LOGICAL "data" size (the product), so
+    # every existing axis_size(DATA_AXIS) caller is layout-agnostic.
+    data_hierarchy: Optional[Tuple[int, int]] = None
 
     @property
     def size(self) -> int:
         return int(np.prod([max(1, s) for s in self.axis_sizes.values()]))
 
     def axis_size(self, axis: str) -> int:
+        if self.data_hierarchy is not None:
+            if axis == DATA_OUTER_AXIS:
+                return self.data_hierarchy[0]
+            if axis == DATA_INNER_AXIS:
+                return self.data_hierarchy[1]
         return self.axis_sizes.get(axis, 1)
+
+    # -- hierarchical-data-axis surface -------------------------------
+
+    @property
+    def hierarchical(self) -> bool:
+        return self.data_hierarchy is not None
+
+    @property
+    def data_axes(self) -> Tuple[str, ...]:
+        """Mesh axis names the data dimension actually lives on,
+        outermost first — `("data",)` flat, `("data_outer",
+        "data_inner")` hierarchical.  Collectives over the whole dp
+        group take this tuple (lax.psum/pmean accept it)."""
+        if self.data_hierarchy is not None:
+            return (DATA_OUTER_AXIS, DATA_INNER_AXIS)
+        return (DATA_AXIS,)
+
+    @property
+    def data_spec(self):
+        """The PartitionSpec entry for "sharded over the data axis":
+        the plain axis name flat, the sub-axis tuple hierarchical."""
+        return DATA_AXIS if self.data_hierarchy is None else \
+            (DATA_OUTER_AXIS, DATA_INNER_AXIS)
+
+    @property
+    def data_outer_size(self) -> int:
+        return self.data_hierarchy[0] if self.data_hierarchy else 1
+
+    @property
+    def data_inner_size(self) -> int:
+        return (self.data_hierarchy[1] if self.data_hierarchy
+                else self.axis_size(DATA_AXIS))
 
     # Reference-parity aliases (pipe/topology.py get_*_parallel_world_size)
     def get_data_parallel_world_size(self) -> int:
@@ -110,11 +170,49 @@ def _resolve_sizes(n_devices: int, sizes: Dict[str, int]) -> Dict[str, int]:
     return resolved
 
 
+def derive_data_outer(dp_size: int) -> int:
+    """Topology-derived outer factor for a hierarchical data axis: one
+    outer group per jax process (the fast/slow fabric boundary — devices
+    within a process share an address space / ICI, processes talk over
+    DCN/TCP).  Returns 1 (flat) whenever a two-level wire cannot win:
+    single process, dp not divisible by the process count, one device
+    per process (inner groups of 1 reduce nothing on the fast fabric),
+    or HETEROGENEOUS local device counts — make_mesh's contiguous
+    reshape would then put a process boundary INSIDE an inner group,
+    silently routing "fast-fabric" collectives over the slow link."""
+    try:
+        procs = jax.process_count()
+    except Exception:
+        procs = 1
+    if procs <= 1 or dp_size % procs != 0 or dp_size // procs <= 1:
+        return 1
+    inner = dp_size // procs
+    try:
+        devs = jax.devices()
+    except Exception:
+        return 1
+    if len(devs) == dp_size:
+        # pure-DP (the only shape the hierarchy engages on): every
+        # contiguous inner-sized run must sit inside ONE process
+        for g in range(procs):
+            owners = {getattr(d, "process_index", 0)
+                      for d in devs[g * inner:(g + 1) * inner]}
+            if len(owners) != 1:
+                logger.warning(
+                    f"comm.hierarchy auto: inner groups of {inner} do not "
+                    f"align with process boundaries (processes contribute "
+                    f"unequal local device counts) — keeping the flat "
+                    f"data axis")
+                return 1
+    return procs
+
+
 def make_mesh(
     data: int = -1,
     model: int = 1,
     pipe: int = 1,
     seq: int = 1,
+    data_outer: int = 1,
     devices: Optional[Sequence] = None,
     set_current: bool = True,
 ) -> MeshInfo:
@@ -122,11 +220,52 @@ def make_mesh(
 
     Replaces reference `init_distributed` + mpu/topology plumbing
     (utils/distributed.py, pipe/topology.py) with one mesh.
+
+    data_outer > 1 factors the data axis into ("data_outer",
+    "data_inner") sub-axes for the hierarchical gradient wire: outer
+    groups are contiguous runs of `jax.devices()` order (process-major,
+    so with data_outer == process_count each process IS one inner
+    group).  data_outer == 1 is exactly the flat layout.
     """
     devices = list(devices) if devices is not None else list(jax.devices())
     sizes = _resolve_sizes(len(devices), {
         DATA_AXIS: data, MODEL_AXIS: model, PIPE_AXIS: pipe, SEQ_AXIS: seq,
     })
+    data_outer = int(data_outer)
+    hierarchy = None
+    if data_outer > 1:
+        dp = sizes[DATA_AXIS]
+        if dp % data_outer != 0:
+            raise ValueError(
+                f"data axis hierarchy: data_outer={data_outer} does not "
+                f"divide the data-parallel size {dp} "
+                f"(data_inner would be {dp / data_outer:g})")
+        inner = dp // data_outer
+        if inner == 1:
+            # outer == dp: every "inner group" is one rank — nothing to
+            # reduce on the fast fabric; flatten back to today's layout
+            logger.debug(
+                f"data hierarchy ({data_outer}, 1) is degenerate; "
+                "using the flat data axis")
+        else:
+            hierarchy = (data_outer, inner)
+    if hierarchy is not None:
+        shape = (sizes[PIPE_AXIS], hierarchy[0], hierarchy[1],
+                 sizes[SEQ_AXIS], sizes[MODEL_AXIS])
+        # plain reshape, NOT mesh_utils: outer groups must stay
+        # contiguous in jax.devices() order (process-major), which is
+        # the fast/slow fabric boundary the hierarchy exists for —
+        # a topology-optimizing permutation would scramble it
+        dev_array = np.asarray(devices).reshape(shape)
+        mesh = Mesh(dev_array, HIER_AXIS_ORDER)
+        info = MeshInfo(mesh=mesh, axis_sizes=sizes,
+                        data_hierarchy=hierarchy)
+        if set_current:
+            set_current_mesh(info)
+        logger.debug(f"hierarchical mesh constructed: {sizes} with "
+                     f"data=(outer {hierarchy[0]} x inner {hierarchy[1]}) "
+                     f"over {len(devices)} devices")
+        return info
     shape = tuple(sizes[a] for a in AXIS_ORDER)
     try:
         from jax.experimental import mesh_utils

@@ -226,6 +226,14 @@ def _dropout(x, rate, rng, train):
 def _constrain(x, cfg: GPTConfig, spec):
     if not cfg.shard_activations:
         return x
+    from ..comm.mesh import peek_mesh
+
+    info = peek_mesh()
+    if info is not None and info.hierarchical:
+        # the literal "data" axis does not exist on a hierarchical mesh
+        # (comm.hierarchy factors it into data_outer/data_inner): expand
+        # it so the constraint binds instead of being swallowed below
+        spec = P(*[info.data_spec if s == DATA_AXIS else s for s in spec])
     try:
         return jax.lax.with_sharding_constraint(x, spec)
     except (ValueError, RuntimeError):

@@ -21,9 +21,13 @@ Instrumented sites:
   prefix `dist.` marks that distinction.
 * `runtime/comm/hostwire.py` — KV-wire payload bytes per allgather.
 * `runtime/comm/bucketing.py` — `bucket.*` per-bucket collective payloads
-  (traced occurrences, like `dist.*`); the engine additionally records
-  per-dispatch `grad_wire.reduce` totals from the BucketPlan's static
-  accounting, which tests pin against the plan exactly.
+  (traced occurrences, like `dist.*`; hierarchical plans tag them
+  `bucket.intra.*` / `bucket.inter.*` per level); the engine
+  additionally records per-dispatch `grad_wire.reduce` totals from the
+  BucketPlan's static accounting, which tests pin against the plan
+  exactly — plus, for hierarchical plans, the per-fabric split
+  `grad_wire.intra` (fast-fabric scatter/gather legs) and
+  `grad_wire.inter` (the slow-fabric hop on the 1/inner-size shard).
 """
 
 from __future__ import annotations

@@ -177,6 +177,30 @@ def render_markdown(run: Dict[str, Any]) -> str:
                          f"{_fmt_bytes(d['bytes'])} |")
         lines.append("")
 
+    # hierarchical gradient wire: the per-level (fast/slow fabric) byte
+    # split the two-level plan exists to produce — surfaced as its own
+    # section so the slow-fabric saving is legible without arithmetic
+    intra = any_comm.get("grad_wire.intra")
+    inter = any_comm.get("grad_wire.inter")
+    if intra or inter:
+        lines.append("## Gradient wire levels (hierarchical reduction)")
+        lines.append("")
+        lines.append("| level | fabric | collectives | bytes |")
+        lines.append("|---|---|---|---|")
+        if intra:
+            lines.append(f"| intra-group | fast (ICI/intra-process) | "
+                         f"{intra['calls']:,} | "
+                         f"{_fmt_bytes(intra['bytes'])} |")
+        if inter:
+            lines.append(f"| inter-group | slow (DCN/TCP) | "
+                         f"{inter['calls']:,} | "
+                         f"{_fmt_bytes(inter['bytes'])} |")
+        if intra and inter and inter["bytes"]:
+            lines.append("")
+            lines.append(f"slow-fabric share of grad-wire traffic: "
+                         f"{100.0 * inter['bytes'] / (intra['bytes'] + inter['bytes']):.1f}%")
+        lines.append("")
+
     pipe = next((s["pipe"] for s in summaries.values() if s["pipe"]), None)
     if pipe and pipe.get("occupancy"):
         lines.append("## Pipeline occupancy (schedule ticks)")

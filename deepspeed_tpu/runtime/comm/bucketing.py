@@ -33,6 +33,20 @@ recipe (stage2.py:614-745 flatten/reduce machinery, ZeRO §5 of
   (reduce-scatter): each dp rank materializes only the bucket shards its
   optimizer partition owns; the post-step parameter all-gather rides
   XLA's sharding propagation exactly as before (zero/partition.py).
+* With a HIERARCHICAL data axis (comm/mesh.py `data_outer`/`data_inner`
+  sub-axes; the ZeRO++ two-level recipe, arXiv:2306.10209) each bucket
+  lowers per level: `psum_scatter` over `data_inner` (fast fabric, full
+  bucket) -> inter-group collective over `data_outer` on the 1/inner
+  shard only (slow fabric — each level selects its own wire mode, so
+  this hop can ride bf16 or the 24-bit split gather while the fast hop
+  stays exact) -> `all_gather` over `data_inner` back to the full
+  bucket.  Slow-fabric bytes drop by the inner-group factor vs the flat
+  wire.  Under ZeRO >= 2 the final gather is skipped entirely: buckets
+  leave sharded over `data_inner`, which is exactly where the hpZ-style
+  secondary optimizer partitions live (zero/partition.py places shards
+  on `data_inner` only), so the post-step parameter all-gather is
+  intra-group and the inter-group cost is just the scatter already
+  paid.
 
 Every traced collective records its payload into the monitor COUNTERS
 (`bucket.*`, traced-occurrence semantics like `dist.*`); the engine adds
@@ -71,6 +85,16 @@ def _record(op: str, nbytes: int) -> None:
         pass
 
 
+class WireLevel(NamedTuple):
+    """One level of a hierarchical reduction: the mesh axis it rides,
+    the group size, and the wire mode its payload crosses the fabric
+    in."""
+
+    axis: str             # mesh axis name ("data_inner" / "data_outer")
+    size: int             # group size along that axis
+    wire: str             # "fp32" | "bf16" | "split" (outer level only)
+
+
 class LeafSlot(NamedTuple):
     """Where one gradient leaf lives inside its bucket."""
 
@@ -97,14 +121,44 @@ class BucketPlan:
 
     def __init__(self, grad_tree, *, dp_size: int, axis: str = DATA_AXIS,
                  bucket_elems: int, wire: str = "fp32",
-                 scatter: bool = False):
+                 scatter: bool = False,
+                 levels: Optional[Tuple[WireLevel, WireLevel]] = None):
         if wire not in WIRE_MODES:
             raise ValueError(
                 f"unknown wire mode {wire!r}; choose from {WIRE_MODES}")
         if bucket_elems <= 0:
             raise ValueError(f"reduce_bucket_size must be > 0, "
                              f"got {bucket_elems}")
-        if scatter and wire == "split":
+        if levels is not None:
+            inner, outer = levels[0], levels[1]
+            if inner.size * outer.size != int(dp_size):
+                raise ValueError(
+                    f"hierarchy levels {outer.size} x {inner.size} do not "
+                    f"factor the data-parallel size {dp_size}")
+            if inner.size <= 1 or outer.size <= 1:
+                raise ValueError(
+                    f"hierarchy levels must both be > 1 (got outer="
+                    f"{outer.size}, inner={inner.size}); use a flat plan "
+                    "for a single-level reduction")
+            if inner.wire == "split":
+                # gather-structured: an intra-level split would
+                # re-materialize the full bucket on every rank and hand
+                # the OUTER hop full-width payloads — the hierarchy's
+                # whole point inverted.  Config sanitizes this to fp32;
+                # direct constructions must not slip through.
+                raise ValueError(
+                    "the split wire is gather-structured and cannot run "
+                    "the intra-group scatter level; use fp32 or bf16 for "
+                    "the inner wire")
+            if inner.wire not in WIRE_MODES or outer.wire not in WIRE_MODES:
+                raise ValueError(
+                    f"per-level wire modes must be from {WIRE_MODES}, got "
+                    f"inner={inner.wire!r}, outer={outer.wire!r}")
+            self.levels: Optional[Tuple[WireLevel, WireLevel]] = \
+                (inner, outer)
+        else:
+            self.levels = None
+        if scatter and wire == "split" and levels is None:
             # the split wire is gather-structured; a scattered gather
             # would re-materialize the full bucket anyway.  Callers
             # (engine._build_bucket_plan) log the fallback.
@@ -139,17 +193,51 @@ class BucketPlan:
             if slots:
                 self._close(dt, slots, fill)
 
-        # wire accounting, fixed at plan-build time
-        itemsize = _WIRE_ITEMSIZE[self.wire]
-        self.wire_bytes_per_reduction = sum(
-            b.padded * itemsize for b in self.buckets)
-        self.collectives_per_reduction = (
-            (2 if self.wire == "split" else 1) * len(self.buckets))
+        # wire accounting, fixed at plan-build time.  For hierarchical
+        # plans the intra/inter split is the headline number: inter
+        # (slow-fabric) bytes are the 1/inner-size shard per bucket.
+        if self.levels is not None:
+            inner, outer = self.levels
+            isz_in = _WIRE_ITEMSIZE[inner.wire]
+            isz_out = _WIRE_ITEMSIZE[outer.wire]
+            # dense: scatter + gather legs on the fast fabric; ZeRO>=2
+            # keeps buckets scattered — the gather leg never runs
+            intra_legs = 1 if self.scatter else 2
+            self.wire_bytes_intra_per_reduction = sum(
+                b.padded * isz_in * intra_legs for b in self.buckets)
+            self.collectives_intra_per_reduction = (
+                intra_legs * len(self.buckets))
+            self.wire_bytes_inter_per_reduction = sum(
+                (b.padded // inner.size) * isz_out for b in self.buckets)
+            self.collectives_inter_per_reduction = (
+                (2 if outer.wire == "split" else 1) * len(self.buckets))
+            self.wire_bytes_per_reduction = (
+                self.wire_bytes_intra_per_reduction
+                + self.wire_bytes_inter_per_reduction)
+            self.collectives_per_reduction = (
+                self.collectives_intra_per_reduction
+                + self.collectives_inter_per_reduction)
+        else:
+            itemsize = _WIRE_ITEMSIZE[self.wire]
+            self.wire_bytes_per_reduction = sum(
+                b.padded * itemsize for b in self.buckets)
+            self.collectives_per_reduction = (
+                (2 if self.wire == "split" else 1) * len(self.buckets))
+            self.wire_bytes_intra_per_reduction = 0
+            self.wire_bytes_inter_per_reduction = 0
+            self.collectives_intra_per_reduction = 0
+            self.collectives_inter_per_reduction = 0
 
     def _close(self, dtype, slots, fill):
-        pad = 0
-        if self.scatter and self.dp_size > 1 and fill % self.dp_size:
-            pad = self.dp_size - fill % self.dp_size
+        # scatter shards over the (inner) axis; hierarchical plans also
+        # psum_scatter dense buckets over the inner group — both need
+        # the bucket length to divide evenly
+        chunks = 1
+        if self.levels is not None:
+            chunks = self.levels[0].size
+        elif self.scatter:
+            chunks = self.dp_size
+        pad = -fill % chunks if chunks > 1 else 0
         self.buckets.append(BucketSpec(dtype, tuple(slots), fill,
                                        fill + pad))
 
@@ -182,9 +270,70 @@ class BucketPlan:
     def reduce(self, buckets) -> List[jnp.ndarray]:
         """Mean-reduce each flat bucket over the data axis: ONE collective
         per bucket (two for the split wire).  Must run in a manual-mesh
-        region (shard_map) with `self.axis` bound."""
+        region (shard_map) with `self.axis` (or, hierarchical, both level
+        axes) bound."""
+        if self.levels is not None:
+            return [self._reduce_one_hier(flat, b) for flat, b in
+                    zip(buckets, self.buckets)]
         return [self._reduce_one(flat, b) for flat, b in
                 zip(buckets, self.buckets)]
+
+    @staticmethod
+    def _split_gather_sum(x, n_elems: int, axis: str, prefix: str):
+        """The 24-bit frexp wire, shared by the flat split mode and the
+        hierarchical outer hop: fp16 mantissa + int8 exponent of `x`
+        all-gather over `axis`, ldexp-reconstruct and sum locally in
+        fp32.  Gather semantics keep the narrow dtypes ON the wire — an
+        arithmetic reduce upcasts before the transfer (BENCH.md round-5
+        methodology note)."""
+        from .compressed_ar import decompose_int8_safe
+
+        mantissa, exponent = decompose_int8_safe(x)
+        _record(f"{prefix}all_gather", n_elems * 2)
+        m_all = lax.all_gather(mantissa, axis, axis=0, tiled=False)
+        _record(f"{prefix}all_gather", n_elems * 1)
+        e_all = lax.all_gather(exponent.astype(jnp.int8), axis,
+                               axis=0, tiled=False)
+        return jnp.sum(jnp.ldexp(m_all.astype(jnp.float32),
+                                 e_all.astype(jnp.int32)), axis=0)
+
+    def _reduce_one_hier(self, flat, spec: BucketSpec):
+        """Two-level lowering: intra-group reduce-scatter (full bucket,
+        fast fabric) -> inter-group collective on the 1/inner shard
+        (slow fabric, its own wire mode) -> intra-group all-gather
+        (skipped under ZeRO>=2: the bucket leaves sharded over the inner
+        axis, where the hpZ optimizer partitions live)."""
+        inner, outer = self.levels
+        isz_in = _WIRE_ITEMSIZE[inner.wire]
+        shard_elems = spec.padded // inner.size
+
+        wired = flat.astype(jnp.bfloat16 if inner.wire == "bf16"
+                            else jnp.float32)
+        _record("intra.psum_scatter", spec.padded * isz_in)
+        shard = lax.psum_scatter(wired, inner.axis, scatter_dimension=0,
+                                 tiled=True).astype(jnp.float32)
+
+        if outer.wire == "split":
+            # the 24-bit frexp gather on the SLOW hop only — priced per
+            # outer group, not per rank
+            shard = self._split_gather_sum(shard, shard_elems,
+                                           outer.axis, "inter.")
+        elif outer.wire == "bf16":
+            _record("inter.psum", shard_elems * 2)
+            shard = lax.psum(shard.astype(jnp.bfloat16),
+                             outer.axis).astype(jnp.float32)
+        else:
+            _record("inter.psum", shard_elems * 4)
+            shard = lax.psum(shard, outer.axis)
+        shard = shard / self.dp_size
+
+        if self.scatter:
+            return shard.astype(flat.dtype)
+        gathered = shard.astype(jnp.bfloat16) if inner.wire == "bf16" \
+            else shard
+        _record("intra.all_gather", spec.padded * isz_in)
+        out = lax.all_gather(gathered, inner.axis, axis=0, tiled=True)
+        return out.astype(flat.dtype)
 
     def _reduce_one(self, flat, spec: BucketSpec):
         axis, dp = self.axis, self.dp_size
@@ -201,25 +350,11 @@ class BucketPlan:
                 red = lax.psum(wired, axis)
             return red.astype(flat.dtype) / dp
         if self.wire == "split":
-            # 24-bit gather wire: the frexp split
-            # (compressed_ar.decompose_int8_safe — subnormals flushed,
-            # the >= 2^127 tail pushed to inf so overflow checks fire;
-            # the int8 exponent never wraps) rides all_gather so
-            # fp16+int8 stay narrow ON the wire (an arithmetic reduce
-            # upcasts before the transfer — BENCH.md round-5 methodology
-            # note); reconstruction and the cross-rank sum run locally
-            # in fp32.
-            from .compressed_ar import decompose_int8_safe
-
-            mantissa, exponent = decompose_int8_safe(flat)
-            _record("all_gather", spec.padded * 2)
-            m_all = lax.all_gather(mantissa, axis, axis=0, tiled=False)
-            _record("all_gather", spec.padded * 1)
-            e_all = lax.all_gather(exponent.astype(jnp.int8), axis,
-                                   axis=0, tiled=False)
-            contrib = jnp.ldexp(m_all.astype(jnp.float32),
-                                e_all.astype(jnp.int32))
-            return (jnp.sum(contrib, axis=0) / dp).astype(flat.dtype)
+            # 24-bit gather wire (compressed_ar.decompose_int8_safe —
+            # subnormals flushed, the >= 2^127 tail pushed to inf so
+            # overflow checks fire; the int8 exponent never wraps)
+            total = self._split_gather_sum(flat, spec.padded, axis, "")
+            return (total / dp).astype(flat.dtype)
         # fp32-accumulate (allreduce_always_fp32 semantics)
         wired = flat.astype(jnp.float32)
         if self.scatter:
@@ -237,8 +372,15 @@ class BucketPlan:
         """Out specs for the reduced buckets: scattered buckets leave the
         manual region sharded over the data axis (each rank holds only
         its shard — the ZeRO-2 wire contract), full reductions leave
-        replicated."""
-        spec = P(self.axis) if self.scatter else P()
+        replicated.  Hierarchical scattered buckets are sharded over the
+        INNER axis only (replicated across outer groups): exactly the
+        hpZ secondary-shard placement zero/partition.py gives the
+        optimizer state, so the post-step gather stays intra-group."""
+        if self.scatter:
+            spec = P(self.levels[0].axis if self.levels is not None
+                     else self.axis)
+        else:
+            spec = P()
         return [spec for _ in self.buckets]
 
     # -- introspection ------------------------------------------------
@@ -255,11 +397,35 @@ class BucketPlan:
     def total_elems(self) -> int:
         return sum(b.n_elems for b in self.buckets)
 
+    @property
+    def hierarchical(self) -> bool:
+        return self.levels is not None
+
+    @property
+    def exact_fp32(self) -> bool:
+        """True when every hop accumulates at full fp32 width — the
+        `allreduce_always_fp32` contract the engine reports."""
+        if self.levels is not None:
+            return all(lvl.wire == "fp32" for lvl in self.levels)
+        return self.wire == "fp32"
+
     def describe(self) -> str:
         sizes = ", ".join(f"{b.n_elems}" + (f"+{b.padded - b.n_elems}pad"
                                             if b.padded > b.n_elems else "")
                           for b in self.buckets)
         lowering = "reduce-scatter" if self.scatter else "allreduce"
+        if self.levels is not None:
+            inner, outer = self.levels
+            return (f"BucketPlan: {self.n_leaves} grad leaves -> "
+                    f"{self.n_buckets} bucket(s) [{sizes}] elems, "
+                    f"hierarchical ({lowering}): intra {inner.axis}="
+                    f"{inner.size} wire={inner.wire} "
+                    f"({self.wire_bytes_intra_per_reduction} B / "
+                    f"{self.collectives_intra_per_reduction} coll), "
+                    f"inter {outer.axis}={outer.size} wire={outer.wire} "
+                    f"({self.wire_bytes_inter_per_reduction} B / "
+                    f"{self.collectives_inter_per_reduction} coll) "
+                    f"per reduction over dp={self.dp_size}")
         return (f"BucketPlan: {self.n_leaves} grad leaves -> "
                 f"{self.n_buckets} bucket(s) [{sizes}] elems, "
                 f"wire={self.wire} ({lowering}), "

@@ -50,13 +50,68 @@ CHUNK_BYTES = 2 << 20          # 2 MiB: safely under gRPC message caps
 MAX_PAYLOAD_BYTES = 128 << 20  # 128 MiB/rank/step: the envelope edge
 
 
-def _client():
-    from jax._src import distributed
+# Client API surface the wire depends on (ADVICE round-5 #4): these are
+# asserted at construction so a jax upgrade that renames/removes one
+# fails with a versioned message instead of an AttributeError deep
+# inside a barrier mid-step.
+_REQUIRED_CLIENT_API = ("key_value_set", "blocking_key_value_get",
+                        "key_value_delete", "wait_at_barrier")
 
-    state = distributed.global_state
+
+def _distributed_state():
+    """The jax.distributed client state, via the public accessor when
+    the installed jax exposes one, else the long-stable private module.
+    Returns None when neither shape is recognized (API drift)."""
+    import jax
+
+    # newer jax releases export the state object publicly
+    state = getattr(jax.distributed, "global_state", None)
+    if state is None:
+        try:
+            from jax._src import distributed
+
+            state = distributed.global_state
+        except Exception:
+            return None
+    if not all(hasattr(state, a) for a in
+               ("client", "process_id", "num_processes")):
+        return None
+    return state
+
+
+def _client():
+    import jax
+
+    state = _distributed_state()
+    if state is None:
+        raise RuntimeError(
+            f"hostwire: jax {jax.__version__} exposes neither "
+            "jax.distributed.global_state nor jax._src.distributed."
+            "global_state with the expected (client, process_id, "
+            "num_processes) surface — the coordination-service KV "
+            "transport cannot attach.  Pin a known-good jax or port "
+            "runtime/comm/hostwire.py to the new client API.")
     if state.client is None:
         return None, 0, 1
     return state.client, state.process_id, state.num_processes
+
+
+def _assert_client_api(client) -> None:
+    """Fail fast (and versioned) when the KV client lacks a method the
+    wire will call later."""
+    if client is None:
+        return
+    import jax
+
+    missing = [a for a in _REQUIRED_CLIENT_API if not hasattr(client, a)]
+    if missing:
+        raise RuntimeError(
+            f"hostwire: the jax {jax.__version__} distributed client is "
+            f"missing required method(s) {missing} (has: "
+            f"{[a for a in _REQUIRED_CLIENT_API if hasattr(client, a)]}). "
+            "The KV wire cannot run on this jax build — pin a version "
+            "whose client exposes the full key-value + barrier surface, "
+            "or port runtime/comm/hostwire.py.")
 
 
 def _kv_set(client, key: str, payload: bytes) -> None:
@@ -95,6 +150,9 @@ class HostWire:
         # a fake in-memory KV store without jax.distributed processes
         self.client, self.rank, self.world = (
             _endpoint if _endpoint is not None else _client())
+        # fail at construction, not deep in a barrier, when the client
+        # API surface is incomplete (jax version drift; fakes included)
+        _assert_client_api(self.client)
         self.tag = tag
         self.timeout_ms = timeout_ms
         self.chunk_bytes = int(chunk_bytes)

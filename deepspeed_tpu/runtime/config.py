@@ -44,13 +44,60 @@ class DeepSpeedConfigWriter(DeepSpeedConfigObject):
     pass
 
 
+def parse_comm_hierarchy(value):
+    """Normalize the `comm.hierarchy` knob to "none" | "auto" | int
+    (the explicit outer factor).  Shared by the config validator and the
+    engine's mesh construction (which runs before full config parsing)."""
+    if value is None:
+        value = c.COMM_HIERARCHY_DEFAULT
+    if isinstance(value, dict):
+        unknown = set(value) - {"outer"}
+        if unknown:
+            raise ValueError(
+                f"comm.hierarchy: unknown key(s) {sorted(unknown)}; "
+                "expected {'outer': <int>}")
+        value = value.get("outer", 1)
+    if isinstance(value, str):
+        mode = value.lower()
+        if mode in ("none", "flat", "off"):
+            return "none"
+        if mode == "auto":
+            return "auto"
+        raise ValueError(
+            "comm.hierarchy must be 'none', 'auto', an int outer factor, "
+            f"or {{'outer': <int>}}, got {value!r}")
+    if isinstance(value, bool) or not isinstance(value, int):
+        raise ValueError(
+            "comm.hierarchy must be 'none', 'auto', an int outer factor, "
+            f"or {{'outer': <int>}}, got {value!r}")
+    if value < 1:
+        raise ValueError(
+            f"comm.hierarchy outer factor must be >= 1, got {value}")
+    return "none" if value == 1 else value
+
+
+def check_hierarchy_divides(outer: int, dp_size: int) -> None:
+    """An explicit outer factor must factor the dp size exactly — raise
+    a shape-level ValueError naming the axis sizes instead of letting a
+    jitted reshape/scatter trace into an opaque shape error."""
+    if dp_size % outer != 0:
+        raise ValueError(
+            f"comm.hierarchy: data_outer={outer} does not divide the "
+            f"data-parallel axis size {dp_size} (data_inner would be "
+            f"{dp_size / outer:g}); pick an outer factor from the "
+            f"divisors of {dp_size}")
+
+
 class DeepSpeedCommConfig(DeepSpeedConfigObject):
     """Gradient-reduction wire selection (runtime/comm/bucketing.py).
 
     "comm": {
       "gradient_reduction": "implicit" | "bucketed",
       "wire_dtype": "fp32" | "bf16" | "split",
-      "reduce_bucket_size": <elements>   # default: zero_optimization's knob
+      "reduce_bucket_size": <elements>,  # default: zero_optimization's knob
+      "hierarchy": "none" | "auto" | <outer> | {"outer": <outer>},
+      "wire_dtype_inner": ...,           # per-level overrides (hierarchy)
+      "wire_dtype_outer": ...
     }
 
     `implicit` (default) leaves DP reduction to XLA's psum at the
@@ -60,9 +107,16 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
     faster on serialization-bound fabrics (BENCH.md grad-wire rounds).
     The reference's top-level `fp32_allreduce` key forces wire_dtype to
     fp32 (the engine's `allreduce_always_fp32()` reflects the result).
+
+    `hierarchy` factors the data axis for the two-level wire (ZeRO++
+    arXiv:2306.10209 recipe): intra-group reduce-scatter, inter-group
+    collective on the 1/inner shard, intra-group all-gather.  Per-level
+    wire dtypes let the slow hop compress (bf16/split) while the fast
+    hop stays exact; the inner level is scatter-structured, so a "split"
+    request there lowers to fp32 with a log line.
     """
 
-    def __init__(self, param_dict, zero_config):
+    def __init__(self, param_dict, zero_config, world_size=None):
         super().__init__()
         d = param_dict.get(c.COMM) or {}
         self.gradient_reduction = str(get_scalar_param(
@@ -75,14 +129,45 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
                 f"got {self.gradient_reduction!r}")
         self.fp32_allreduce = bool(get_scalar_param(
             param_dict, c.FP32_ALLREDUCE, c.FP32_ALLREDUCE_DEFAULT))
-        wire = str(get_scalar_param(d, c.COMM_WIRE_DTYPE,
-                                    c.COMM_WIRE_DTYPE_DEFAULT)).lower()
         from .comm.bucketing import WIRE_MODES
 
-        if wire not in WIRE_MODES:
-            raise ValueError(f"comm.wire_dtype must be one of {WIRE_MODES}, "
-                             f"got {wire!r}")
-        self.wire_dtype = "fp32" if self.fp32_allreduce else wire
+        def wire_param(key, default):
+            w = get_scalar_param(d, key, default)
+            if w is None:
+                return None
+            w = str(w).lower()
+            if w not in WIRE_MODES:
+                raise ValueError(f"comm.{key} must be one of {WIRE_MODES}, "
+                                 f"got {w!r}")
+            return "fp32" if self.fp32_allreduce else w
+
+        self.wire_dtype = wire_param(c.COMM_WIRE_DTYPE,
+                                     c.COMM_WIRE_DTYPE_DEFAULT)
+        self.hierarchy = parse_comm_hierarchy(
+            get_scalar_param(d, c.COMM_HIERARCHY, c.COMM_HIERARCHY_DEFAULT))
+        if isinstance(self.hierarchy, int) and world_size is not None:
+            check_hierarchy_divides(self.hierarchy, int(world_size))
+        # per-level overrides default to the single-level wire; the
+        # inner level can't carry the gather-structured split wire
+        # (BucketPlan would re-materialize the full bucket), so it
+        # falls back to exact fp32 there — the fast hop staying exact
+        # is the recommended placement anyway (comm_tuning.md)
+        inner_override = wire_param(c.COMM_WIRE_DTYPE_INNER, None)
+        self.wire_dtype_inner = inner_override or self.wire_dtype
+        self.wire_dtype_outer = wire_param(c.COMM_WIRE_DTYPE_OUTER, None) \
+            or self.wire_dtype
+        if self.wire_dtype_inner == "split":
+            if inner_override is not None:
+                # warn only on an EXPLICIT inner-split request; when it
+                # is merely inherited from wire_dtype the flat path may
+                # still run the split wire unchanged (hierarchy "auto"
+                # can resolve flat), and on a factored mesh the engine's
+                # BucketPlan log shows the effective per-level wires
+                logger.warning(
+                    "comm: the split wire is gather-structured and cannot "
+                    "run the intra-group scatter level; wire_dtype_inner "
+                    "lowers to fp32")
+            self.wire_dtype_inner = "fp32"
         self.reduce_bucket_size = int(get_scalar_param(
             d, c.COMM_REDUCE_BUCKET_SIZE, zero_config.reduce_bucket_size))
 
@@ -219,7 +304,8 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         self.zero_enabled = self.zero_optimization_stage > 0
 
         # gradient-reduction wire (runtime/comm/bucketing.py)
-        self.comm_config = DeepSpeedCommConfig(pd, self.zero_config)
+        self.comm_config = DeepSpeedCommConfig(pd, self.zero_config,
+                                               world_size=self.world_size)
 
         # pipeline: use_p2p_channels forces the multi-host channel
         # executor even single-process (the driver's virtual-multichip

@@ -93,6 +93,16 @@ COMM_WIRE_DTYPE = "wire_dtype"
 COMM_WIRE_DTYPE_DEFAULT = "fp32"  # "fp32" | "bf16" | "split"
 COMM_REDUCE_BUCKET_SIZE = "reduce_bucket_size"  # elements; falls back to
                                                 # zero_optimization's knob
+# Two-level (intra/inter fabric) reduction over a factored data axis:
+#   "hierarchy": "none" | "auto" | <outer int> | {"outer": <int>}
+# "auto" derives one outer group per jax process; an explicit outer must
+# divide the dp size.  Only meaningful with gradient_reduction=bucketed.
+COMM_HIERARCHY = "hierarchy"
+COMM_HIERARCHY_DEFAULT = "none"
+# Per-level wire overrides (default: wire_dtype for both levels; the
+# inner level is scatter-structured so "split" there lowers to fp32).
+COMM_WIRE_DTYPE_INNER = "wire_dtype_inner"
+COMM_WIRE_DTYPE_OUTER = "wire_dtype_outer"
 FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
 

@@ -274,6 +274,15 @@ class DeepSpeedEngine:
         self.run_monitor = self._init_run_monitor()
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
+        if isinstance(config, str):
+            # file-path configs must drive the mesh/hierarchy exactly
+            # like dict configs; a bad path surfaces as DeepSpeedConfig's
+            # error right after, so fall back quietly here
+            try:
+                with open(config) as f:
+                    config = json.load(f)
+            except Exception:
+                config = {}
         mesh_dict = {}
         if isinstance(config, dict):
             mesh_dict = dict(config.get(const.MESH) or {})
@@ -283,7 +292,97 @@ class DeepSpeedEngine:
             data=mesh_dict.get("data", -1),
             model=mesh_dict.get("model", 1),
             pipe=mesh_dict.get("pipe", 1),
-            seq=mesh_dict.get("seq", 1))
+            seq=mesh_dict.get("seq", 1),
+            data_outer=self._resolve_hierarchy(config, mesh_dict))
+
+    def _resolve_hierarchy(self, config, mesh_dict) -> int:
+        """Outer factor for a hierarchical data axis, resolved BEFORE
+        full config parsing (the mesh must exist first).  1 == flat.
+        Only the bucketed gradient wire consumes the factored axis, so
+        the hierarchy engages only when that wire is requested and the
+        mesh is pure-DP; anything else logs the reason and stays flat.
+        An explicit factor that doesn't divide dp raises a ValueError
+        naming the axis sizes (config.check_hierarchy_divides) instead
+        of tracing into a shape error later."""
+        from .config import check_hierarchy_divides, parse_comm_hierarchy
+
+        comm_dict = (config.get(const.COMM) or {}) \
+            if isinstance(config, dict) else {}
+        hierarchy = parse_comm_hierarchy(comm_dict.get(const.COMM_HIERARCHY))
+        if hierarchy == "none":
+            return 1
+        # RESOLVED axis sizes (the same resolver make_mesh uses): the
+        # factor is validated against the real dp, and the pure-DP gate
+        # sees what -1 ("take the rest") axes actually resolve to — raw
+        # dict values would let e.g. model=-1 slip past the blocker
+        from ..comm.mesh import (DATA_AXIS as _DA, MODEL_AXIS as _MA,
+                                 PIPE_AXIS as _PA, SEQ_AXIS as _SA,
+                                 _resolve_sizes)
+
+        data = mesh_dict.get("data", -1)
+        sizes = _resolve_sizes(jax.device_count(), {
+            _DA: -1 if data is None else data,
+            _MA: mesh_dict.get("model", 1),
+            _PA: mesh_dict.get("pipe", 1),
+            _SA: mesh_dict.get("seq", 1)})
+        dp = sizes[_DA]
+        if isinstance(hierarchy, int):
+            # an explicit non-dividing factor is a config error even
+            # when another blocker keeps the mesh flat: raising here
+            # (before any "falling back" log) matches the comm-config
+            # validator instead of contradicting it
+            check_hierarchy_divides(hierarchy, dp)
+        blockers = []
+        if str(comm_dict.get(const.COMM_GRADIENT_REDUCTION,
+                             const.COMM_GRADIENT_REDUCTION_DEFAULT)
+               ).lower() != "bucketed":
+            blockers.append("comm.gradient_reduction is not 'bucketed' "
+                            "(only the bucketed wire rides the factored "
+                            "axis)")
+        for ax in (_MA, _PA, _SA):
+            if sizes[ax] > 1:
+                blockers.append(f"{ax} axis > 1 (hierarchy needs a "
+                                "pure-DP mesh)")
+        # the AUTHORITATIVE zero-config parse (stage defaults, legacy
+        # bool, cpu_offload/offload_optimizer normalization) — never a
+        # re-derivation from the raw dict that could drift from the
+        # runtime's own gates; a malformed section is left for
+        # DeepSpeedConfig to raise the real error on
+        from .zero.config import DeepSpeedZeroConfig
+
+        try:
+            zcfg = DeepSpeedZeroConfig(config if isinstance(config, dict)
+                                       else {})
+        except Exception:
+            zcfg = None
+        if zcfg is not None and zcfg.stage >= 3:
+            blockers.append("ZeRO-3 (param sharding keeps the flat axis)")
+        if zcfg is not None and (zcfg.cpu_offload
+                                 or zcfg.offload_optimizer is not None):
+            # same condition _configure_offload engages on: the step
+            # runs host-side, the bucketed wire never engages, and a
+            # factored mesh would only buy hpZ's extra partition memory
+            # with zero slow-fabric savings
+            blockers.append("ZeRO-Offload (the step runs host-side)")
+        if blockers:
+            log_dist("comm.hierarchy requested but unavailable — keeping "
+                     "the flat data axis: " + "; ".join(blockers),
+                     ranks=[0])
+            return 1
+        if hierarchy == "auto":
+            outer = comm.derive_data_outer(dp)
+            if outer == 1:
+                log_dist("comm.hierarchy auto: topology offers no "
+                         "two-level factorization (single process, or "
+                         "inner groups of 1) — keeping the flat data "
+                         "axis", ranks=[0])
+            return outer
+        if dp // int(hierarchy) == 1:
+            log_dist(f"comm.hierarchy outer={hierarchy} leaves inner "
+                     "groups of 1 — keeping the flat data axis",
+                     ranks=[0])
+            return 1
+        return int(hierarchy)
 
     def _configure_optimizer(self):
         """reference engine.py:647-757 optimizer selection."""
@@ -505,12 +604,25 @@ class DeepSpeedEngine:
             return None
         scatter = (self._config.zero_optimization_stage >= 2
                    and bool(self._config.zero_config.reduce_scatter))
-        if scatter and cc.wire_dtype == "split":
+        if scatter and cc.wire_dtype == "split" \
+                and not self.mesh_info.hierarchical:
             log_dist("split wire is gather-structured; ZeRO>=2 bucket "
                      "reduction stays allreduce-lowered", ranks=[0])
+        levels = None
+        if self.mesh_info.hierarchical:
+            from .comm.bucketing import WireLevel
+            from ..comm.mesh import DATA_INNER_AXIS, DATA_OUTER_AXIS
+
+            levels = (
+                WireLevel(DATA_INNER_AXIS, self.mesh_info.data_inner_size,
+                          cc.wire_dtype_inner),
+                WireLevel(DATA_OUTER_AXIS, self.mesh_info.data_outer_size,
+                          cc.wire_dtype_outer),
+            )
         plan = BucketPlan(self._params, dp_size=dp,
                           bucket_elems=cc.reduce_bucket_size,
-                          wire=cc.wire_dtype, scatter=scatter)
+                          wire=cc.wire_dtype, scatter=scatter,
+                          levels=levels)
         log_dist(plan.describe(), ranks=[0])
         return plan
 
@@ -519,13 +631,24 @@ class DeepSpeedEngine:
         plan's predicted payload, recorded as the step executes (unlike
         the traced-occurrence `bucket.*`/`dist.*` counters).  The
         monitor's per-step counter deltas pick this up unchanged, and
-        tests/test_grad_bucketing.py pins it against the plan exactly."""
+        tests/test_grad_bucketing.py pins it against the plan exactly.
+        Hierarchical plans additionally split the total into
+        `grad_wire.intra` (fast-fabric scatter/gather legs) and
+        `grad_wire.inter` (the slow-fabric hop on the 1/inner shard —
+        the number a two-level placement exists to shrink)."""
         plan = self.bucket_plan
         if plan is None or self._capture_layers is not None:
             return
         COUNTERS.add("grad_wire.reduce",
                      plan.wire_bytes_per_reduction * events,
                      calls=plan.collectives_per_reduction * events)
+        if plan.hierarchical:
+            COUNTERS.add("grad_wire.intra",
+                         plan.wire_bytes_intra_per_reduction * events,
+                         calls=plan.collectives_intra_per_reduction * events)
+            COUNTERS.add("grad_wire.inter",
+                         plan.wire_bytes_inter_per_reduction * events,
+                         calls=plan.collectives_inter_per_reduction * events)
 
     def _build_step_fns(self):
         model = self.module
@@ -584,22 +707,33 @@ class DeepSpeedEngine:
         else:
             mesh = self.mesh_info.mesh
             P = PartitionSpec
+            data_axes = self.mesh_info.data_axes  # outermost first
+            batch_spec = self.mesh_info.data_spec
+            inner_size = self.mesh_info.data_inner_size
+
+            def _global_dp_rank():
+                # linearized rank over the (possibly factored) data
+                # axis: outer-major matches the mesh's device order
+                if len(data_axes) == 1:
+                    return jax.lax.axis_index(data_axes[0])
+                return (jax.lax.axis_index(data_axes[0]) * inner_size
+                        + jax.lax.axis_index(data_axes[1]))
 
             def _local_step(cp, b, r, ls, th):
                 # per-shard rng decorrelation: the implicit wire draws ONE
                 # global dropout mask; each shard must not repeat it
-                r = jax.random.fold_in(r, jax.lax.axis_index(DATA_AXIS))
+                r = jax.random.fold_in(r, _global_dp_rank())
                 grads, (loss, _) = jax.grad(
                     lambda p: run_loss(p, b, r, th, ls), has_aux=True)(cp)
                 buckets = wire_plan.flatten(cast(grads, jnp.float32))
                 buckets = wire_plan.reduce(buckets)
-                return buckets, jax.lax.pmean(loss, DATA_AXIS)
+                return buckets, jax.lax.pmean(loss, data_axes)
 
             smapped = jax.shard_map(
                 _local_step, mesh=mesh,
-                in_specs=(P(), P(DATA_AXIS), P(), P(), P()),
+                in_specs=(P(), P(batch_spec), P(), P(), P()),
                 out_specs=(wire_plan.bucket_out_specs(), P()),
-                axis_names={DATA_AXIS}, check_vma=False)
+                axis_names=set(data_axes), check_vma=False)
 
             def compute_grads(cparams, batch, rng, pld_theta, loss_scale):
                 """LOCAL grads under shard_map, mean-reduced through the
@@ -765,12 +899,15 @@ class DeepSpeedEngine:
         ok = (self.gradient_accumulation_steps() == 1
               and self._offload is None
               and self._config.zero_optimization_stage == 0
-              and self.mesh_info.axis_size(DATA_AXIS) > 1)
+              and self.mesh_info.axis_size(DATA_AXIS) > 1
+              and not self.mesh_info.hierarchical)
         if not ok:
             log_dist(
                 "1-bit optimizer falling back to dense DP reduction "
                 "(compressed comm needs gas==1, ZeRO stage 0, no offload, "
-                "dp>1 — reference onebit/adam.py has the same constraints)",
+                "dp>1, a FLAT data axis — reference onebit/adam.py has the "
+                "same constraints; the compressed wire addresses one named "
+                "axis)",
                 ranks=[0])
         return ok
 
@@ -877,7 +1014,7 @@ class DeepSpeedEngine:
             x = jnp.asarray(x)
             spec = [None] * x.ndim
             if batch_shardable(x.shape, max(1, self.dp_world_size)):
-                spec[0] = DATA_AXIS
+                spec[0] = self.mesh_info.data_spec
             elif x.ndim:
                 # replicating costs dp x memory/compute — tell the user once
                 if not getattr(self, "_warned_replicated_batch", False):
@@ -1434,7 +1571,7 @@ class DeepSpeedEngine:
             x = jnp.asarray(x)
             spec = [None] * x.ndim
             if x.ndim > 1 and x.shape[1] % max(1, self.dp_world_size) == 0:
-                spec[1] = DATA_AXIS
+                spec[1] = self.mesh_info.data_spec
             target = NamedSharding(mesh, PartitionSpec(*spec))
             if isinstance(x, jax.Array) and \
                     x.sharding.is_equivalent_to(target, x.ndim):
@@ -1516,7 +1653,7 @@ class DeepSpeedEngine:
         so there is nothing to do between steps."""
         self._grad_acc = None
 
-    def allreduce_gradients(self, bucket_size=None):
+    def allreduce_gradients(self, bucket_size=None, hierarchy=None):
         """reference engine.py:1023-1038.  DP gradient reduction runs
         INSIDE the jitted step here — through the BucketPlan's fused
         collectives when `comm.gradient_reduction=="bucketed"`, else
@@ -1527,6 +1664,13 @@ class DeepSpeedEngine:
         * `bucket_size` (elements, the reference's meaning) retunes the
           BucketPlan and recompiles the step programs when the bucketed
           wire is active — the reference's dynamic-bucket knob.
+        * `hierarchy` (an outer factor, or {"outer": n}) is VALIDATED
+          against the dp size with a shape-level ValueError naming the
+          axis sizes — never traced into an opaque reshape error.  The
+          factorization itself is fixed at initialize() (it is the mesh
+          layout every array placement derives from), so a valid factor
+          that differs from the current mesh raises too, pointing at the
+          config knob.
         * On paths where globally-reduced gradients never exist (the
           1-bit compressed wire, ZeRO-Infinity streaming) it raises
           instead of silently lying about having reduced anything."""
@@ -1536,6 +1680,26 @@ class DeepSpeedEngine:
                 "materialize on this path (ZeRO-Infinity streams per-block "
                 "grads; the 1-bit optimizer owns the compressed wire) — "
                 "there is nothing to reduce")
+        if hierarchy is not None:
+            from .config import check_hierarchy_divides, parse_comm_hierarchy
+
+            parsed = parse_comm_hierarchy(hierarchy)
+            dp = self.mesh_info.axis_size(DATA_AXIS)
+            current = self.mesh_info.data_outer_size
+            if isinstance(parsed, int):
+                check_hierarchy_divides(parsed, dp)
+            if parsed == "auto":
+                parsed = comm.derive_data_outer(dp)
+                parsed = "none" if parsed == 1 else parsed
+            wanted = 1 if parsed == "none" else int(parsed)
+            if wanted != current and not (
+                    wanted > 1 and dp // wanted == 1 and current == 1):
+                raise ValueError(
+                    f"allreduce_gradients: the data-axis factorization is "
+                    f"the mesh layout and is fixed at initialize() — "
+                    f"currently data_outer={current} x data_inner="
+                    f"{dp // max(1, current)}; set comm.hierarchy in the "
+                    f"config to train with data_outer={wanted}")
         if bucket_size is not None and self.bucket_plan is not None and \
                 int(bucket_size) != self.bucket_plan.bucket_elems:
             self._config.comm_config.reduce_bucket_size = int(bucket_size)
@@ -1622,7 +1786,7 @@ class DeepSpeedEngine:
         layer-output capture forces the step programs back onto the
         implicit fp32 wire (_build_step_fns), so report THAT."""
         if self.bucket_plan is not None and self._capture_layers is None:
-            return self.bucket_plan.wire == "fp32"
+            return self.bucket_plan.exact_fp32
         return True
 
     def memory_breakdown(self):

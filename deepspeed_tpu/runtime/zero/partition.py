@@ -22,17 +22,31 @@ Sharding rule per tensor: shard the largest dimension divisible by the dp
 size that is not already occupied by a tensor-parallel axis; tensors too
 small to shard (or with no divisible dim) stay replicated — the analogue of
 the reference's `param_persistence_threshold` (stage3.py:1386).
+
+Hierarchical data axis (hpZ secondary shards, ZeRO++ arXiv:2306.10209):
+when the mesh factors `data` into `("data_outer", "data_inner")`, the
+stage-1/2 optimizer-state and gradient partitions are placed over
+`data_inner` ONLY — replicated across outer groups.  That costs
+outer-factor x more partition memory than a full-dp shard (the hpZ
+trade) but keeps every post-step parameter all-gather strictly on the
+fast intra-group fabric, and it is exactly where the hierarchical
+bucket wire's reduce-scatter already leaves the gradients
+(runtime/comm/bucketing.py).  Stage-3 parameter sharding keeps the full
+dp factor (both sub-axes) — the memory win is the point there.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ...comm.mesh import DATA_AXIS, MeshInfo
+from ...comm.mesh import (DATA_AXIS, DATA_INNER_AXIS, DATA_OUTER_AXIS,
+                          MeshInfo)
+
+_DATA_AXIS_NAMES = (DATA_AXIS, DATA_INNER_AXIS, DATA_OUTER_AXIS)
 
 
 def _spec_to_list(spec: Optional[PartitionSpec], ndim: int):
@@ -45,15 +59,19 @@ def _spec_to_list(spec: Optional[PartitionSpec], ndim: int):
 
 
 def add_data_axis(spec: Optional[PartitionSpec], shape, dp_size: int,
-                  min_size_to_shard: int = 1024) -> PartitionSpec:
-    """Extend a (possibly TP-sharded) PartitionSpec with the `data` axis on
-    the best free dimension. Returns the original spec if nothing divides."""
+                  min_size_to_shard: int = 1024,
+                  axes: Sequence[str] = (DATA_AXIS,)) -> PartitionSpec:
+    """Extend a (possibly TP-sharded) PartitionSpec with the data axis
+    (`axes`: one mesh axis name, or the hierarchical sub-axis pair with
+    `dp_size` their product) on the best free dimension. Returns the
+    original spec if nothing divides."""
     dims = _spec_to_list(spec, len(shape))
     if dp_size <= 1 or int(np.prod(shape or (1,))) < min_size_to_shard:
         return PartitionSpec(*dims)
     flat = [a for d in dims if d is not None
             for a in (d if isinstance(d, tuple) else (d,))]
-    if DATA_AXIS in flat:  # already data-sharded (e.g. expert-parallel)
+    if any(a in flat for a in _DATA_AXIS_NAMES):
+        # already data-sharded (e.g. expert-parallel)
         return PartitionSpec(*dims)
     best, best_len = None, 0
     for i, d in enumerate(shape):
@@ -61,7 +79,8 @@ def add_data_axis(spec: Optional[PartitionSpec], shape, dp_size: int,
             best, best_len = i, d
     if best is None:
         return PartitionSpec(*dims)
-    dims[best] = DATA_AXIS
+    axes = tuple(axes)
+    dims[best] = axes[0] if len(axes) == 1 else axes
     return PartitionSpec(*dims)
 
 
@@ -78,6 +97,19 @@ class ZeroShardingPlan:
         self.mesh_info = mesh_info
         self.min_size_to_shard = min_size_to_shard
         dp = mesh_info.axis_size(DATA_AXIS)
+        # partition placement: flat meshes shard over the whole data
+        # axis; hierarchical meshes place stage-1/2 partitions on the
+        # inner sub-axis only (hpZ secondary shards — see module doc),
+        # keeping stage-3 parameter shards at the full dp factor.
+        if mesh_info.hierarchical:
+            part_axes: Tuple[str, ...] = (DATA_INNER_AXIS,)
+            part_size = mesh_info.data_inner_size
+            full_axes: Tuple[str, ...] = (DATA_OUTER_AXIS, DATA_INNER_AXIS)
+        else:
+            part_axes = full_axes = (DATA_AXIS,)
+            part_size = dp
+        self.partition_axes = part_axes
+        self.partition_size = part_size
 
         def base_spec(path_spec, leaf):
             # TP spec supplied by the model (or None -> replicated)
@@ -96,14 +128,21 @@ class ZeroShardingPlan:
                 param_specs, params,
                 is_leaf=lambda x: isinstance(x, PartitionSpec) or x is None)
 
-        def with_dp(spec, leaf):
-            return add_data_axis(spec, leaf.shape, dp, min_size_to_shard)
+        def with_partition(spec, leaf):
+            return add_data_axis(spec, leaf.shape, part_size,
+                                 min_size_to_shard, axes=part_axes)
+
+        def with_full_dp(spec, leaf):
+            return add_data_axis(spec, leaf.shape, dp, min_size_to_shard,
+                                 axes=full_axes)
 
         is_spec = lambda x: isinstance(x, PartitionSpec) or x is None
 
-        # parameter specs: replicated over data unless stage 3
+        # parameter specs: replicated over data unless stage 3 (full dp
+        # factor even hierarchical — param memory is the stage-3 win)
         if self.stage >= 3:
-            self.param_spec = jax.tree_util.tree_map(with_dp, param_specs,
+            self.param_spec = jax.tree_util.tree_map(with_full_dp,
+                                                     param_specs,
                                                      params, is_leaf=is_spec)
         else:
             self.param_spec = jax.tree_util.tree_map(base_spec, param_specs,
@@ -112,22 +151,38 @@ class ZeroShardingPlan:
         # gradient specs: sharded from stage 2 (reduce-scatter), else
         # follow params (mean over data handled by psum/jit)
         if self.stage >= 2:
-            self.grad_spec = jax.tree_util.tree_map(with_dp, param_specs,
+            self.grad_spec = jax.tree_util.tree_map(with_partition,
+                                                    param_specs,
                                                     params, is_leaf=is_spec)
         else:
             self.grad_spec = self.param_spec
 
         # optimizer-state specs: sharded from stage 1
         if self.stage >= 1:
-            self.opt_spec = jax.tree_util.tree_map(with_dp, param_specs,
+            self.opt_spec = jax.tree_util.tree_map(with_partition,
+                                                   param_specs,
                                                    params, is_leaf=is_spec)
         else:
             self.opt_spec = self.param_spec
 
+    def _translate_data_axes(self, d):
+        """One spec entry (axis name or tuple): on a hierarchical mesh
+        the logical "data" name is not a mesh axis — a model-supplied
+        spec using it (e.g. expert-parallel MoE params) expands to the
+        ("data_outer", "data_inner") pair, same total size."""
+        if not self.mesh_info.hierarchical or d is None:
+            return d
+        out = []
+        for a in (d if isinstance(d, tuple) else (d,)):
+            out.extend((DATA_OUTER_AXIS, DATA_INNER_AXIS)
+                       if a == DATA_AXIS else (a,))
+        return tuple(out) if len(out) > 1 else out[0]
+
     def _sanitize(self, spec: Optional[PartitionSpec], shape):
         if spec is None:
             return PartitionSpec()
-        dims = _spec_to_list(spec, len(shape))
+        dims = [self._translate_data_axes(d)
+                for d in _spec_to_list(spec, len(shape))]
         out = []
         for i, d in enumerate(dims):
             if d is None:
@@ -217,7 +272,14 @@ class ZeroShardingPlan:
         for s in jax.tree_util.tree_leaves(
                 self.opt_spec, is_leaf=lambda x: isinstance(x, PartitionSpec)):
             n_total += 1
-            if DATA_AXIS in tuple(s):
+            flat = [a for d in tuple(s) if d is not None
+                    for a in (d if isinstance(d, tuple) else (d,))]
+            if any(a in flat for a in _DATA_AXIS_NAMES):
                 n_shard += 1
+        where = (f"{self.partition_size} intra-group shards "
+                 f"(hpZ: replicated across "
+                 f"{self.mesh_info.data_outer_size} outer groups)"
+                 if self.mesh_info.hierarchical
+                 else f"{self.partition_size} shards")
         return (f"ZeRO stage {self.stage}: {n_shard}/{n_total} tensors "
-                f"dp-sharded over {self.mesh_info.axis_size(DATA_AXIS)} shards")
+                f"dp-sharded over {where}")

@@ -1,10 +1,12 @@
 """Worker for the 2-process bucketed-wire slow-lane parity test
 (test_grad_bucketing.py): each jax.distributed process backs 4 virtual
-CPU devices; the SAME data stream trains an implicit-wire engine and a
-bucketed-wire engine, so the cross-process collectives (gloo/TCP — the
-fabric where bucketing pays) carry real serialized bytes.  Every process
-prints both final losses + a param checksum; the parent asserts the two
-wires agree and all processes agree with each other."""
+CPU devices; the SAME data stream trains an implicit-wire engine, a
+bucketed-wire engine, and a HIERARCHICAL bucketed engine (data_outer=2:
+one outer group per process, so the inter-group hop rides the real
+gloo/TCP boundary while intra-group collectives stay in-process), so
+the cross-process collectives carry real serialized bytes.  Every
+process prints the final losses + a param checksum per wire; the parent
+asserts all wires agree and all processes agree with each other."""
 
 import os
 import sys
@@ -72,9 +74,23 @@ def main():
         {"gradient_reduction": "bucketed", "reduce_bucket_size": 1024})
     assert engine.bucket_plan is not None, \
         "bucketed wire did not engage on the 2-process lane"
+    # hierarchical lane: "auto" must map processes to outer groups
+    # (outer=nprocs, inner=4 local devices) on this topology
+    hier_loss, hier_psum, hier_engine = run(
+        {"gradient_reduction": "bucketed", "reduce_bucket_size": 1024,
+         "hierarchy": "auto"})
+    assert hier_engine.mesh_info.hierarchical, \
+        "hierarchy=auto did not factor the data axis across processes"
+    assert hier_engine.mesh_info.data_outer_size == nprocs
+    hplan = hier_engine.bucket_plan
+    assert hplan is not None and hplan.hierarchical
+    assert hplan.wire_bytes_inter_per_reduction * 4 <= \
+        engine.bucket_plan.wire_bytes_per_reduction + 4 * 16 * \
+        hplan.n_buckets, "inter bytes did not drop by the inner factor"
     print(f"GWOK proc={proc_id} "
           f"implicit={implicit_loss:.6f}/{implicit_psum:.6f} "
           f"bucketed={bucketed_loss:.6f}/{bucketed_psum:.6f} "
+          f"hier={hier_loss:.6f}/{hier_psum:.6f} "
           f"buckets={engine.bucket_plan.n_buckets}", flush=True)
 
 

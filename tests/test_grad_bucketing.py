@@ -302,7 +302,9 @@ def test_onebit_dense_fallback_still_gets_buckets():
 @pytest.mark.slow
 def test_two_process_bucketed_parity():
     """The bucketed wire over a REAL serialization boundary (2
-    jax.distributed processes, gloo/TCP): both wires converge to the
+    jax.distributed processes, gloo/TCP): implicit, flat-bucketed, and
+    hierarchical (data_outer=2 — one outer group per process, the
+    inter-group hop riding the actual TCP boundary) all converge to the
     same loss/params, and all processes agree."""
     nprocs = 2
     s = socket.socket()
@@ -333,6 +335,11 @@ def test_two_process_bucketed_parity():
     assert len({ln.split(" ", 2)[2] for ln in lines}) == 1, lines
     implicit = lines[0].split("implicit=")[1].split()[0]
     bucketed = lines[0].split("bucketed=")[1].split()[0]
+    hier = lines[0].split("hier=")[1].split()[0]
     il, ip = map(float, implicit.split("/"))
     bl, bp = map(float, bucketed.split("/"))
+    hl, hp = map(float, hier.split("/"))
     assert abs(il - bl) < 1e-4 and abs(ip - bp) / (abs(ip) + 1e-6) < 1e-4
+    # the two-level wire (fp32/fp32) must land on the same training
+    # trajectory as the flat wires over the real TCP boundary
+    assert abs(il - hl) < 1e-4 and abs(ip - hp) / (abs(ip) + 1e-6) < 1e-4

@@ -22,11 +22,25 @@ Two fabrics, following tools/onebit_bench_mp.py:
              serialize/send cost — the fabric where round-5 measured the
              dense step at 270 ms vs 53 ms for the fused onebit wire.
 
+--hierarchy adds the two-level lanes (comm.hierarchy, ZeRO++-style):
+processes map to outer groups (data_outer = nproc on the TCP fabric, 2
+on the single-process mesh), so only the 1/inner-size shard crosses the
+slow boundary per bucket:
+
+  hier             fp32 both levels (exact; parity with `bucketed`)
+  hier_outer_bf16  slow hop compressed to bf16, fast hop exact
+  hier_outer_split slow hop on the 24-bit frexp gather
+  zero2_hier       hierarchical reduce-scatter + hpZ secondary shards
+                   (post-step param gather stays intra-group)
+
+Each hier row reports the measured grad_wire.intra / grad_wire.inter
+counter split beside the plan prediction.
+
 Results are recorded through monitor/artifacts.py into
 bench_artifacts/runs/ + manifest (the PR-2 durable-artifact rule).
 
 Usage: python tools/grad_wire_bench.py [--nproc 2] [--steps 20]
-           [--size nano] [--seq 32]
+           [--size nano] [--seq 32] [--hierarchy]
 """
 
 from __future__ import annotations
@@ -54,6 +68,17 @@ VARIANTS = [
 ]
 
 
+def hier_variants(outer: int):
+    """--hierarchy lanes: two-level reduction with data_outer groups."""
+    base = {"gradient_reduction": "bucketed", "hierarchy": {"outer": outer}}
+    return [
+        ("hier", 0, dict(base)),
+        ("hier_outer_bf16", 0, dict(base, wire_dtype_outer="bf16")),
+        ("hier_outer_split", 0, dict(base, wire_dtype_outer="split")),
+        ("zero2_hier", 2, dict(base)),
+    ]
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -79,8 +104,14 @@ def bench(args, nproc: int, proc_id: int):
     tok = rng.randint(0, 512, (dp, args.seq + 1)).astype(np.int32)
     batch = (tok[:, :-1], tok[:, 1:])
 
+    variants = list(VARIANTS)
+    if args.hierarchy:
+        # processes are the slow-fabric boundary on the TCP lane; the
+        # single-process mesh has no real boundary — split it 2-ways so
+        # the lowering still runs end-to-end (overhead floor)
+        variants += hier_variants(nproc if nproc > 1 else 2)
     results = {}
-    for name, stage, comm in VARIANTS:
+    for name, stage, comm in variants:
         cfg = {
             "train_batch_size": dp,
             "zero_optimization": {"stage": stage},
@@ -114,7 +145,8 @@ def bench(args, nproc: int, proc_id: int):
                  "loss": round(float(loss), 4)}
         if engine.bucket_plan is not None:
             plan = engine.bucket_plan
-            wire = COUNTERS.delta_since(snap).get("grad_wire.reduce", {})
+            deltas = COUNTERS.delta_since(snap)
+            wire = deltas.get("grad_wire.reduce", {})
             entry.update({
                 "n_buckets": plan.n_buckets,
                 "wire": plan.wire,
@@ -124,6 +156,20 @@ def bench(args, nproc: int, proc_id: int):
                 "collectives_per_step": plan.collectives_per_reduction,
                 "counted_wire_bytes": int(wire.get("bytes", 0)),
             })
+            if plan.hierarchical:
+                inner, outer = plan.levels
+                entry.update({
+                    "wire": f"{inner.wire}/{outer.wire}",
+                    "hierarchy": f"outer={outer.size} x inner={inner.size}",
+                    "intra_bytes_per_step":
+                        plan.wire_bytes_intra_per_reduction,
+                    "inter_bytes_per_step":
+                        plan.wire_bytes_inter_per_reduction,
+                    "counted_intra_bytes": int(deltas.get(
+                        "grad_wire.intra", {}).get("bytes", 0)),
+                    "counted_inter_bytes": int(deltas.get(
+                        "grad_wire.inter", {}).get("bytes", 0)),
+                })
         results[name] = entry
 
     if proc_id == 0:
@@ -131,14 +177,19 @@ def bench(args, nproc: int, proc_id: int):
         for name in results:
             results[name]["vs_unfused"] = round(
                 base / max(results[name]["step_ms"], 1e-9), 2)
+        suffix = "_hier" if args.hierarchy else ""
+        # the headline value must track the metric the manifest row is
+        # NAMED for: the hierarchical lane on --hierarchy runs, the flat
+        # bucketed lane otherwise
+        headline = results["hier" if args.hierarchy else "bucketed"]
         print(json.dumps({
             "metric": ("grad_wire_2proc_tcp" if nproc > 1
-                       else "grad_wire_cpu_mesh"),
+                       else "grad_wire_cpu_mesh") + suffix,
             "platform": "cpu",
             "n_params": int(n_params),
             "world": {"processes": nproc, "devices": dp},
             "steps": args.steps,
-            "value": results["bucketed"]["vs_unfused"],
+            "value": headline["vs_unfused"],
             "unit": "x_vs_unfused_dense",
             **results,
         }), flush=True)
@@ -187,6 +238,9 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--size", default="nano")
     ap.add_argument("--seq", type=int, default=32)
+    ap.add_argument("--hierarchy", action="store_true",
+                    help="add the two-level (data_outer x data_inner) "
+                         "lanes; processes map to outer groups")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
     ap.add_argument("--coord", default="")
@@ -212,7 +266,8 @@ def main():
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--proc-id", str(pid), "--coord", coord,
              "--nproc", str(args.nproc), "--steps", str(args.steps),
-             "--size", args.size, "--seq", str(args.seq)],
+             "--size", args.size, "--seq", str(args.seq)]
+            + (["--hierarchy"] if args.hierarchy else []),
             stdout=subprocess.PIPE if pid == 0 else subprocess.DEVNULL,
             stderr=subprocess.STDOUT if pid == 0 else subprocess.DEVNULL,
             env={**os.environ, "JAX_PLATFORMS": "cpu"}))

@@ -39,6 +39,11 @@ def selftest() -> int:
         for step in range(1, 4):
             mon.step_start(step - 1)
             COUNTERS.add("p2p.send", 1024)
+            # hierarchical grad-wire levels: fast-fabric legs + the
+            # slow-fabric shard hop (report renders them as their own
+            # per-level section)
+            COUNTERS.add("grad_wire.intra", 8192, calls=2)
+            COUNTERS.add("grad_wire.inter", 1024, calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -57,7 +62,8 @@ def selftest() -> int:
         assert s["mean_tokens_per_sec"] is not None, s
         md = render_markdown(run)
         for needle in ("Run report", "p2p.send", "Pipeline occupancy",
-                       "11.1%", "forward"):
+                       "11.1%", "forward", "Gradient wire levels",
+                       "inter-group", "slow-fabric share"):
             assert needle in md, f"{needle!r} missing from report"
     print("run_report selftest ok")
     return 0
