@@ -28,6 +28,20 @@ Instrumented sites:
   exactly — plus, for hierarchical plans, the per-fabric split
   `grad_wire.intra` (fast-fabric scatter/gather legs) and
   `grad_wire.inter` (the slow-fabric hop on the 1/inner-size shard).
+* the input pipeline (`input.*`, rendered by monitor/report.py as its
+  own "Input pipeline" section rather than the comm table):
+  `input.host_wait_ms` — wall time the engine's Python thread spent
+  blocked pulling a batch from the host iterator (bytes slot carries
+  integer MICROSECONDS; the report divides back to ms), recorded by
+  `runtime/dataloader.timed_next` on every engine-side pull so
+  prefetch-on/off lanes are directly comparable;
+  `input.h2d_bytes` — batch bytes actually `device_put` by
+  `engine._shard_batch`/`_shard_batch_stacked` (already-placed arrays
+  are skipped and not counted); `input.queue_depth` — PrefetchLoader
+  queue occupancy sampled at each pop (mean = bytes/calls);
+  `input.replicated_batches` — batches whose dim 0 didn't divide the
+  data axis and were replicated (dp x compute for that batch; the
+  dataloader's wraparound tail padding exists to keep this at zero).
 """
 
 from __future__ import annotations

@@ -166,15 +166,50 @@ def render_markdown(run: Dict[str, Any]) -> str:
             acc = any_comm.setdefault(name, {"calls": 0, "bytes": 0})
             acc["calls"] += d["calls"]
             acc["bytes"] += d["bytes"]
-    if any_comm:
+    # input.* counters carry pipeline metrics (µs, queue depths), not
+    # wire bytes — split them out of the comm table into their own section
+    input_counters = {k: v for k, v in any_comm.items()
+                      if k.startswith("input.")}
+    wire_counters = {k: v for k, v in any_comm.items()
+                     if not k.startswith("input.")}
+    if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
         lines.append("")
         lines.append("| counter | calls | bytes |")
         lines.append("|---|---|---|")
-        for name in sorted(any_comm):
-            d = any_comm[name]
+        for name in sorted(wire_counters):
+            d = wire_counters[name]
             lines.append(f"| `{name}` | {d['calls']:,} | "
                          f"{_fmt_bytes(d['bytes'])} |")
+        lines.append("")
+
+    if input_counters:
+        lines.append("## Input pipeline")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        hw = input_counters.get("input.host_wait_ms")
+        if hw:
+            total_ms = hw["bytes"] / 1000.0  # stored as integer µs
+            per = total_ms / hw["calls"] if hw["calls"] else 0.0
+            lines.append(f"| host wait (batch fetch) | {total_ms:,.1f} ms "
+                         f"total over {hw['calls']:,} fetches "
+                         f"({per:.2f} ms/fetch) |")
+        h2d = input_counters.get("input.h2d_bytes")
+        if h2d:
+            lines.append(f"| H2D batch transfer | "
+                         f"{_fmt_bytes(h2d['bytes'])} over "
+                         f"{h2d['calls']:,} device_put dispatches |")
+        qd = input_counters.get("input.queue_depth")
+        if qd and qd["calls"]:
+            lines.append(f"| mean prefetch queue depth | "
+                         f"{qd['bytes'] / qd['calls']:.2f} "
+                         f"(sampled at {qd['calls']:,} pops) |")
+        rep = input_counters.get("input.replicated_batches")
+        if rep:
+            lines.append(f"| replicated (indivisible) batches | "
+                         f"{rep['calls']:,} x dp-replicated, "
+                         f"{_fmt_bytes(rep['bytes'])} |")
         lines.append("")
 
     # hierarchical gradient wire: the per-level (fast/slow fabric) byte

@@ -172,6 +172,64 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
             d, c.COMM_REDUCE_BUCKET_SIZE, zero_config.reduce_bucket_size))
 
 
+class DeepSpeedDataPipelineConfig(DeepSpeedConfigObject):
+    """Async input pipeline (runtime/dataloader.py PrefetchLoader +
+    engine._DeviceFeed).
+
+    "data_pipeline": {
+      "enabled": true,          # master switch (default ON)
+      "prefetch_depth": 2,      # bounded host queue, in batches
+      "num_workers": 1,         # parallel collate threads
+      "device_prefetch": true   # device_put batch N+1 during step N
+    }
+
+    Defaults ON: background collate + device double-buffering hide the
+    host-side gap between step dispatches.  Correctness is unchanged —
+    batch order is deterministic and the loss sequence is byte-identical
+    with the pipeline off (tests/test_data_pipeline.py pins it across
+    all three jitted step paths).  `prefetch_depth: 0` disables host
+    prefetch while keeping device double-buffering, and vice versa.
+    """
+
+    def __init__(self, param_dict):
+        super().__init__()
+        d = param_dict.get(c.DATA_PIPELINE) or {}
+        known = {c.DATA_PIPELINE_ENABLED, c.DATA_PIPELINE_PREFETCH_DEPTH,
+                 c.DATA_PIPELINE_NUM_WORKERS, c.DATA_PIPELINE_DEVICE_PREFETCH}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"data_pipeline: unknown key(s) {sorted(unknown)}; "
+                f"expected a subset of {sorted(known)}")
+        self.enabled = bool(get_scalar_param(
+            d, c.DATA_PIPELINE_ENABLED, c.DATA_PIPELINE_ENABLED_DEFAULT))
+        depth = get_scalar_param(d, c.DATA_PIPELINE_PREFETCH_DEPTH,
+                                 c.DATA_PIPELINE_PREFETCH_DEPTH_DEFAULT)
+        workers = get_scalar_param(d, c.DATA_PIPELINE_NUM_WORKERS,
+                                   c.DATA_PIPELINE_NUM_WORKERS_DEFAULT)
+        for name, val, lo in ((c.DATA_PIPELINE_PREFETCH_DEPTH, depth, 0),
+                              (c.DATA_PIPELINE_NUM_WORKERS, workers, 1)):
+            if isinstance(val, bool) or not isinstance(val, int) or val < lo:
+                raise ValueError(
+                    f"data_pipeline.{name} must be an int >= {lo}, "
+                    f"got {val!r}")
+        self.prefetch_depth = int(depth)
+        self.num_workers = int(workers)
+        self.device_prefetch = bool(get_scalar_param(
+            d, c.DATA_PIPELINE_DEVICE_PREFETCH,
+            c.DATA_PIPELINE_DEVICE_PREFETCH_DEFAULT))
+
+    @property
+    def host_prefetch(self) -> bool:
+        """True when the background-thread host loop should engage."""
+        return self.enabled and self.prefetch_depth > 0
+
+    @property
+    def device_feed(self) -> bool:
+        """True when the engine should double-buffer batches on device."""
+        return self.enabled and self.device_prefetch
+
+
 def get_fp16_enabled(param_dict):
     return get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_ENABLED,
                             c.FP16_ENABLED_DEFAULT)
@@ -306,6 +364,10 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         # gradient-reduction wire (runtime/comm/bucketing.py)
         self.comm_config = DeepSpeedCommConfig(pd, self.zero_config,
                                                world_size=self.world_size)
+
+        # async input pipeline (runtime/dataloader.py PrefetchLoader +
+        # engine._DeviceFeed) — default ON
+        self.data_pipeline_config = DeepSpeedDataPipelineConfig(pd)
 
         # pipeline: use_p2p_channels forces the multi-host channel
         # executor even single-process (the driver's virtual-multichip

@@ -107,6 +107,24 @@ FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
 
 #############################################
+# Async input pipeline (TPU-specific addition; see runtime/dataloader.py
+# PrefetchLoader, engine._DeviceFeed and docs/tutorials/data_pipeline.md).
+# Default ON: host collate runs on background thread(s) and batch N+1's
+# H2D transfer overlaps step N's compute.  The batch stream and loss
+# curve are byte-identical with the pipeline off (pinned in
+# tests/test_data_pipeline.py).
+#############################################
+DATA_PIPELINE = "data_pipeline"
+DATA_PIPELINE_ENABLED = "enabled"
+DATA_PIPELINE_ENABLED_DEFAULT = True
+DATA_PIPELINE_PREFETCH_DEPTH = "prefetch_depth"   # bounded-queue batches
+DATA_PIPELINE_PREFETCH_DEPTH_DEFAULT = 2
+DATA_PIPELINE_NUM_WORKERS = "num_workers"         # parallel collate threads
+DATA_PIPELINE_NUM_WORKERS_DEFAULT = 1
+DATA_PIPELINE_DEVICE_PREFETCH = "device_prefetch"  # double-buffer H2D
+DATA_PIPELINE_DEVICE_PREFETCH_DEFAULT = True
+
+#############################################
 # Precision: fp16 section doubles as the precision section via "type"
 # (EleutherAI fork: PRECISION, runtime/constants.py:127-161)
 #############################################

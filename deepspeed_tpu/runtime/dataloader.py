@@ -1,4 +1,4 @@
-"""Data loading: DeepSpeedDataLoader + RepeatingLoader.
+"""Data loading: DeepSpeedDataLoader + RepeatingLoader + PrefetchLoader.
 
 Reference: deepspeed/runtime/dataloader.py:10,33 (torch DataLoader +
 DistributedSampler). TPU-native redesign: single-controller JAX wants the
@@ -6,15 +6,30 @@ GLOBAL batch assembled on host and sharded over the mesh's data axis by the
 engine, so the loader yields global numpy batches; in multi-process mode
 each process reads its own slice (process_index-strided sampling), matching
 DistributedSampler semantics.
+
+PrefetchLoader is the TPU-native answer to the reference's
+`DataLoader(num_workers, pin_memory)`: the per-sample fetch + collate loop
+runs on background thread(s) feeding a bounded queue, so the host
+assembles batch N+1 while the device executes step N.  Batch ORDER is
+deterministic regardless of worker count (round-robin task assignment +
+in-order consumption), threads shut down cleanly on close()/GC/
+StopIteration, and worker exceptions re-raise at the consumer.
 """
 
 from __future__ import annotations
 
+import queue
+import threading
+import time
+import weakref
 from typing import Any, Callable, Iterable, Optional
 
 import numpy as np
 
 import jax
+
+from ..monitor.counters import COUNTERS
+from ..utils.logging import logger
 
 
 class RepeatingLoader:
@@ -59,6 +74,14 @@ class DeepSpeedDataLoader:
     dataset: any indexable (len + __getitem__) of samples (arrays, tuples,
     dicts). Yields GLOBAL per-process batches as numpy pytrees; the engine
     shards dim 0 over the data mesh axis.
+
+    drop_last=False pads the tail batch to full size by WRAPPING around
+    this shard's sample order (DistributedSampler-style): a short tail
+    would fall into the engine's replicate-over-data-axis fallback and
+    cost dp x compute for that batch, so the few duplicated samples are
+    the cheaper trade.  The duplicates slightly overweight the wrapped
+    samples in that batch's loss — acceptable for training; for exact
+    evaluation sums, account for `len(dataset)` yourself.
     """
 
     def __init__(self, dataset, batch_size: int,
@@ -102,7 +125,12 @@ class DeepSpeedDataLoader:
     def __len__(self):
         return self.len
 
-    def __iter__(self):
+    def _batch_indices(self):
+        """Yield this shard's per-batch sample-index arrays for the
+        CURRENT epoch.  Pure numpy (cheap) — the expensive part
+        (dataset[j] + collate) lives in _materialize, so PrefetchLoader
+        workers can collate different batches in parallel while this
+        generator fixes the deterministic order."""
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
@@ -115,10 +143,257 @@ class DeepSpeedDataLoader:
             order = np.concatenate([order, order[:total - n]])
         shard_idx = order[self.shard_id::self.num_shards]
         for i in range(0, len(shard_idx) - self._per_shard + 1, self._per_shard):
-            batch_ids = shard_idx[i:i + self._per_shard]
-            yield self.collate_fn([self.dataset[int(j)] for j in batch_ids])
+            yield shard_idx[i:i + self._per_shard]
         if not self.drop_last:
             tail = len(shard_idx) % self._per_shard
             if tail:
-                batch_ids = shard_idx[len(shard_idx) - tail:]
-                yield self.collate_fn([self.dataset[int(j)] for j in batch_ids])
+                # wraparound pad to _per_shard: a full-size tail keeps the
+                # batch on the sharded (not replicated) engine path.
+                # np.resize TILES the shard order, so even a shard with
+                # fewer samples than _per_shard pads to full size
+                ids = shard_idx[len(shard_idx) - tail:]
+                pad = np.resize(shard_idx, self._per_shard - tail)
+                yield np.concatenate([ids, pad])
+
+    def _materialize(self, batch_ids):
+        """Sample fetch + collate for one index array (the per-batch unit
+        of work PrefetchLoader parallelizes)."""
+        return self.collate_fn([self.dataset[int(j)] for j in batch_ids])
+
+    def __iter__(self):
+        for batch_ids in self._batch_indices():
+            yield self._materialize(batch_ids)
+
+
+# ---------------------------------------------------------------------------
+# PrefetchLoader — background fetch+collate with a bounded queue
+# ---------------------------------------------------------------------------
+
+_DONE = object()   # producer sentinel: the underlying stream is exhausted
+
+
+class _WorkerError:
+    """Exception carrier: re-raised at the consumer, in order."""
+
+    def __init__(self, exc):
+        self.exc = exc
+
+
+def _shutdown(stop: threading.Event, queues, threads) -> None:
+    """Module-level so weakref.finalize holds no reference to the
+    iterator: signal stop, drain the queues (unblocking producers stuck
+    on a full put), and join the threads."""
+    stop.set()
+    for q in queues:
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+    me = threading.current_thread()
+    for t in threads:
+        if t is not me:  # GC may run the finalizer on a producer itself
+            t.join(timeout=5.0)
+
+
+def _bounded_put(stop: threading.Event, q: queue.Queue, item) -> bool:
+    """Bounded put that aborts promptly on shutdown."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+# producer bodies are MODULE-LEVEL: a bound-method thread target would
+# keep the iterator alive from its own worker threads (a cycle that
+# defers GC teardown and can run the finalizer on a producer)
+
+def _index_producer(stop, loader, tasks, worker_id, n_workers, q):
+    try:
+        for i in range(worker_id, len(tasks), n_workers):
+            if stop.is_set():
+                return
+            if not _bounded_put(stop, q, loader._materialize(tasks[i])):
+                return
+    except BaseException as e:  # noqa: BLE001 — carried to the consumer
+        _bounded_put(stop, q, _WorkerError(e))
+        return
+    _bounded_put(stop, q, _DONE)
+
+
+def _stream_producer(stop, it, q):
+    try:
+        while not stop.is_set():
+            try:
+                item = next(it)
+            except StopIteration:
+                break
+            if not _bounded_put(stop, q, item):
+                return
+    except BaseException as e:  # noqa: BLE001
+        _bounded_put(stop, q, _WorkerError(e))
+        return
+    _bounded_put(stop, q, _DONE)
+
+
+class _PrefetchIterator:
+    """One epoch of prefetched batches.  Two producer layouts:
+
+    * index mode (the wrapped loader exposes _batch_indices/_materialize,
+      i.e. DeepSpeedDataLoader): batch i is collated by worker
+      i % num_workers, each worker feeding its own bounded queue; the
+      consumer pops queue i % num_workers — parallel collate, identical
+      order.
+    * stream mode (any other iterable): iteration is inherently serial,
+      so ONE producer thread pulls next() into a single bounded queue.
+    """
+
+    def __init__(self, loader, depth: int, num_workers: int):
+        self._stop = threading.Event()
+        self._exhausted = False
+        indexable = (hasattr(loader, "_batch_indices")
+                     and hasattr(loader, "_materialize"))
+        workers = max(1, int(num_workers)) if indexable else 1
+        depth = max(1, int(depth))
+        if num_workers > 1 and not indexable:
+            logger.warning(
+                "PrefetchLoader: num_workers > 1 needs an index-protocol "
+                "loader (DeepSpeedDataLoader); falling back to one "
+                "producer thread for a generic iterable")
+        # total buffered batches across workers stays ~depth
+        per_q = max(1, -(-depth // workers))
+        self._queues = [queue.Queue(maxsize=per_q) for _ in range(workers)]
+        self._next_q = 0
+        if indexable:
+            # snapshot the epoch's batch order ONCE (cheap numpy) so every
+            # worker agrees on the task list even if set_epoch races later
+            tasks = list(loader._batch_indices())
+            self._threads = [
+                threading.Thread(
+                    target=_index_producer,
+                    args=(self._stop, loader, tasks, w, workers,
+                          self._queues[w]),
+                    name=f"dstpu-prefetch-{w}", daemon=True)
+                for w in range(workers)]
+        else:
+            self._threads = [threading.Thread(
+                target=_stream_producer,
+                args=(self._stop, iter(loader), self._queues[0]),
+                name="dstpu-prefetch-0", daemon=True)]
+        for t in self._threads:
+            t.start()
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._stop, self._queues, self._threads)
+
+    # -- consumer ----------------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._exhausted:
+            raise StopIteration
+        q = self._queues[self._next_q]
+        # observability: queue depth at pop time — how far ahead the
+        # producers are running (input.queue_depth mean = bytes/calls)
+        COUNTERS.add("input.queue_depth", sum(x.qsize()
+                                              for x in self._queues))
+        while True:
+            if self._stop.is_set():
+                raise StopIteration
+            try:
+                item = q.get(timeout=0.5)
+                break
+            except queue.Empty:
+                # producer may have died without a sentinel (interpreter
+                # teardown): fail closed instead of hanging forever —
+                # with one last non-blocking pop to close the window
+                # where the item landed between timeout and the check
+                if not any(t.is_alive() for t in self._threads):
+                    try:
+                        item = q.get_nowait()
+                        break
+                    except queue.Empty:
+                        self.close()
+                        raise StopIteration
+        if item is _DONE:
+            # round-robin invariant: the FIRST _DONE (always on the queue
+            # owning the next batch index) means no later batch exists on
+            # any other queue — drain and stop
+            self.close()
+            raise StopIteration
+        if isinstance(item, _WorkerError):
+            self.close()
+            raise item.exc
+        self._next_q = (self._next_q + 1) % len(self._queues)
+        return item
+
+    def close(self):
+        """Idempotent: stop producers, drain queues, join threads."""
+        self._exhausted = True
+        if self._finalizer.alive:
+            self._finalizer()
+
+
+class PrefetchLoader:
+    """Run a loader's fetch+collate on background thread(s) with a
+    bounded queue (`prefetch_depth` batches buffered, `num_workers`
+    parallel collate threads when the wrapped loader supports it).
+
+    Transparent: same batches, same order, same dtypes — `train_batch`
+    parity with the unwrapped loader is pinned byte-exact in
+    tests/test_data_pipeline.py.  Forwards len()/set_epoch so it can
+    wrap DeepSpeedDataLoader under RepeatingLoader unchanged."""
+
+    def __init__(self, loader: Iterable[Any], prefetch_depth: int = 2,
+                 num_workers: int = 1):
+        if prefetch_depth < 1:
+            raise ValueError(
+                f"PrefetchLoader: prefetch_depth must be >= 1, "
+                f"got {prefetch_depth}")
+        if num_workers < 1:
+            raise ValueError(
+                f"PrefetchLoader: num_workers must be >= 1, "
+                f"got {num_workers}")
+        self.loader = loader
+        self.prefetch_depth = int(prefetch_depth)
+        self.num_workers = int(num_workers)
+        self._live_iter: Optional[weakref.ReferenceType] = None
+
+    def __len__(self):
+        return len(self.loader)
+
+    def set_epoch(self, epoch: int):
+        if hasattr(self.loader, "set_epoch"):
+            self.loader.set_epoch(epoch)
+
+    def __iter__(self):
+        # one live epoch at a time: iterating again tears the previous
+        # iterator's threads down first (RepeatingLoader re-iters per epoch)
+        prev = self._live_iter() if self._live_iter is not None else None
+        if prev is not None:
+            prev.close()
+        it = _PrefetchIterator(self.loader, self.prefetch_depth,
+                               self.num_workers)
+        self._live_iter = weakref.ref(it)
+        return it
+
+    def close(self):
+        prev = self._live_iter() if self._live_iter is not None else None
+        if prev is not None:
+            prev.close()
+
+
+def timed_next(data_iter):
+    """next(data_iter) with the host-blocked wall time recorded as
+    `input.host_wait_ms` (stored in integer microseconds; the report
+    renders ms).  Every engine-side pull from a host iterator goes
+    through here so prefetch-on/off lanes measure the same thing."""
+    t0 = time.perf_counter()
+    batch = next(data_iter)
+    COUNTERS.add("input.host_wait_ms",
+                 int((time.perf_counter() - t0) * 1e6))
+    return batch

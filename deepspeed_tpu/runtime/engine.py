@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+from collections import deque
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -48,7 +49,8 @@ from ..utils.logging import log_dist, logger
 from . import checkpointing as ckpt_io
 from . import constants as const
 from .config import DeepSpeedConfig
-from .dataloader import DeepSpeedDataLoader, RepeatingLoader
+from .dataloader import (DeepSpeedDataLoader, PrefetchLoader,
+                         RepeatingLoader, timed_next)
 from .fp16.loss_scaler import create_loss_scaler
 from .fp16.onebit import OnebitAdam, OnebitLamb
 from .lr_schedules import SCHEDULERS
@@ -62,6 +64,71 @@ from .zero.partition import ZeroShardingPlan
 
 DTYPES = {"float32": jnp.float32, "float16": jnp.float16,
           "bfloat16": jnp.bfloat16}
+
+# deferred steps_per_print log entries kept in flight before the oldest
+# is force-settled (each holds one device scalar; tiny either way)
+_STEP_LOG_RING = 4
+
+
+class _DeviceFeed:
+    """Device-side double buffering for the input pipeline.
+
+    Owns a host iterator and keeps AT MOST ONE batch placed on device
+    ahead of the consumer: `next()` returns the current step's batch
+    (fetch+place synchronously only on the first call or when lookahead
+    is off); `schedule()` — called right after a step program is
+    dispatched — pulls batch N+1 from the host iterator (an instant
+    queue pop when PrefetchLoader runs underneath) and enqueues its
+    `device_put` toward the NamedSharding target, so the H2D transfer
+    runs while step N's program computes.
+
+    Donation-safe by construction: batch arguments are never in the step
+    programs' donate_argnums and every place() builds fresh device
+    arrays, so rotating to the next buffer cannot alias storage a
+    running program still reads.
+
+    `lookahead` engages only for the engine-owned training iterator:
+    prefetching ahead of a USER-supplied iterator would consume batches
+    the caller may still expect to own.
+    """
+
+    _EMPTY = object()
+
+    def __init__(self, source, fetch, place, scan: bool,
+                 lookahead: bool = True):
+        self.source = source          # identity key (the host iterator)
+        self.scan = scan              # payload unit: stacked global batch?
+        self._fetch = fetch
+        self._place = place
+        self._lookahead = lookahead
+        self._pending = self._EMPTY
+        self._exhausted = False
+
+    @property
+    def has_pending(self) -> bool:
+        return self._pending is not self._EMPTY
+
+    def next(self):
+        if self._pending is not self._EMPTY:
+            batch = self._pending
+            self._pending = self._EMPTY
+            return batch
+        if self._exhausted:
+            raise StopIteration
+        return self._place(self._fetch())
+
+    def schedule(self) -> None:
+        """Fetch + device-place the NEXT batch; call right after the
+        step dispatch returns (the program runs while this transfers)."""
+        if not self._lookahead or self._exhausted or \
+                self._pending is not self._EMPTY:
+            return
+        try:
+            host = self._fetch()
+        except StopIteration:
+            self._exhausted = True
+            return
+        self._pending = self._place(host)
 
 
 class DeepSpeedEngine:
@@ -198,6 +265,9 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._pending_overflow = None
         self._pending_full = None
+        self._device_feed = None        # owned-iterator double buffer
+        self._user_device_feed = None   # latest user-iterator feed
+        self._step_log_ring = deque()   # deferred steps_per_print scalars
         self.run_monitor = self._init_run_monitor()
 
     # ------------------------------------------------------------------
@@ -271,6 +341,9 @@ class DeepSpeedEngine:
         self._last_loss = None
         self._pending_overflow = None
         self._pending_full = None
+        self._device_feed = None
+        self._user_device_feed = None
+        self._step_log_ring = deque()
         self.run_monitor = self._init_run_monitor()
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
@@ -560,11 +633,31 @@ class DeepSpeedEngine:
     def finalize_monitoring(self):
         """Flush the event stream and write end-of-run summaries.  Under
         multi-host the summary merge is collective — call on every rank
-        (or skip entirely; per-step events are already durable)."""
+        (or skip entirely; per-step events are already durable).  Also
+        settles any deferred step-log lines and stops the input
+        pipeline's background threads."""
+        self._drain_step_log(force=True)
+        self.close_data_pipeline()
         if self.run_monitor is not None:
             self.run_monitor.close()
         if self.monitor is not None:
             self.monitor.flush()
+
+    def close_data_pipeline(self):
+        """Stop the engine-owned PrefetchLoader's background threads and
+        drop the device-side double buffer.  Idempotent; engine GC tears
+        the threads down too (the prefetch iterator carries a finalizer)
+        — this is the deterministic hook."""
+        self._device_feed = None
+        self._user_device_feed = None
+        it = getattr(self, "_train_iter", None)
+        if it is not None:
+            # _train_iter is the RepeatingLoader; .loader is the
+            # (possibly Prefetch-wrapped) base loader
+            loader = getattr(it, "loader", None)
+            if hasattr(loader, "close"):
+                loader.close()
+            del self._train_iter
 
     # ------------------------------------------------------------------
     # jitted step programs
@@ -1009,6 +1102,7 @@ class DeepSpeedEngine:
     def _shard_batch(self, batch):
         """Place the global batch sharded over the data axis (dim 0)."""
         mesh = self.mesh_info.mesh
+        replicated = [0]  # bytes of indivisible leaves in THIS batch
 
         def put(x):
             x = jnp.asarray(x)
@@ -1016,7 +1110,10 @@ class DeepSpeedEngine:
             if batch_shardable(x.shape, max(1, self.dp_world_size)):
                 spec[0] = self.mesh_info.data_spec
             elif x.ndim:
-                # replicating costs dp x memory/compute — tell the user once
+                # replicating costs dp x memory/compute — count the
+                # batch (input.replicated_batches, rendered by the run
+                # report) and tell the user once
+                replicated[0] += int(x.nbytes)
                 if not getattr(self, "_warned_replicated_batch", False):
                     self._warned_replicated_batch = True
                     logger.warning(
@@ -1027,9 +1124,16 @@ class DeepSpeedEngine:
             if isinstance(x, jax.Array) and \
                     x.sharding.is_equivalent_to(target, x.ndim):
                 return x  # already placed — skip a per-step dispatch
+            COUNTERS.add("input.h2d_bytes", int(x.nbytes))
             return jax.device_put(x, target)
 
-        return jax.tree_util.tree_map(put, batch)
+        placed = jax.tree_util.tree_map(put, batch)
+        if replicated[0]:
+            # ONE event per batch (calls counts batches, bytes their
+            # replicated payload) — per-leaf counting would inflate with
+            # the batch pytree's arity
+            COUNTERS.add("input.replicated_batches", replicated[0])
+        return placed
 
     def _next_rng(self):
         self._rng_key, sub = jax.random.split(self._rng_key)
@@ -1363,16 +1467,47 @@ class DeepSpeedEngine:
             self._resolve_pending_overflow()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
+        self._queue_step_log()
+        self._emit_run_event(grad_norm=grad_norm, overflow=overflow)
+
+    def _queue_step_log(self):
+        """steps_per_print logging WITHOUT a device sync: the loss-scale
+        scalar is usually still in flight right after the step dispatch,
+        so `float()`-ing it here would serialize the Python thread
+        against device compute every print window.  Instead the device
+        scalar rides a small FIFO ring and the line prints on a later
+        step once its buffer is ready — the same deferred settlement
+        _resolve_pending_overflow applies to the overflow flag."""
         if self.steps_per_print() and \
                 self.global_steps % self.steps_per_print() == 0:
-            cur = self._current_lr()
-            lr_str = f"{cur:.3e}" if cur is not None else "optimizer-default"
+            self._step_log_ring.append(
+                (self.global_steps, self._current_lr(),
+                 self.tput_timer.avg_samples_per_sec(),
+                 self._scaler_state["cur_scale"]))
+        self._drain_step_log()
+
+    def _drain_step_log(self, force: bool = False):
+        """Emit queued step lines whose scalars have settled (in order);
+        `force` (finalize/teardown) and a full ring settle regardless —
+        the ring bounds staleness, it never drops a line."""
+        ring = self._step_log_ring
+        while ring:
+            step, lr, sps, scale = ring[0]
+            if not force and len(ring) <= _STEP_LOG_RING:
+                ready_fn = getattr(scale, "is_ready", None)
+                if ready_fn is not None:
+                    try:
+                        ready = ready_fn()
+                    except Exception:
+                        ready = True  # no async view: float() below is safe
+                    if not ready:
+                        return
+            ring.popleft()
+            lr_str = f"{lr:.3e}" if lr is not None else "optimizer-default"
             log_dist(
-                f"step={self.global_steps}, lr={lr_str}, "
-                f"loss_scale={float(self._scaler_state['cur_scale'])}, "
-                f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
-                ranks=[0])
-        self._emit_run_event(grad_norm=grad_norm, overflow=overflow)
+                f"step={step}, lr={lr_str}, "
+                f"loss_scale={float(scale)}, "
+                f"samples/sec={sps:.1f}", ranks=[0])
 
     def _fused_step_bookkeeping(self):
         """Host-side tail of the fused (gas==1) step: the device update was
@@ -1395,15 +1530,7 @@ class DeepSpeedEngine:
             self._resolve_pending_overflow()
         self._emit_monitor_scalars()
         self.tput_timer.stop(report_speed=False)
-        if self.steps_per_print() and \
-                self.global_steps % self.steps_per_print() == 0:
-            cur = self._current_lr()
-            lr_str = f"{cur:.3e}" if cur is not None else "optimizer-default"
-            log_dist(
-                f"step={self.global_steps}, lr={lr_str}, "
-                f"loss_scale={float(self._scaler_state['cur_scale'])}, "
-                f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
-                ranks=[0])
+        self._queue_step_log()
         self._emit_run_event(grad_norm=_grad_norm, overflow=overflow)
 
     def _resolve_pending_overflow(self):
@@ -1491,6 +1618,85 @@ class DeepSpeedEngine:
         self.tput_timer.stop(report_speed=False)
         self._emit_run_event(overflow=overflow)
 
+    def _wrap_prefetch(self, loader):
+        """Wrap the engine-owned loader in PrefetchLoader when the
+        data_pipeline config asks for host-side background collate."""
+        dp = self._config.data_pipeline_config
+        if not dp.host_prefetch:
+            return loader
+        return PrefetchLoader(loader, prefetch_depth=dp.prefetch_depth,
+                              num_workers=dp.num_workers)
+
+    def _data_feed(self, data_iter, scan: bool) -> Optional[_DeviceFeed]:
+        """The (cached) device double-buffer bound to `data_iter`, or
+        None when device prefetch is off / the path streams host-side
+        (ZeRO-Infinity consumes host batches directly).
+
+        Two cache slots: the engine-OWNED iterator's feed (the only one
+        with lookahead, i.e. the only one that can hold a prefetched
+        batch) and the latest USER iterator's feed.  Keeping them apart
+        means a train_batch(user_iter) call can never evict an owned
+        feed whose pending batch was already consumed from the training
+        stream — that batch survives for the next train_batch()."""
+        dp = self._config.data_pipeline_config
+        if not dp.device_feed or self._infinity is not None:
+            return None
+        owned = data_iter is getattr(self, "_train_iter", None)
+        feed = self._device_feed if owned else self._user_device_feed
+        if feed is not None and feed.source is data_iter:
+            if feed.scan == scan:
+                return feed
+            if feed.has_pending:
+                # a prefetched batch is already placed for the OTHER
+                # path's payload shape; silently re-slicing it would be
+                # easy to get subtly wrong — fail loud instead
+                raise RuntimeError(
+                    "data_pipeline: the train_batch step path changed "
+                    "mid-accumulation with a prefetched batch in flight "
+                    "(manual forward() calls interleaved with "
+                    "train_batch?); call train_batch only at "
+                    "accumulation boundaries or disable "
+                    "data_pipeline.device_prefetch")
+        if scan:
+            gas = self.gradient_accumulation_steps()
+
+            def _stack(*leaves):
+                # host batches stack as numpy (one H2D for the whole
+                # global batch at place time); leaves already on device
+                # stack as jnp — np.asarray on them would be a blocking
+                # D2H round-trip the non-feed path never pays
+                if any(isinstance(l, jax.Array) for l in leaves):
+                    return jnp.stack([jnp.asarray(l) for l in leaves])
+                return np.stack([np.asarray(l) for l in leaves])
+
+            def fetch():
+                micro = [timed_next(data_iter) for _ in range(gas)]
+                try:
+                    stacked = jax.tree_util.tree_map(_stack, *micro)
+                except (ValueError, TypeError):
+                    # heterogeneous micro batches can't stack: hand the
+                    # raw list back for the per-micro fallback
+                    return ("raw", micro)
+                return ("stacked", stacked)
+
+            def place(tagged):
+                tag, payload = tagged
+                if tag == "stacked":
+                    payload = self._shard_batch_stacked(payload)
+                return (tag, payload)
+        else:
+            def fetch():
+                return timed_next(data_iter)
+
+            place = self._shard_batch
+        feed = _DeviceFeed(data_iter, fetch, place, scan=scan,
+                           lookahead=owned)
+        if owned:
+            self._device_feed = feed
+        else:
+            self._user_device_feed = feed
+        return feed
+
     def train_batch(self, data_iter=None):
         """Convenience: run a full global batch (gas micro steps + update).
         Returns the mean loss (reference PipelineEngine.train_batch parity
@@ -1498,45 +1704,71 @@ class DeepSpeedEngine:
 
         With gas > 1 on the standard device path this compiles the WHOLE
         global batch (scan over micro steps + optimizer) into one program
-        — a single host dispatch per global batch."""
+        — a single host dispatch per global batch.
+
+        Input pipeline (config "data_pipeline", default ON): the
+        engine-owned iterator runs fetch+collate on background threads
+        (PrefetchLoader) and the next batch's H2D transfer is dispatched
+        while the current step's program runs (_DeviceFeed), so the host
+        gap between step dispatches collapses to a queue pop."""
         if data_iter is None:
             if self.training_dataloader is None:
                 raise ValueError("train_batch needs data_iter or training_data")
-            data_iter = self._train_iter if hasattr(self, "_train_iter") else \
-                iter(RepeatingLoader(self.training_dataloader))
-            self._train_iter = data_iter
-        if "full_scan" in self._step_fns and self.micro_steps % \
-                self.gradient_accumulation_steps() == 0:
-            return self._scan_train_batch(data_iter)
+            if not hasattr(self, "_train_iter"):
+                self._train_iter = iter(RepeatingLoader(
+                    self._wrap_prefetch(self.training_dataloader)))
+            data_iter = self._train_iter
+        use_scan = ("full_scan" in self._step_fns and self.micro_steps %
+                    self.gradient_accumulation_steps() == 0)
+        feed = self._data_feed(data_iter, scan=use_scan)
+        if use_scan:
+            return self._scan_train_batch(data_iter, feed)
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
-            batch = next(data_iter)
+            batch = feed.next() if feed is not None else timed_next(data_iter)
             losses.append(self.forward(batch))
             self.backward()
+            if feed is not None:
+                feed.schedule()  # H2D of micro N+1 rides under micro N
         self.step()
         return jnp.mean(jnp.stack(losses))
 
-    def _scan_train_batch(self, data_iter):
+    def _scan_train_batch(self, data_iter, feed=None):
         gas = self.gradient_accumulation_steps()
-        micro_batches = [next(data_iter) for _ in range(gas)]
-        try:
-            stacked = jax.tree_util.tree_map(
-                lambda *leaves: jnp.stack(
-                    [jnp.asarray(l) for l in leaves]), *micro_batches)
-        except (ValueError, TypeError):
-            # heterogeneous micro batches can't stack: fall back
-            for batch in micro_batches:
-                self.forward(batch)
-                self.backward()
-            self.step()
-            return self._last_loss
+        if feed is not None:
+            tag, payload = feed.next()
+            if tag == "raw":
+                # heterogeneous micro batches can't stack: fall back
+                for batch in payload:
+                    self.forward(batch)
+                    self.backward()
+                self.step()
+                return self._last_loss
+            stacked = payload  # already device-placed by the feed
+        else:
+            micro_batches = [timed_next(data_iter) for _ in range(gas)]
+            try:
+                stacked = jax.tree_util.tree_map(
+                    lambda *leaves: jnp.stack(
+                        [jnp.asarray(l) for l in leaves]), *micro_batches)
+            except (ValueError, TypeError):
+                # heterogeneous micro batches can't stack: fall back
+                for batch in micro_batches:
+                    self.forward(batch)
+                    self.backward()
+                self.step()
+                return self._last_loss
         self._resolve_pending_overflow()
         rm = self.run_monitor
         if rm is not None:
             rm.step_start(self.global_steps)
         self.tput_timer.start()
         stacked = self._shard_batch_stacked(stacked)
-        rngs = jnp.stack([self._next_rng() for _ in range(gas)])
+        # ONE split dispatch for the whole global batch (a python loop of
+        # _next_rng() costs gas separate jax.random.split dispatches):
+        # key state folds forward once, per-micro keys peel off the rest
+        keys = jax.random.split(self._rng_key, gas + 1)
+        self._rng_key, rngs = keys[0], keys[1:]
         theta = jnp.asarray(
             self.progressive_layer_drop.get_theta()
             if self.progressive_layer_drop else 1.0, jnp.float32)
@@ -1551,6 +1783,10 @@ class DeepSpeedEngine:
             self._params, self._opt_state, self._scaler_state, stacked,
             rngs, lr, theta)
         self._account_grad_wire(events=gas)
+        if feed is not None:
+            # the scan program is in flight: collate + H2D of the NEXT
+            # global batch overlap it (before any sync-closing span)
+            feed.schedule()
         if sp is not None:
             sp.close(sync=loss if rm.sync_timing else None)
         self._consume_extras(extras)
@@ -1576,6 +1812,7 @@ class DeepSpeedEngine:
             if isinstance(x, jax.Array) and \
                     x.sharding.is_equivalent_to(target, x.ndim):
                 return x
+            COUNTERS.add("input.h2d_bytes", int(x.nbytes))
             return jax.device_put(x, target)
 
         return jax.tree_util.tree_map(put, stacked)

@@ -28,14 +28,25 @@ def test_batching_shapes_and_length():
     assert x.shape == (8, 4) and y.shape == (8,)
 
 
-def test_drop_last_false_yields_tail():
+def test_drop_last_false_pads_tail_by_wrapping():
+    """drop_last=False yields a FULL-SIZE tail batch padded by wrapping
+    around the shard's sample order: a short tail would fall into the
+    engine's replicate-over-data-axis fallback (dp x compute for that
+    batch), so the loader pads instead and documents the duplication."""
     data = _dataset(42)
     loader = DeepSpeedDataLoader(data, batch_size=8, drop_last=False,
                                  data_parallel_world_size=1,
                                  data_parallel_rank=0)
     batches = list(loader)
     assert len(batches) == len(loader) == 6
-    assert batches[-1][0].shape[0] == 2
+    x, y = batches[-1]
+    assert x.shape[0] == 8              # full-size, never replicated
+    # the 2 genuine tail samples come first, then wraparound from the
+    # start of this shard's (unshuffled) order: ids 40,41,0,1,2,3,4,5
+    assert [int(i) for i in y] == [40, 41, 0, 1, 2, 3, 4, 5]
+    # every sample still covered across the epoch
+    seen = {int(i) for b in batches for i in b[1]}
+    assert seen == set(range(42))
 
 
 def test_shuffle_is_epoch_deterministic():

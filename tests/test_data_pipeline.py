@@ -1,0 +1,409 @@
+"""Async input pipeline: PrefetchLoader determinism + shutdown hygiene,
+device double-buffering parity (prefetch on vs off byte-identical across
+all three jitted step paths x ZeRO stage), input.* counter accounting,
+and the bench tool's CPU dry-run."""
+
+import gc
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.monitor.counters import COUNTERS
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader,
+                                              PrefetchLoader,
+                                              RepeatingLoader)
+from tests.simple_model import SimpleModel, random_dataset
+
+
+def _dataset(n=48, d=4):
+    rng = np.random.RandomState(0)
+    return [(rng.randn(d).astype(np.float32), np.int32(i)) for i in range(n)]
+
+
+def _prefetch_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("dstpu-prefetch")]
+
+
+def _batches(loader):
+    return [(np.asarray(x), np.asarray(y)) for x, y in loader]
+
+
+# ---------------------------------------------------------------------------
+# PrefetchLoader: determinism
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("depth,workers", [(1, 1), (2, 1), (4, 2), (2, 3)])
+def test_prefetch_preserves_order_and_bytes(depth, workers):
+    """Same seed => byte-identical batch sequence, any depth/worker mix
+    (round-robin task assignment pins the order)."""
+    data = _dataset(48)
+    plain = DeepSpeedDataLoader(data, batch_size=8, shuffle=True,
+                                data_parallel_world_size=1,
+                                data_parallel_rank=0)
+    pre = PrefetchLoader(
+        DeepSpeedDataLoader(data, batch_size=8, shuffle=True,
+                            data_parallel_world_size=1,
+                            data_parallel_rank=0),
+        prefetch_depth=depth, num_workers=workers)
+    a, b = _batches(plain), _batches(pre)
+    assert len(a) == len(b) == 6
+    for (xa, ya), (xb, yb) in zip(a, b):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+    # epochs advance identically through the wrapper
+    plain.set_epoch(1)
+    pre.set_epoch(1)
+    for (xa, ya), (xb, yb) in zip(_batches(plain), _batches(pre)):
+        np.testing.assert_array_equal(xa, xb)
+        np.testing.assert_array_equal(ya, yb)
+
+
+def test_prefetch_generic_iterable_stream_mode():
+    """Non-indexable iterables run the single-producer stream mode with
+    the same output sequence."""
+    def gen():
+        for i in range(7):
+            yield np.full((4,), i, np.float32)
+
+    class Iterable:
+        def __iter__(self):
+            return gen()
+
+    out = list(PrefetchLoader(Iterable(), prefetch_depth=3))
+    assert len(out) == 7
+    for i, x in enumerate(out):
+        np.testing.assert_array_equal(x, np.full((4,), i, np.float32))
+
+
+def test_prefetch_under_repeating_loader_cycles():
+    data = _dataset(16)
+    rep = iter(RepeatingLoader(PrefetchLoader(
+        DeepSpeedDataLoader(data, batch_size=8,
+                            data_parallel_world_size=1,
+                            data_parallel_rank=0), prefetch_depth=2)))
+    got = [next(rep) for _ in range(5)]  # 2-batch epoch cycled 2.5x
+    np.testing.assert_array_equal(got[0][0], got[2][0])
+    np.testing.assert_array_equal(got[1][0], got[3][0])
+
+
+def test_prefetch_validation_and_exception_propagation():
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        PrefetchLoader(_dataset(8), prefetch_depth=0)
+    with pytest.raises(ValueError, match="num_workers"):
+        PrefetchLoader(_dataset(8), num_workers=0)
+
+    class Poisoned:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            if i == 9:
+                raise RuntimeError("bad sample")
+            return np.zeros(2, np.float32)
+
+    loader = PrefetchLoader(
+        DeepSpeedDataLoader(Poisoned(), batch_size=4,
+                            data_parallel_world_size=1,
+                            data_parallel_rank=0),
+        prefetch_depth=2, num_workers=2)
+    it = iter(loader)
+    next(it)  # batch 0 (samples 0-3) is fine
+    next(it)  # batch 1 (samples 4-7) is fine
+    with pytest.raises(RuntimeError, match="bad sample"):
+        for _ in range(4):
+            next(it)
+    # the error tore the pipeline down
+    assert not _prefetch_threads()
+
+
+# ---------------------------------------------------------------------------
+# PrefetchLoader: shutdown hygiene (no leaked threads)
+# ---------------------------------------------------------------------------
+
+def test_no_leaked_threads_after_exhaustion_close_and_gc():
+    base = set(threading.enumerate())
+    data = _dataset(32)
+
+    def mk():
+        return PrefetchLoader(
+            DeepSpeedDataLoader(data, batch_size=8,
+                                data_parallel_world_size=1,
+                                data_parallel_rank=0),
+            prefetch_depth=2, num_workers=2)
+
+    # (a) StopIteration drains the workers
+    assert len(list(mk())) == 4
+    # (b) explicit close mid-stream
+    it = iter(mk())
+    next(it)
+    it.close()
+    it.close()  # idempotent
+    # (c) iterator GC'd mid-stream without close
+    it2 = iter(mk())
+    next(it2)
+    del it2
+    gc.collect()
+    deadline = time.time() + 5
+    while _prefetch_threads() and time.time() < deadline:
+        time.sleep(0.02)
+    assert not _prefetch_threads()
+    assert set(threading.enumerate()) - base == set()
+
+
+# ---------------------------------------------------------------------------
+# Engine parity: prefetch on (default) vs off, all three step paths
+# ---------------------------------------------------------------------------
+
+def _cfg(gas, stage=0, pipeline=True, offload=False, **over):
+    zero = {"stage": stage}
+    if offload:
+        zero["cpu_offload"] = True
+    cfg = {
+        "train_batch_size": 32,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": zero,
+        "mesh": {"data": 8},
+        "steps_per_print": 0,
+    }
+    if not pipeline:
+        cfg["data_pipeline"] = {"enabled": False}
+    cfg.update(over)
+    return cfg
+
+
+def _run(cfg, steps=6):
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=cfg,
+                               training_data=random_dataset(n=256))
+    losses = [float(engine.train_batch()) for _ in range(steps)]
+    params = [np.asarray(p) for p in
+              jax.tree_util.tree_leaves(engine.params)]
+    engine.finalize_monitoring()  # deterministic thread teardown
+    return losses, params
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+@pytest.mark.parametrize("path,gas,offload", [
+    ("fused", 1, False),       # gas==1 single fused program
+    ("full_scan", 2, False),   # gas>1 one-program lax.scan
+])
+def test_pipeline_parity_device_paths(path, gas, offload, stage):
+    """data_pipeline ON (the default: background collate + device
+    double-buffering) must yield the EXACT loss sequence and params of
+    the synchronous path — prefetching is a scheduling change, never a
+    numerics change."""
+    lon, pon = _run(_cfg(gas, stage=stage, offload=offload))
+    loff, poff = _run(_cfg(gas, stage=stage, offload=offload,
+                           pipeline=False))
+    assert lon == loff  # exactly equal, not allclose
+    for a, b in zip(pon, poff):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+def test_pipeline_parity_split_path(stage):
+    """The split micro/apply path (no fused program: ZeRO-Offload runs
+    the optimizer host-side) rides the same feed via train_batch's
+    per-micro loop — parity must hold there too."""
+    lon, pon = _run(_cfg(2, stage=max(1, stage), offload=True), steps=4)
+    loff, poff = _run(_cfg(2, stage=max(1, stage), offload=True,
+                           pipeline=False), steps=4)
+    assert lon == loff
+    for a, b in zip(pon, poff):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_teardown_leaves_no_threads():
+    base = set(_prefetch_threads())
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=_cfg(2),
+                               training_data=random_dataset(n=256))
+    engine.train_batch()
+    engine.train_batch()
+    assert _prefetch_threads(), "prefetch threads should be running"
+    # (a) deterministic hook
+    engine.close_data_pipeline()
+    deadline = time.time() + 5
+    while set(_prefetch_threads()) - base and time.time() < deadline:
+        time.sleep(0.02)
+    assert set(_prefetch_threads()) - base == set()
+    # (b) GC route
+    engine.train_batch()
+    assert _prefetch_threads()
+    del engine
+    gc.collect()
+    deadline = time.time() + 5
+    while set(_prefetch_threads()) - base and time.time() < deadline:
+        time.sleep(0.02)
+    assert set(_prefetch_threads()) - base == set()
+
+
+def test_device_feed_engages_and_counters_flow():
+    """With the pipeline on, the engine keeps one device-placed batch in
+    flight (double buffering) and the input.* counters record host wait
+    + H2D traffic + queue occupancy."""
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=_cfg(1),
+                               training_data=random_dataset(n=256))
+    snap = COUNTERS.snapshot()
+    for _ in range(3):
+        engine.train_batch()
+    feed = engine._device_feed
+    assert feed is not None and feed.has_pending, \
+        "lookahead batch should be device-placed while the step runs"
+    delta = COUNTERS.delta_since(snap)
+    assert delta.get("input.host_wait_ms", {}).get("calls", 0) >= 3
+    assert delta.get("input.h2d_bytes", {}).get("bytes", 0) > 0
+    assert "input.queue_depth" in delta
+    engine.finalize_monitoring()
+    assert engine._device_feed is None
+
+
+def test_replicated_batch_counter_and_single_warning():
+    """An indivisible batch falls into the replicate fallback: every
+    event is counted (the monitor surfaces it), the log warns once."""
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=_cfg(1))
+    snap = COUNTERS.snapshot()
+    x = np.random.RandomState(0).randn(9, 16).astype(np.float32)
+    y = np.zeros((9, 4), np.float32)
+    for _ in range(2):
+        engine.forward((x, y))
+        engine.backward()
+        engine.step()
+    delta = COUNTERS.delta_since(snap).get("input.replicated_batches")
+    assert delta is not None
+    # ONE event per BATCH (not per pytree leaf): 2 steps -> calls == 2,
+    # bytes cover both indivisible leaves of each batch
+    assert delta["calls"] == 2
+    assert delta["bytes"] == 2 * (x.nbytes + y.nbytes)
+
+
+def test_tiny_shard_tail_tiles_to_full_size():
+    """A shard with fewer samples than _per_shard still pads to a
+    full-size batch (np.resize tiles the shard order) — never a short
+    batch that would hit the replicate fallback."""
+    data = _dataset(3)
+    loader = DeepSpeedDataLoader(data, batch_size=8, drop_last=False,
+                                 data_parallel_world_size=1,
+                                 data_parallel_rank=0)
+    batches = list(loader)
+    assert len(batches) == len(loader) == 1
+    x, y = batches[0]
+    assert x.shape[0] == 8
+    assert [int(i) for i in y] == [0, 1, 2, 0, 1, 2, 0, 1]
+
+
+def test_owned_feed_pending_survives_user_iterator_interleave():
+    """A train_batch(user_iter) call must not evict the engine-owned
+    feed's prefetched batch: that batch was already consumed from the
+    training stream and would otherwise silently vanish."""
+    from tests.simple_model import random_batches
+
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=_cfg(1),
+                               training_data=random_dataset(n=256))
+    engine.train_batch()
+    engine.train_batch()
+    owned = engine._device_feed
+    assert owned is not None and owned.has_pending
+    pending = owned._pending
+    engine.train_batch(iter(list(random_batches(1, batch_size=32))))
+    assert engine._device_feed is owned and owned._pending is pending, \
+        "user iterator evicted the owned feed's prefetched batch"
+    # the next owned call consumes the pending batch and refills ONCE;
+    # a dropped pending would show up as TWO host fetches here
+    snap = COUNTERS.snapshot()
+    engine.train_batch()
+    calls = COUNTERS.delta_since(snap).get("input.host_wait_ms",
+                                           {}).get("calls", 0)
+    assert calls == 1, f"expected 1 host fetch (refill), saw {calls}"
+    engine.finalize_monitoring()
+
+
+def test_pipeline_config_validation():
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        ds.initialize(model=SimpleModel(), config_params=_cfg(
+            1, data_pipeline={"prefetch_depth": -1}))
+    with pytest.raises(ValueError, match="num_workers"):
+        ds.initialize(model=SimpleModel(), config_params=_cfg(
+            1, data_pipeline={"num_workers": 0}))
+    with pytest.raises(ValueError, match="unknown key"):
+        ds.initialize(model=SimpleModel(), config_params=_cfg(
+            1, data_pipeline={"depth": 3}))
+    # prefetch_depth 0 keeps device double-buffering but no host threads
+    engine, *_ = ds.initialize(
+        model=SimpleModel(),
+        config_params=_cfg(1, data_pipeline={"prefetch_depth": 0}),
+        training_data=random_dataset(n=256))
+    engine.train_batch()
+    assert not _prefetch_threads()
+    assert engine._device_feed is not None
+    engine.finalize_monitoring()
+
+
+def test_deferred_step_log_settles_without_hot_loop_sync(monkeypatch):
+    """steps_per_print lines ride the async ring: they settle (in order,
+    none dropped) by finalize at the latest — and the hot loop never
+    float()s an in-flight scalar."""
+    import deepspeed_tpu.runtime.engine as engine_mod
+
+    lines = []
+    monkeypatch.setattr(engine_mod, "log_dist",
+                        lambda msg, ranks=None, **kw: lines.append(msg))
+    engine, *_ = ds.initialize(
+        model=SimpleModel(), config_params=_cfg(1, steps_per_print=2),
+        training_data=random_dataset(n=256))
+    for _ in range(5):
+        engine.train_batch()
+    engine.finalize_monitoring()
+    step_lines = [ln for ln in lines if ln.startswith("step=")]
+    assert [ln.split(",")[0] for ln in step_lines] == ["step=2", "step=4"]
+    assert all("loss_scale=" in ln and "samples/sec=" in ln
+               for ln in step_lines)
+
+
+# ---------------------------------------------------------------------------
+# run report renders the Input pipeline section from a real engine run
+# ---------------------------------------------------------------------------
+
+def test_run_report_renders_input_pipeline_section(tmp_path):
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    cfg = _cfg(1, monitor={"enabled": True, "output_path": str(tmp_path),
+                           "job_name": "pipe", "flush_interval": 1})
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=cfg,
+                               training_data=random_dataset(n=256))
+    for _ in range(3):
+        engine.train_batch()
+    engine.finalize_monitoring()
+    md = render_markdown(load_run(str(tmp_path / "pipe")))
+    assert "## Input pipeline" in md
+    assert "host wait" in md and "H2D batch transfer" in md
+
+
+# ---------------------------------------------------------------------------
+# bench tool CPU dry-run (tier-1 cover for tools/input_pipeline_bench.py)
+# ---------------------------------------------------------------------------
+
+def test_input_pipeline_bench_dry_run(tmp_path):
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "input_pipeline_bench",
+        pathlib.Path(__file__).resolve().parent.parent / "tools" /
+        "input_pipeline_bench.py")
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    result = bench.run_bench(steps=3, warmup=1, batch=32, dim=16,
+                             sample_delay_ms=0.2, gas=1,
+                             artifact_root=str(tmp_path))
+    assert result["prefetch_off"]["host_wait_ms_per_step"] > 0
+    assert result["prefetch_on"]["step_ms"] > 0
+    # the artifact landed through monitor/artifacts.py
+    assert (tmp_path / "manifest.jsonl").exists()
+    files = list(tmp_path.glob("*_input_pipeline*.json"))
+    assert files, "bench artifact missing"

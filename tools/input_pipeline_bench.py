@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Input-pipeline bench: host gap between step dispatches, prefetch
+off vs on.
+
+The round-5 verdict put the remaining GPT-2 gap on the HOST: with the
+on-device step fused to one program, `train_batch` still paid a
+synchronous per-sample fetch + collate + H2D placement between
+dispatches.  This tool measures that gap directly over a synthetic SLOW
+dataset (each `__getitem__` sleeps `--delay-ms`, standing in for
+tokenization / disk reads):
+
+  prefetch_off   "data_pipeline": {"enabled": false} — the pre-pipeline
+                 synchronous path
+  prefetch_on    the default pipeline: PrefetchLoader background collate
+                 + _DeviceFeed device double-buffering
+
+Reported per lane:
+
+  host_gap_ms    median wall time of a train_batch call EXCLUDING the
+                 final device sync — fetch + collate wait + H2D + step
+                 dispatch, i.e. the host-side serial section between
+                 dispatches
+  step_ms        end-to-end wall per step (N steps + one final sync)
+  host_wait_ms_per_step   the engine's own `input.host_wait_ms` counter
+                 delta (time blocked pulling batches), and
+  h2d_mb_per_step         `input.h2d_bytes` — same transfer volume on
+                 both lanes, only its overlap changes
+
+The headline value is host_gap_off / host_gap_on.  Results are recorded
+through monitor/artifacts.py into bench_artifacts/runs/ + manifest.jsonl
+(the PR-2 durable-artifact rule); render any monitored run with
+tools/run_report.py to see the counters as an "Input pipeline" section.
+
+Usage: python tools/input_pipeline_bench.py [--steps 30] [--delay-ms 1.0]
+           [--batch 32] [--gas 1] [--workers 2] [--no-record]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+
+class SlowDataset:
+    """Deterministic regression samples with an artificial per-sample
+    fetch cost (sleep) standing in for real tokenize/decode/IO work."""
+
+    def __init__(self, n, dim, out, delay_ms):
+        rng = __import__("numpy").random.RandomState(0)
+        self._w = rng.randn(dim, out).astype("float32")
+        self._x = rng.randn(n, dim).astype("float32")
+        self._y = self._x @ self._w
+        self._delay = delay_ms / 1000.0
+
+    def __len__(self):
+        return len(self._x)
+
+    def __getitem__(self, i):
+        if self._delay:
+            time.sleep(self._delay)
+        return self._x[i], self._y[i]
+
+
+def _mlp(dim, out):
+    """Tiny two-layer MLP TrainModule (mirrors tests/simple_model.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.module import TrainModule
+
+    class MLP(TrainModule):
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (dim, dim)) * 0.1,
+                    "b1": jnp.zeros((dim,)),
+                    "w2": jax.random.normal(k2, (dim, out)) * 0.1,
+                    "b2": jnp.zeros((out,))}
+
+        def loss(self, params, batch, rng=None, train=True, **kw):
+            x, y = batch
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            pred = h @ params["w2"] + params["b2"]
+            return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+    return MLP()
+
+
+def _lane(enabled, args_ns):
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor.counters import COUNTERS
+
+    cfg = {
+        "train_batch_size": args_ns["batch"],
+        "gradient_accumulation_steps": args_ns["gas"],
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "data_pipeline": ({"num_workers": args_ns["workers"],
+                           "prefetch_depth": args_ns["depth"]}
+                          if enabled else {"enabled": False}),
+    }
+    dataset = SlowDataset(max(args_ns["batch"] * 8, 256), args_ns["dim"],
+                          4, args_ns["delay"])
+    engine, *_ = ds.initialize(model=_mlp(args_ns["dim"], 4),
+                               config_params=cfg, training_data=dataset)
+    for _ in range(args_ns["warmup"]):
+        engine.train_batch()
+    snap = COUNTERS.snapshot()
+    gaps = []
+    t_all0 = time.perf_counter()
+    loss = None
+    for _ in range(args_ns["steps"]):
+        t0 = time.perf_counter()
+        loss = engine.train_batch()  # async dispatch: wall here ≈ host gap
+        gaps.append(time.perf_counter() - t0)
+    loss.block_until_ready()
+    wall = time.perf_counter() - t_all0
+    delta = COUNTERS.delta_since(snap)
+    steps = args_ns["steps"]
+    out = {
+        "host_gap_ms": round(float(np.median(gaps)) * 1e3, 3),
+        "step_ms": round(wall / steps * 1e3, 3),
+        "host_wait_ms_per_step": round(
+            delta.get("input.host_wait_ms", {}).get("bytes", 0)
+            / 1000.0 / steps, 3),
+        "h2d_mb_per_step": round(
+            delta.get("input.h2d_bytes", {}).get("bytes", 0)
+            / 1e6 / steps, 3),
+        "mean_queue_depth": (
+            round(delta["input.queue_depth"]["bytes"]
+                  / delta["input.queue_depth"]["calls"], 2)
+            if delta.get("input.queue_depth", {}).get("calls") else None),
+        "loss": round(float(loss), 6),
+    }
+    engine.finalize_monitoring()  # join prefetch threads between lanes
+    return out
+
+
+def run_bench(steps=30, warmup=3, batch=32, dim=64, sample_delay_ms=1.0,
+              gas=1, workers=2, depth=2, artifact_root=None, record=True):
+    args_ns = {"steps": steps, "warmup": warmup, "batch": batch,
+               "dim": dim, "delay": sample_delay_ms, "gas": gas,
+               "workers": workers, "depth": depth}
+    off = _lane(False, args_ns)
+    on = _lane(True, args_ns)
+    assert off["loss"] == on["loss"], \
+        f"parity broke: prefetch changed the loss ({off['loss']} vs " \
+        f"{on['loss']})"
+    result = {
+        "metric": f"input_pipeline_gas{gas}",
+        "platform": "cpu",
+        "steps": steps,
+        "sample_delay_ms": sample_delay_ms,
+        "batch": batch,
+        "gas": gas,
+        "workers": workers,
+        "prefetch_depth": depth,
+        "prefetch_off": off,
+        "prefetch_on": on,
+        "value": round(off["host_gap_ms"] / max(on["host_gap_ms"], 1e-9),
+                       2),
+        "unit": "x_hostgap_reduction",
+    }
+    if record:
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        result["artifact"] = record_bench_result(
+            result, root=artifact_root, name=result["metric"])
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--delay-ms", type=float, default=1.0,
+                    help="per-sample fetch cost (tokenize/IO stand-in)")
+    ap.add_argument("--gas", type=int, default=1,
+                    help="gradient accumulation steps (2+ runs the "
+                    "full_scan stacked path)")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--depth", type=int, default=2)
+    ap.add_argument("--no-record", action="store_true",
+                    help="skip the bench_artifacts/ write")
+    args = ap.parse_args()
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    result = run_bench(steps=args.steps, warmup=args.warmup,
+                       batch=args.batch, dim=args.dim,
+                       sample_delay_ms=args.delay_ms, gas=args.gas,
+                       workers=args.workers, depth=args.depth,
+                       record=not args.no_record)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,12 @@ def selftest() -> int:
             # per-level section)
             COUNTERS.add("grad_wire.intra", 8192, calls=2)
             COUNTERS.add("grad_wire.inter", 1024, calls=1)
+            # input pipeline: host wait (µs in the bytes slot), H2D
+            # payload, prefetch queue occupancy — rendered as their own
+            # "Input pipeline" section, not comm rows
+            COUNTERS.add("input.host_wait_ms", 1500, calls=1)
+            COUNTERS.add("input.h2d_bytes", 4096, calls=2)
+            COUNTERS.add("input.queue_depth", 2, calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -63,8 +69,12 @@ def selftest() -> int:
         md = render_markdown(run)
         for needle in ("Run report", "p2p.send", "Pipeline occupancy",
                        "11.1%", "forward", "Gradient wire levels",
-                       "inter-group", "slow-fabric share"):
+                       "inter-group", "slow-fabric share",
+                       "Input pipeline", "host wait", "H2D batch transfer",
+                       "mean prefetch queue depth"):
             assert needle in md, f"{needle!r} missing from report"
+        assert "`input.host_wait_ms`" not in md, \
+            "input.* rows must not leak into the comm table"
     print("run_report selftest ok")
     return 0
 
