@@ -8,3 +8,8 @@ from .elasticity import (  # noqa: F401
     ensure_immutable_elastic_config,
     get_compatible_gpus_v01,
 )
+from .supervisor import (  # noqa: F401
+    HeartbeatWatcher,
+    RestartPolicy,
+    supervise,
+)

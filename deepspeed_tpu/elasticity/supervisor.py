@@ -4,49 +4,296 @@ failures.
 Capability beyond the reference (SURVEY.md §5: v0.3.15 has no in-run
 failure detector or rendezvous — its recovery story is "the launcher
 kills the local group on any child failure" + elastic checkpoints that
-resume at a different world size). This supervisor closes the loop: it
-runs the training command, and when the command dies it relaunches it
-with exponential backoff, relying on the framework's elastic
-checkpoints ("latest" tag) for the resumed process to pick up where it
-left off — at whatever world size the new launch discovers.
+resume at a different world size). This supervisor closes the loop in
+two ways:
+
+* **exit-driven**: when the training command dies it is relaunched
+  with exponential backoff + jitter under a restart budget (at most
+  `max_restarts` failures per rolling `restart_window` seconds, then
+  give up with the child's nonzero exit code) — `RestartPolicy` is the
+  unit-testable state machine.
+* **heartbeat-driven**: with `--monitor-dir` pointing at a RunMonitor
+  run directory (docs/tutorials/monitoring.md), `HeartbeatWatcher`
+  tails the per-rank event streams.  A run that stops writing events
+  for `--stall-timeout` seconds (hung collective, dead coordinator) or
+  a rank flagged straggler in `--straggler-strikes` consecutive
+  heartbeats triggers a SUPERVISED restart even though the process is
+  still "alive": the child gets SIGTERM first (save-if-possible — the
+  checkpoint layer's two-phase commit means an interrupted save can
+  never corrupt the resume point), then SIGKILL after `--grace`
+  seconds, and the relaunch carries `DSTPU_ELASTIC_RESTART=1`,
+  `DSTPU_ELASTIC_REASON`, and — when the heartbeats identify dead or
+  straggling ranks — `DSTPU_DEAD_RANKS` / `DSTPU_SURVIVING_WORLD`, so
+  the launcher can re-form the job at the surviving world size and the
+  framework's elastic checkpoints ("latest" committed tag) resume it
+  there.
 
 Usage (also `ds_elastic supervise -- ...`):
 
     python -m deepspeed_tpu.elasticity.supervisor \
-        [--max-restarts 10] [--backoff 5] [--success-window 300] \
+        [--max-restarts 10] [--backoff 5] [--restart-window 3600] \
+        [--monitor-dir runs/myjob] [--stall-timeout 600] \
         -- deepspeed --hostfile hostfile train.py --deepspeed_config c.json
 
-Exit code: 0 if the command eventually succeeds; once restarts are
-exhausted, the last child exit code (signal-killed children map to the
-conventional 128+signum); 128+signum when the supervisor itself is
-stopped by SIGINT/SIGTERM (operator signals stop the loop, they are
-never retried).
+Exit code: 0 if the command eventually succeeds; once the restart
+budget is exhausted, the last child exit code (signal-killed children
+map to the conventional 128+signum); 128+signum when the supervisor
+itself is stopped by SIGINT/SIGTERM (operator signals stop the loop,
+they are never retried).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import json
+import os
+import random
 import signal
 import subprocess
 import sys
 import time
+from collections import deque
+from typing import Dict, List, Optional
 
 from ..utils.logging import logger
 
 
-def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
-              backoff_cap: float = 300.0, success_window: float = 300.0):
-    """Run `command` (list) until it exits 0 or restarts are exhausted.
+class RestartPolicy:
+    """Relaunch state machine: exponential backoff with jitter under a
+    rolling restart-budget window.
 
-    A child that stays alive longer than `success_window` seconds resets
-    the restart budget and the backoff (long-running training that dies
-    after hours should get its full retry budget back, not inherit the
-    count from startup flakes)."""
-    restarts_left = max_restarts
-    delay = backoff
+    * `record_failure(ran_for)` -> the relaunch delay in seconds, or
+      None when the budget is exhausted (give up).
+    * budget: at most `max_restarts` failures inside the trailing
+      `restart_window` seconds (window 0 = no time horizon: the count
+      only clears when a child survives `success_window`).
+    * backoff: starts at `backoff`, doubles per failure up to
+      `backoff_cap`, multiplied by a uniform jitter in
+      [1-jitter, 1+jitter] so a fleet of supervisors does not relaunch
+      in lockstep against the same coordinator/filesystem.
+    * a child that stayed alive >= `success_window` seconds earns its
+      full budget back and resets the backoff (long-running training
+      that dies after hours must not inherit the count from startup
+      flakes).
+
+    `rng`/`clock` are injectable for tests."""
+
+    def __init__(self, max_restarts: int = 10, backoff: float = 5.0,
+                 backoff_cap: float = 300.0, jitter: float = 0.25,
+                 restart_window: float = 0.0,
+                 success_window: float = 300.0,
+                 rng=None, clock=time.monotonic):
+        if not 0.0 <= float(jitter) < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self.max_restarts = int(max_restarts)
+        self.backoff = float(backoff)
+        self.backoff_cap = float(backoff_cap)
+        self.jitter = float(jitter)
+        self.restart_window = float(restart_window)
+        self.success_window = float(success_window)
+        self._rng = rng if rng is not None else random.Random()
+        self._clock = clock
+        self._delay = self.backoff
+        self._failures: deque = deque()  # clock() stamps of failures
+
+    @property
+    def failures_in_window(self) -> int:
+        self._prune(self._clock())
+        return len(self._failures)
+
+    def _prune(self, now: float) -> None:
+        if self.restart_window > 0:
+            while self._failures and \
+                    now - self._failures[0] > self.restart_window:
+                self._failures.popleft()
+
+    def record_failure(self, ran_for: float) -> Optional[float]:
+        """A child died after `ran_for` seconds: the delay before the
+        relaunch, or None = budget exhausted, give up."""
+        now = self._clock()
+        if ran_for >= self.success_window:
+            self._failures.clear()
+            self._delay = self.backoff
+        self._failures.append(now)
+        self._prune(now)
+        if len(self._failures) > self.max_restarts:
+            return None
+        delay = self._delay * self._rng.uniform(1.0 - self.jitter,
+                                                1.0 + self.jitter)
+        self._delay = min(self._delay * 2.0, self.backoff_cap)
+        return max(0.0, delay)
+
+
+class HeartbeatWatcher:
+    """Health view over a RunMonitor run directory (monitor/monitor.py):
+    per-rank `events.rank*.jsonl` streams + the rank-0 `heartbeat`
+    events the monitor emits every `heartbeat_interval` steps.
+
+    `check()` returns None while the run looks healthy, else a dict
+    {"reason": str, "dead_ranks": [...], "surviving_world": int|None}:
+
+    * **stall** — no event file grew for `stall_timeout` seconds.  A
+      hung collective / dead coordinator stops EVERY rank's stream, so
+      this is the dead-rank detector that works even when the victim
+      cannot say goodbye.
+    * **straggler** — a rank flagged by `straggler_factor` x median in
+      `straggler_strikes` CONSECUTIVE heartbeat events (one slow step
+      is noise; a persistently slow rank is a failing host).
+
+    `reset()` re-arms the liveness clock after a relaunch."""
+
+    def __init__(self, run_dir: str, stall_timeout: float,
+                 straggler_strikes: int = 3, clock=time.time):
+        self.run_dir = run_dir
+        self.stall_timeout = float(stall_timeout)
+        self.straggler_strikes = int(straggler_strikes)
+        self._clock = clock
+        self._strikes: Dict[int, int] = {}
+        self._hb_offset = 0  # byte cursor into the rank-0 event stream
+        self._armed_at = self._clock()
+
+    def _stream_size(self) -> int:
+        files = self._event_files()
+        if not files:
+            return 0
+        try:
+            return os.path.getsize(files[0])
+        except OSError:
+            return 0
+
+    def reset(self) -> None:
+        """Re-arm after a relaunch: clear strikes, skip everything
+        already in the stream (the heartbeats that justified the LAST
+        restart must not re-trigger against the fresh child — the
+        relaunched run appends to the same files), and floor the
+        liveness clock at now."""
+        self._strikes.clear()
+        self._hb_offset = self._stream_size()
+        self._armed_at = self._clock()
+
+    def _world_size(self) -> Optional[int]:
+        try:
+            with open(os.path.join(self.run_dir, "manifest.json")) as f:
+                return int(json.load(f).get("world_size"))
+        except (OSError, ValueError, TypeError, json.JSONDecodeError):
+            return None
+
+    def _event_files(self) -> List[str]:
+        return sorted(glob.glob(os.path.join(self.run_dir,
+                                             "events.rank*.jsonl")))
+
+    def _last_activity(self) -> Optional[float]:
+        """Newest mtime across event streams (None: no files yet)."""
+        stamps = []
+        for path in self._event_files():
+            try:
+                stamps.append(os.path.getmtime(path))
+            except OSError:
+                continue
+        return max(stamps) if stamps else None
+
+    def _latest_heartbeats(self, tail_bytes: int = 1 << 16) -> List[dict]:
+        """NEW heartbeat events from the rank-0 stream since the last
+        read, oldest first.  A byte cursor (`_hb_offset`) makes each
+        event count exactly once across check()/reset() calls; the read
+        is additionally bounded to the last `tail_bytes` so an
+        arbitrarily long backlog never stalls the poll loop."""
+        files = self._event_files()
+        if not files:
+            return []
+        path = files[0]
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size <= self._hb_offset:
+                    return []
+                f.seek(max(self._hb_offset, size - tail_bytes))
+                chunk = f.read().decode("utf-8", errors="replace")
+        except OSError:
+            return []
+        self._hb_offset = size
+        out = []
+        for line in chunk.splitlines():
+            if '"heartbeat"' not in line:
+                continue
+            try:
+                e = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn first line of the window
+            if e.get("type") == "heartbeat":
+                out.append(e)
+        return out
+
+    def check(self) -> Optional[dict]:
+        now = self._clock()
+        # liveness: SOME stream must keep growing
+        if self.stall_timeout > 0:
+            last = self._last_activity()
+            # _armed_at floors the anchor: right after (re)arming, stale
+            # pre-relaunch file mtimes must not trigger instantly — the
+            # fresh child gets a full stall_timeout to show life
+            anchor = (self._armed_at if last is None
+                      else max(last, self._armed_at))
+            if now - anchor > self.stall_timeout:
+                return {
+                    "reason": (f"no monitor events in "
+                               f"{now - anchor:.0f}s (> stall-timeout "
+                               f"{self.stall_timeout:.0f}s) under "
+                               f"{self.run_dir}"),
+                    "dead_ranks": [],
+                    "surviving_world": None,
+                }
+        # straggler strikes: consecutive heartbeat flags per rank
+        for hb in self._latest_heartbeats():
+            flagged = set(hb.get("stragglers") or [])
+            for r in flagged:
+                self._strikes[r] = self._strikes.get(r, 0) + 1
+            for r in list(self._strikes):
+                if r not in flagged:
+                    del self._strikes[r]  # consecutive only
+        dead = sorted(r for r, n in self._strikes.items()
+                      if n >= self.straggler_strikes)
+        if dead:
+            world = self._world_size()
+            return {
+                "reason": (f"rank(s) {dead} straggling in "
+                           f"{self.straggler_strikes} consecutive "
+                           f"heartbeats"),
+                "dead_ranks": dead,
+                "surviving_world": (world - len(dead)
+                                    if world is not None else None),
+            }
+        return None
+
+
+def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
+              backoff_cap: float = 300.0, success_window: float = 300.0,
+              jitter: float = 0.25, restart_window: float = 0.0,
+              monitor_dir: Optional[str] = None,
+              stall_timeout: float = 0.0, straggler_strikes: int = 3,
+              grace: float = 15.0, poll_interval: float = 0.5,
+              policy: Optional[RestartPolicy] = None,
+              watcher: Optional[HeartbeatWatcher] = None):
+    """Run `command` (list) until it exits 0 or the restart budget is
+    exhausted.  See the module docstring for the exit-driven and
+    heartbeat-driven restart paths; `policy`/`watcher` may be passed
+    pre-built (tests, custom clocks)."""
+    if policy is None:
+        policy = RestartPolicy(max_restarts=max_restarts, backoff=backoff,
+                               backoff_cap=backoff_cap, jitter=jitter,
+                               restart_window=restart_window,
+                               success_window=success_window)
+    if watcher is None and monitor_dir is not None:
+        # stall_timeout 0 turns off only the liveness check — straggler
+        # detection still runs off the heartbeat events
+        watcher = HeartbeatWatcher(monitor_dir, stall_timeout,
+                                   straggler_strikes=straggler_strikes)
     attempt = 0
     child = None
     stop_signal = None
+    elastic: Optional[dict] = None  # last heartbeat trigger, for env
 
     def forward(signum, _frame):
         # an operator/scheduler signal means STOP, not "restart harder":
@@ -71,6 +318,44 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 return
             time.sleep(min(left, 0.5))
 
+    def child_env():
+        env = dict(os.environ)
+        if elastic is not None:
+            env["DSTPU_ELASTIC_RESTART"] = "1"
+            env["DSTPU_ELASTIC_REASON"] = elastic["reason"]
+            if elastic.get("dead_ranks"):
+                env["DSTPU_DEAD_RANKS"] = ",".join(
+                    str(r) for r in elastic["dead_ranks"])
+            if elastic.get("surviving_world"):
+                env["DSTPU_SURVIVING_WORLD"] = str(
+                    elastic["surviving_world"])
+        return env
+
+    def wait_with_watcher():
+        """Block until the child exits OR the watcher triggers; returns
+        (rc, trigger_or_None).  On a trigger the child is torn down
+        SIGTERM-first (save-if-possible), SIGKILL after `grace`."""
+        while True:
+            rc = child.poll()
+            if rc is not None:
+                return rc, None
+            if stop_signal is not None:
+                return child.wait(), None
+            trigger = watcher.check() if watcher is not None else None
+            if trigger is not None:
+                logger.warning(
+                    f"supervisor: heartbeat trigger — {trigger['reason']}; "
+                    f"stopping the job for an elastic restart "
+                    f"(SIGTERM, SIGKILL after {grace:.0f}s)")
+                child.send_signal(signal.SIGTERM)
+                try:
+                    rc = child.wait(timeout=grace)
+                except subprocess.TimeoutExpired:
+                    child.kill()
+                    rc = child.wait()
+                return rc, trigger
+            time.sleep(poll_interval)
+
     old_int = signal.signal(signal.SIGINT, forward)
     old_term = signal.signal(signal.SIGTERM, forward)
     try:
@@ -82,14 +367,14 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
             start = time.monotonic()
             logger.info(f"supervisor: launching attempt {attempt}: "
                         f"{' '.join(command)}")
-            child = subprocess.Popen(command)
+            child = subprocess.Popen(command, env=child_env())
             if stop_signal is not None:
                 # raced the launch: the handler saw the OLD child; pass
                 # the stop on to the one we just started
                 child.send_signal(stop_signal)
-            rc = child.wait()
+            rc, trigger = wait_with_watcher()
             ran_for = time.monotonic() - start
-            if rc == 0:
+            if rc == 0 and trigger is None:
                 logger.info(f"supervisor: command succeeded after "
                             f"{attempt} attempt(s)")
                 return 0
@@ -97,23 +382,29 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 logger.info(f"supervisor: stopping on signal "
                             f"{stop_signal} (child exit {rc})")
                 return 128 + int(stop_signal)
-            if ran_for >= success_window:
-                restarts_left = max_restarts
-                delay = backoff
-            if restarts_left <= 0:
-                logger.error(f"supervisor: giving up after {attempt} "
-                             f"attempt(s); last exit code {rc}")
-                return to_exit_code(rc)
-            restarts_left -= 1
+            elastic = trigger or None
+            delay = policy.record_failure(ran_for)
+            if delay is None:
+                logger.error(
+                    f"supervisor: restart budget exhausted "
+                    f"({policy.max_restarts} restart(s)"
+                    + (f" per {policy.restart_window:.0f}s"
+                       if policy.restart_window > 0 else "")
+                    + f") after {attempt} attempt(s); last exit code {rc}")
+                return to_exit_code(rc) or 1  # never exit 0 on give-up
             logger.warning(
-                f"supervisor: exit code {rc} after {ran_for:.1f}s; "
-                f"relaunching in {delay:.1f}s "
-                f"({restarts_left} restart(s) left)")
+                f"supervisor: "
+                + (f"elastic trigger ({trigger['reason']})" if trigger
+                   else f"exit code {rc}")
+                + f" after {ran_for:.1f}s; relaunching in {delay:.1f}s "
+                f"({policy.failures_in_window}/{policy.max_restarts} "
+                f"restarts used)")
             interruptible_sleep(delay)
             if stop_signal is not None:  # signal arrived during backoff
                 logger.info(f"supervisor: stopping on signal {stop_signal}")
                 return 128 + int(stop_signal)
-            delay = min(delay * 2, backoff_cap)
+            if watcher is not None:
+                watcher.reset()  # re-arm liveness for the fresh child
     finally:
         signal.signal(signal.SIGINT, old_int)
         signal.signal(signal.SIGTERM, old_term)
@@ -124,10 +415,29 @@ def main(argv=None):
         description="restart supervisor for elastic training jobs")
     parser.add_argument("--max-restarts", type=int, default=10)
     parser.add_argument("--backoff", type=float, default=5.0,
-                        help="initial relaunch delay (doubles per failure)")
+                        help="initial relaunch delay (doubles per failure, "
+                        "with +/- jitter)")
     parser.add_argument("--backoff-cap", type=float, default=300.0)
+    parser.add_argument("--jitter", type=float, default=0.25,
+                        help="uniform backoff jitter fraction in [0, 1)")
+    parser.add_argument("--restart-window", type=float, default=0.0,
+                        help="rolling budget window in seconds: give up "
+                        "after max-restarts failures within it (0: no "
+                        "time horizon)")
     parser.add_argument("--success-window", type=float, default=300.0,
                         help="children alive this long reset the budget")
+    parser.add_argument("--monitor-dir", type=str, default=None,
+                        help="RunMonitor run directory to watch for "
+                        "heartbeats/liveness (docs/tutorials/monitoring.md)")
+    parser.add_argument("--stall-timeout", type=float, default=0.0,
+                        help="restart when no monitor events appear for "
+                        "this many seconds (0: off)")
+    parser.add_argument("--straggler-strikes", type=int, default=3,
+                        help="consecutive straggler heartbeats before an "
+                        "elastic restart")
+    parser.add_argument("--grace", type=float, default=15.0,
+                        help="seconds between SIGTERM and SIGKILL on a "
+                        "heartbeat-triggered teardown")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- training command")
     args = parser.parse_args(argv)
@@ -138,7 +448,12 @@ def main(argv=None):
         parser.error("no command given (use: supervisor [opts] -- cmd ...)")
     return supervise(command, max_restarts=args.max_restarts,
                      backoff=args.backoff, backoff_cap=args.backoff_cap,
-                     success_window=args.success_window)
+                     jitter=args.jitter, restart_window=args.restart_window,
+                     success_window=args.success_window,
+                     monitor_dir=args.monitor_dir,
+                     stall_timeout=args.stall_timeout,
+                     straggler_strikes=args.straggler_strikes,
+                     grace=args.grace)
 
 
 if __name__ == "__main__":

@@ -42,6 +42,15 @@ Instrumented sites:
   `input.replicated_batches` — batches whose dim 0 didn't divide the
   data axis and were replicated (dp x compute for that batch; the
   dataloader's wraparound tail padding exists to keep this at zero).
+* checkpointing (`ckpt.*`, rendered by monitor/report.py as a
+  "Checkpointing" section, like `input.*` kept out of the comm table):
+  `ckpt.stall_ms` — wall time the TRAINING thread spent blocked inside
+  `save_checkpoint_state` (bytes slot carries integer MICROSECONDS;
+  with async_save this is the host snapshot only, without it the full
+  serialize+write+commit); `ckpt.bytes` — serialized bytes per
+  COMMITTED tag (added by the commit job, so an interrupted save never
+  counts); `ckpt.pending` — background writer-queue depth sampled at
+  each save (mean = bytes/calls, like input.queue_depth).
 """
 
 from __future__ import annotations

@@ -163,9 +163,14 @@ class RunMonitor:
         return self.config.sync_timing
 
     def step_start(self, step: int) -> None:
-        """Call at the start of a global batch (accumulation boundary)."""
+        """Call at the start of a global batch (accumulation boundary).
+        The counter snapshot carries over from the previous step_end
+        when one exists, so work BETWEEN steps (checkpoint saves, user
+        collectives) is attributed to the next step event instead of
+        vanishing into the gap."""
         self.trace_window.tick(step)
-        self._counter_snap = COUNTERS.snapshot()
+        if self._counter_snap is None:
+            self._counter_snap = COUNTERS.snapshot()
         self._step_t0 = time.perf_counter()
 
     def step_end(self, step: int, **metrics) -> None:
@@ -184,7 +189,9 @@ class RunMonitor:
         if spans_ms:
             payload["spans_ms"] = spans_ms
         comm = COUNTERS.delta_since(self._counter_snap)
-        self._counter_snap = None
+        # re-snapshot HERE (not at the next step_start) so inter-step
+        # counter activity lands in the next event's delta
+        self._counter_snap = COUNTERS.snapshot()
         if comm:
             payload["comm"] = comm
         mem = device_memory_stats()

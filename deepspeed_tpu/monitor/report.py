@@ -166,12 +166,15 @@ def render_markdown(run: Dict[str, Any]) -> str:
             acc = any_comm.setdefault(name, {"calls": 0, "bytes": 0})
             acc["calls"] += d["calls"]
             acc["bytes"] += d["bytes"]
-    # input.* counters carry pipeline metrics (µs, queue depths), not
-    # wire bytes — split them out of the comm table into their own section
+    # input.*/ckpt.* counters carry pipeline/checkpoint metrics (µs,
+    # queue depths), not wire bytes — split them out of the comm table
+    # into their own sections
     input_counters = {k: v for k, v in any_comm.items()
                       if k.startswith("input.")}
+    ckpt_counters = {k: v for k, v in any_comm.items()
+                     if k.startswith("ckpt.")}
     wire_counters = {k: v for k, v in any_comm.items()
-                     if not k.startswith("input.")}
+                     if not k.startswith(("input.", "ckpt."))}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
         lines.append("")
@@ -210,6 +213,31 @@ def render_markdown(run: Dict[str, Any]) -> str:
             lines.append(f"| replicated (indivisible) batches | "
                          f"{rep['calls']:,} x dp-replicated, "
                          f"{_fmt_bytes(rep['bytes'])} |")
+        lines.append("")
+
+    if ckpt_counters:
+        lines.append("## Checkpointing")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        stall = ckpt_counters.get("ckpt.stall_ms")
+        if stall:
+            total_ms = stall["bytes"] / 1000.0  # stored as integer µs
+            per = total_ms / stall["calls"] if stall["calls"] else 0.0
+            lines.append(f"| training stall (blocked in save) | "
+                         f"{total_ms:,.1f} ms total over "
+                         f"{stall['calls']:,} saves "
+                         f"({per:.2f} ms/save) |")
+        cb = ckpt_counters.get("ckpt.bytes")
+        if cb:
+            lines.append(f"| committed checkpoint bytes | "
+                         f"{_fmt_bytes(cb['bytes'])} over {cb['calls']:,} "
+                         f"committed tag(s) |")
+        pend = ckpt_counters.get("ckpt.pending")
+        if pend and pend["calls"]:
+            lines.append(f"| mean async writer queue depth | "
+                         f"{pend['bytes'] / pend['calls']:.2f} "
+                         f"(sampled at {pend['calls']:,} saves) |")
         lines.append("")
 
     # hierarchical gradient wire: the per-level (fast/slow fabric) byte

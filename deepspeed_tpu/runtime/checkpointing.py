@@ -1,9 +1,11 @@
-"""Checkpoint save/load with reference-compatible layout + sharded I/O.
+"""Checkpoint save/load with reference-compatible layout + sharded I/O
+and crash-consistent two-phase commit.
 
 Reference: deepspeed/runtime/engine.py:1462-1890. Layout kept:
 
     <save_dir>/<tag>/mp_rank_00_model_states.msgpack
     <save_dir>/<tag>/zero_pp_rank_<dp>_mp_rank_00_optim_states.msgpack
+    <save_dir>/<tag>/.ckpt_commit.json    (commit marker; see below)
     <save_dir>/latest                     (text file holding the tag)
 
 Sharded design (reference engine.py:1462-1489 per-rank shard files):
@@ -12,41 +14,267 @@ a sharded jax.Array is written as a piece (with its index) into the
 zero_pp_rank_<r> file of its shard rank; the model/optim skeleton files
 keep a marker per sharded leaf. In multi-host jobs each process writes
 only the pieces it can address — no cross-host gather, every host writes
-in parallel (the reference's per-rank writer behaviour). Rank files are
-written by a background thread pool; save returns after the writes land
-(pass async_save=True to overlap with training and flush_pending() later).
+in parallel (the reference's per-rank writer behaviour).
+
+Crash consistency (two-phase commit): every file lands as tmp+rename, so
+no reader ever sees a torn file.  A tag becomes COMMITTED only when
+`.ckpt_commit.json` appears in its directory — written by process 0
+after every process has posted a per-tag done-key on the coordination-
+service KV (runtime/comm/hostwire.py), i.e. after ALL rank files are
+durably on disk everywhere.  `latest` is rewritten (atomically) only
+after the marker lands.  A save interrupted at ANY point therefore
+leaves `latest` pointing at the previous committed tag, and
+`read_latest_tag` additionally skips a tag without a marker back to the
+newest committed one.  The marker doubles as checkpoint metadata: it
+records the saving run's topology (dp size, hierarchy factor, ZeRO
+stage), which the engine uses to log/validate resharding-on-restore.
+
+Failure taxonomy: "nothing to resume from" (no latest, no tag dir)
+raises FileNotFoundError — callers warn and start fresh.  "A tag is
+present but incomplete/uncommitted/corrupt" raises
+CheckpointIntegrityError naming the tag and what is missing — resuming
+silently from it would be wrong, so that one is never swallowed.
+
+Async saves: rank files are written by a background thread pool; with
+async_save=True the call returns after the host snapshot and the
+serialize+write+commit runs in the background (flush_pending() blocks
+on it; a second save of the SAME tag, and any load from the same
+directory, flush first so the writer is never raced).  Stall accounting
+rides the monitor counters: `ckpt.stall_ms` (µs of blocked training per
+save, in the bytes slot), `ckpt.bytes` (serialized bytes per committed
+tag), `ckpt.pending` (writer-queue depth sampled per save).
 
 On load the pieces are reassembled into full host arrays, so checkpoints
-stay elastic by construction — loading at a different world size just
-re-shards via device_put (subsumes the reference's ZeRO-1 elastic
-re-partition logic, zero/stage1.py:924-1155). Unsharded (round-1/2 format)
-checkpoints load unchanged.
+stay elastic by construction — loading at a different world size,
+hierarchy factor, or ZeRO stage re-partitions via device_put under the
+restoring run's own sharding plan (subsumes the reference's ZeRO-1
+elastic re-partition logic, zero/stage1.py:924-1155). Unsharded
+(round-1/2 format) checkpoints load unchanged.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Dict, List, Optional
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 import jax
 from flax import serialization
 
+from ..monitor.counters import COUNTERS
 from ..utils.logging import logger
 
 _SHARD_MARKER = "__dstpu_sharded_leaf__"
+COMMIT_MARKER = ".ckpt_commit.json"
+COMMIT_SCHEMA_VERSION = 1
+COMMIT_TIMEOUT_MS = 300_000
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint tag exists but is incomplete, uncommitted, or
+    corrupt.  Distinct from FileNotFoundError ("nothing to resume
+    from"): silently training from scratch over a damaged checkpoint
+    would lose the run, so engines let this propagate."""
+
+
+# ---------------------------------------------------------------------------
+# background writer + per-(dir, tag) pending bookkeeping
+# ---------------------------------------------------------------------------
+
 _writer = ThreadPoolExecutor(max_workers=4)
-_pending: List[Any] = []
+_pending_lock = threading.Lock()
+_pending: Dict[Tuple[str, str], List[Future]] = {}
+# last commit-bearing future per save_dir: async commits CHAIN on it so
+# `latest` (and marker timestamps) always land in save-call order even
+# when several tags are in flight on the pool at once
+_dir_chain: Dict[str, Future] = {}
+# per-(save_dir, tag) save counter: scopes the commit barrier's KV keys
+# so a tag re-save never rendezvouses on the previous round's keys
+_tag_seq: Dict[Tuple[str, str], int] = {}
 
 
-def flush_pending():
-    """Block until all async checkpoint writes have landed."""
-    global _pending
-    for f in _pending:
-        f.result()
-    _pending = []
+def _pending_key(save_dir: str, tag) -> Tuple[str, str]:
+    return (os.path.realpath(save_dir), str(tag))
+
+
+def _track_pending(save_dir: str, tag, futures: List[Future]) -> None:
+    with _pending_lock:
+        _pending.setdefault(_pending_key(save_dir, tag), []).extend(futures)
+
+
+def pending_count() -> int:
+    """Async checkpoint jobs not yet finished (writer-queue depth)."""
+    with _pending_lock:
+        return sum(1 for fs in _pending.values()
+                   for f in fs if not f.done())
+
+
+def flush_pending(save_dir: Optional[str] = None,
+                  tag=None) -> None:
+    """Block until async checkpoint writes have landed (and committed).
+
+    With no arguments: everything (engine teardown).  With `save_dir`
+    (and optionally `tag`): only that directory/tag — used to serialize
+    a tag re-save against the previous writer and a load against any
+    in-flight save of the same directory."""
+    with _pending_lock:
+        if save_dir is None:
+            keys = list(_pending)
+        else:
+            root = os.path.realpath(save_dir)
+            keys = [k for k in _pending
+                    if k[0] == root and (tag is None or k[1] == str(tag))]
+        grabbed = [(k, _pending.pop(k)) for k in keys]
+    errs = []
+    for _k, futures in grabbed:
+        for f in futures:
+            try:
+                f.result()
+            except Exception as e:  # surface the FIRST failure, flush all
+                errs.append(e)
+    if errs:
+        raise errs[0]
+
+
+# ---------------------------------------------------------------------------
+# atomic file plumbing
+# ---------------------------------------------------------------------------
+
+
+def _fsync_dir(path: str) -> None:
+    """fsync a directory so a completed rename is durable — without this
+    the rename can sit in the page cache after the data fsync, and a
+    crash can publish a marker/`latest` over missing files."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return  # platforms without dir fds: rename alone is the best we get
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+_TMP_SEQ = __import__("itertools").count()
+
+
+def _atomic_write(path: str, blob: bytes) -> int:
+    """tmp + fsync + rename: readers never observe a torn file.  The
+    tmp name carries pid AND a process-local sequence number: two
+    background commits landing the same target (e.g. `latest` for
+    overlapping async tags) must not collide on one tmp file."""
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return len(blob)
+
+
+# ---------------------------------------------------------------------------
+# commit barrier over the coordination-service KV
+# ---------------------------------------------------------------------------
+
+
+class CommitBarrier:
+    """Two-phase commit rendezvous for one checkpoint tag.
+
+    Every process posts a done-key after its rank files are durably
+    renamed; process 0 blocks for all done-keys, runs the commit action
+    (marker + latest), then posts a committed-key the other processes
+    block on — so once ANY process's save (or flush_pending) returns,
+    the tag is globally committed, and a tag missing its marker can only
+    mean a save died before commit.
+
+    Keys are scoped by a per-(tag) sequence number (`seq`) so a RE-SAVE
+    of the same tag never sees the previous round's keys: without it,
+    non-zero ranks would wait() the stale committed-key and return
+    before the new commit ran.  Save calls are collective and ordered,
+    so each process's local counter agrees; an elastic restart restarts
+    every process, re-agreeing at 0 (jax.distributed has no partial
+    restart).
+
+    `_endpoint=(client, rank, world)` lets tests drive the barrier over
+    a fake in-memory KV (tests/test_hostwire.FakeCoordClient)."""
+
+    def __init__(self, tag: str, timeout_ms: int = COMMIT_TIMEOUT_MS,
+                 seq: int = 0, _endpoint=None):
+        from .comm.hostwire import KVSignals
+
+        self.signals = KVSignals(_endpoint=_endpoint)
+        self.tag = str(tag)
+        self.seq = int(seq)
+        self.timeout_ms = int(timeout_ms)
+
+    @property
+    def world(self) -> int:
+        return self.signals.world
+
+    def _key(self, kind: str, rank: Optional[int] = None) -> str:
+        base = f"dstpu-ckpt/{self.tag}/{self.seq}/{kind}"
+        return base if rank is None else f"{base}/{rank}"
+
+    def commit(self, commit_fn) -> None:
+        """Collective: post done, rendezvous, run `commit_fn` on process
+        0, release everyone.  Single-process runs commit_fn directly."""
+        sig = self.signals
+        if sig.world <= 1:
+            commit_fn()
+            return
+        sig.post(self._key("done", sig.rank), "1")
+        if sig.rank == 0:
+            try:
+                for r in range(sig.world):
+                    sig.wait(self._key("done", r), self.timeout_ms)
+            except Exception as e:
+                raise CheckpointIntegrityError(
+                    f"checkpoint tag {self.tag!r}: commit barrier timed "
+                    f"out waiting for rank done-keys ({e}); the tag was "
+                    f"NOT committed") from e
+            commit_fn()
+            sig.post(self._key("committed"), "1")
+            for r in range(sig.world):
+                sig.delete(self._key("done", r))
+        else:
+            try:
+                sig.wait(self._key("committed"), self.timeout_ms)
+            except Exception as e:
+                raise CheckpointIntegrityError(
+                    f"checkpoint tag {self.tag!r}: commit barrier timed "
+                    f"out waiting for process 0's commit marker ({e})"
+                ) from e
+
+
+# ---------------------------------------------------------------------------
+# host conversion + sharded split/reassembly
+# ---------------------------------------------------------------------------
+
+
+def prefetch_to_host(tree) -> None:
+    """Start non-blocking D2H transfers for every device leaf (and every
+    addressable shard of sharded leaves) so the later np.asarray
+    snapshot finds the bytes already on host.  Best-effort: any leaf
+    without the async API just pays the copy at snapshot time."""
+
+    def kick(x):
+        try:
+            if isinstance(x, jax.Array):
+                if x.is_fully_replicated or x.is_fully_addressable:
+                    x.copy_to_host_async()
+                else:
+                    for sh in x.addressable_shards:
+                        sh.data.copy_to_host_async()
+        except Exception:
+            pass
+        return x
+
+    jax.tree_util.tree_map(kick, tree)
 
 
 def _to_host(tree):
@@ -123,7 +351,7 @@ def _is_marker(x) -> bool:
     return isinstance(x, dict) and x.get(_SHARD_MARKER, False)
 
 
-def _reassemble(tree, pieces_by_key: Dict[str, list]):
+def _reassemble(tree, pieces_by_key: Dict[str, list], tag=None):
     """Inverse of _split_sharded: markers -> full host arrays."""
 
     def visit(leaf):
@@ -132,9 +360,10 @@ def _reassemble(tree, pieces_by_key: Dict[str, list]):
         key = leaf["key"]
         got = pieces_by_key.get(key, [])
         if len(got) != int(leaf["num_pieces"]):
-            raise FileNotFoundError(
-                f"sharded checkpoint leaf {key}: found {len(got)} of "
-                f"{leaf['num_pieces']} pieces (missing rank files?)")
+            raise CheckpointIntegrityError(
+                f"checkpoint tag {tag!r}: sharded leaf {key} has "
+                f"{len(got)} of {leaf['num_pieces']} pieces (missing or "
+                f"truncated zero_pp_rank_* rank files?)")
         full = np.empty([int(s) for s in leaf["shape"]],
                         dtype=np.dtype(leaf["dtype"]))
         for entry in got:
@@ -160,6 +389,10 @@ def _load_rank_pieces(ckpt_dir: str, mp_rank: int) -> Dict[str, list]:
     return pieces
 
 
+# ---------------------------------------------------------------------------
+# Infinity stream-group files
+# ---------------------------------------------------------------------------
+
 _STREAM_PREFIX = "__dstpu_stream__:"
 
 
@@ -181,16 +414,17 @@ def stream_marker(group: str, slot: str) -> str:
 
 def write_stream_group(ckpt_dir: str, group: str, payload) -> str:
     path = stream_group_ckpt_name(ckpt_dir, group)
-    with open(path, "wb") as f:
-        f.write(serialization.msgpack_serialize(_to_host(payload)))
+    _atomic_write(path,
+                  serialization.msgpack_serialize(_to_host(payload)))
     return path
 
 
 def _read_stream_group(ckpt_dir: str, group: str):
     path = stream_group_ckpt_name(ckpt_dir, group)
     if not os.path.isfile(path):
-        raise FileNotFoundError(
-            f"streamed checkpoint group file not found: {path}")
+        raise CheckpointIntegrityError(
+            f"checkpoint at {ckpt_dir} is incomplete: streamed group "
+            f"file not found: {path}")
     with open(path, "rb") as f:
         return serialization.msgpack_restore(f.read())
 
@@ -235,6 +469,11 @@ def resolve_streamed(tree, ckpt_dir: str):
     return visit(tree)
 
 
+# ---------------------------------------------------------------------------
+# file naming
+# ---------------------------------------------------------------------------
+
+
 def model_ckpt_name(ckpt_dir: str, mp_rank: int = 0) -> str:
     return os.path.join(ckpt_dir, f"mp_rank_{mp_rank:02d}_model_states.msgpack")
 
@@ -253,87 +492,278 @@ def layer_ckpt_name(ckpt_dir: str, layer_idx: int, mp_rank: int = 0) -> str:
         f".msgpack")
 
 
+# ---------------------------------------------------------------------------
+# commit markers / tag state
+# ---------------------------------------------------------------------------
+
+
+def commit_marker_path(load_dir: str, tag) -> str:
+    return os.path.join(load_dir, str(tag), COMMIT_MARKER)
+
+
+def is_tag_committed(load_dir: str, tag) -> bool:
+    return os.path.isfile(commit_marker_path(load_dir, tag))
+
+
+def read_tag_meta(load_dir: str, tag) -> Optional[Dict[str, Any]]:
+    """The commit marker's payload ({"tag", "committed_unix", "meta":
+    {...saving-run topology...}}), or None for legacy/uncommitted tags."""
+    path = commit_marker_path(load_dir, tag)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        logger.warning(f"unreadable commit marker {path}: {e}")
+        return None
+
+
+def committed_tags(load_dir: str) -> List[str]:
+    """Committed tags under `load_dir`, oldest -> newest commit time."""
+    out = []
+    try:
+        entries = os.listdir(load_dir)
+    except OSError:
+        return []
+    for name in entries:
+        marker = read_tag_meta(load_dir, name)
+        if marker is not None:
+            out.append((float(marker.get("committed_unix", 0.0)), name))
+    return [name for _, name in sorted(out)]
+
+
+def _dir_has_markers(load_dir: str) -> bool:
+    try:
+        return any(os.path.isfile(os.path.join(load_dir, d, COMMIT_MARKER))
+                   for d in os.listdir(load_dir))
+    except OSError:
+        return False
+
+
+def write_commit_marker(save_dir: str, tag,
+                        meta: Optional[Dict[str, Any]] = None,
+                        world_size: int = 1, nbytes: int = 0) -> None:
+    """Publish the commit marker for `tag` (atomic rename + dir fsync).
+    Call ONLY after every process's files for the tag are durably on
+    disk — writers with their own rendezvous (the multi-host pipeline
+    engine's collective barrier) call this directly instead of going
+    through CommitBarrier."""
+    ckpt_dir = os.path.join(save_dir, str(tag))
+    marker = {
+        "schema_version": COMMIT_SCHEMA_VERSION,
+        "tag": str(tag),
+        "committed_unix": time.time(),
+        "world_size": int(world_size),
+        "nbytes_rank0": int(nbytes),
+        "meta": dict(meta or {}),
+    }
+    _atomic_write(commit_marker_path(save_dir, tag),
+                  json.dumps(marker, indent=2, sort_keys=True,
+                             default=str).encode())
+    _fsync_dir(ckpt_dir)
+
+
+def _commit(save_dir: str, tag, meta: Optional[Dict[str, Any]],
+            save_latest: bool, nbytes: int,
+            commit_endpoint=None,
+            commit_timeout_ms: int = COMMIT_TIMEOUT_MS,
+            seq: int = 0) -> None:
+    """Phase 2: rendezvous all processes, then (process 0) publish the
+    commit marker and repoint `latest` — both atomic renames, in that
+    order, so `latest` can never name an uncommitted tag.  Module-level
+    so crash tests can monkeypatch it away, simulating a writer killed
+    between the file writes and the commit."""
+    barrier = CommitBarrier(str(tag), timeout_ms=commit_timeout_ms,
+                            seq=seq, _endpoint=commit_endpoint)
+
+    def publish():
+        write_commit_marker(save_dir, tag, meta,
+                            world_size=barrier.world, nbytes=nbytes)
+        if save_latest:
+            _atomic_write(os.path.join(save_dir, "latest"),
+                          str(tag).encode())
+            _fsync_dir(save_dir)
+
+    run_commit = publish if jax.process_index() == 0 else (lambda: None)
+    barrier.commit(run_commit)
+    COUNTERS.add("ckpt.bytes", int(nbytes))
+
+
+# ---------------------------------------------------------------------------
+# save / load
+# ---------------------------------------------------------------------------
+
+
 def save_checkpoint_state(save_dir: str, tag: str, model_state: Dict[str, Any],
                           optim_state: Optional[Dict[str, Any]] = None,
                           save_latest: bool = True, mp_rank: int = 0,
                           dp_rank: int = 0, layer_states=None,
-                          tied_states=None, async_save: bool = False) -> str:
+                          tied_states=None, async_save: bool = False,
+                          meta: Optional[Dict[str, Any]] = None,
+                          commit_endpoint=None,
+                          commit_timeout_ms: int = COMMIT_TIMEOUT_MS,
+                          device_leaves_are_snapshots: bool = False) -> str:
+    """Write one checkpoint tag (two-phase: files -> barrier -> marker ->
+    latest).  `meta` (saving-run topology: dp size, hierarchy factor,
+    ZeRO stage, ...) is recorded in the commit marker for
+    resharding-on-restore.  Returns the tag directory.
+
+    async_save defers serialization to the background, so by default
+    device (jax.Array) leaves are still materialized to host on THIS
+    thread — a caller's live param buffers may be donated away by a
+    later train step before the background thread reads them.  The
+    engine passes device_leaves_are_snapshots=True after taking fresh
+    device copies (_async_ckpt_snapshot), which skips that blocking
+    materialization — only set it if every device leaf is a snapshot no
+    later computation can donate."""
+    t0 = time.perf_counter()
+    # a re-save of the SAME tag must never race the previous background
+    # writer over the same files — serialize on it first
+    flush_pending(save_dir, tag)
     ckpt_dir = os.path.join(save_dir, str(tag))
     os.makedirs(ckpt_dir, exist_ok=True)
+    with _pending_lock:
+        seq = _tag_seq[_pending_key(save_dir, tag)] = \
+            _tag_seq.get(_pending_key(save_dir, tag), -1) + 1
 
-    # sharded leaves are split into per-rank piece files; nothing is
-    # gathered across hosts — each process serializes only what it owns
-    rank_pieces: Dict[int, Dict[str, Any]] = {}
-    model_state = _split_sharded(model_state, rank_pieces, "model:")
-    optim_skeleton = None
-    if optim_state is not None:
-        optim_skeleton = _split_sharded(optim_state, rank_pieces, "optim:")
+    if async_save:
+        # snapshot in-place-mutating HOST arrays NOW (offload/infinity
+        # fp32 masters advance every step; a later background read must
+        # not see them).  Device jax.Arrays: materialize here too UNLESS
+        # the caller vouches they are donation-safe snapshots — the
+        # engine device-copies them right after the step dispatch
+        # (device_leaves_are_snapshots=True), which keeps the training
+        # thread from blocking on the in-flight step, the exact stall
+        # async_save exists to remove.
+        def host_snap(x):
+            if isinstance(x, np.ndarray):
+                return x.copy()
+            if not device_leaves_are_snapshots and isinstance(x, jax.Array):
+                return np.asarray(x)
+            return x
 
-    def _write(path, payload):
-        with open(path, "wb") as f:
-            f.write(serialization.msgpack_serialize(payload))
-
-    jobs = []
-    if jax.process_index() == 0:
+        model_state = jax.tree_util.tree_map(host_snap, model_state)
+        if optim_state is not None:
+            optim_state = jax.tree_util.tree_map(host_snap, optim_state)
         if layer_states is not None:
-            # pipeline layout: layer params go to per-layer files (reference
-            # pipe/module.py:520-578); the module file keeps placeholders
-            for idx, lp in sorted(layer_states.items()):
-                jobs.append((layer_ckpt_name(ckpt_dir, idx, mp_rank),
-                             _to_host(lp)))
-            model_state = dict(model_state)
-            model_state["module"] = {
-                "layers": [None] * len(model_state["module"]["layers"]),
-                "tied": _to_host(tied_states or {}),
-                "num_layers": len(model_state["module"]["layers"]),
-            }
-        jobs.append((model_ckpt_name(ckpt_dir, mp_rank),
-                     _to_host(model_state)))
-        if optim_skeleton is not None and 0 not in rank_pieces:
-            rank_pieces[0] = {}
+            layer_states = jax.tree_util.tree_map(host_snap, layer_states)
 
-    for rank, pieces in rank_pieces.items():
-        payload: Dict[str, Any] = {"__dstpu_ckpt_v2__": True,
-                                   "pieces": pieces}
-        if rank == 0 and optim_skeleton is not None:
-            payload["state"] = _to_host(optim_skeleton)
-        jobs.append((optim_ckpt_name(ckpt_dir, rank, mp_rank), payload))
+    def build_and_write(parallel: bool) -> int:
+        """Phase 1: split sharded leaves, serialize, land every file by
+        tmp+rename.  `parallel` fans serialization over the writer pool
+        (sync path only: a pool thread submitting to its own pool and
+        waiting could deadlock at max_workers in-flight saves)."""
+        # sharded leaves are split into per-rank piece files; nothing is
+        # gathered across hosts — each process serializes only what it
+        # owns
+        rank_pieces: Dict[int, Dict[str, Any]] = {}
+        mstate = _split_sharded(model_state, rank_pieces, "model:")
+        optim_skeleton = None
+        if optim_state is not None:
+            optim_skeleton = _split_sharded(optim_state, rank_pieces,
+                                            "optim:")
 
+        def _write(path, payload) -> int:
+            return _atomic_write(path,
+                                 serialization.msgpack_serialize(payload))
+
+        jobs = []
+        if jax.process_index() == 0:
+            if layer_states is not None:
+                # pipeline layout: layer params go to per-layer files
+                # (reference pipe/module.py:520-578); the module file
+                # keeps placeholders
+                for idx, lp in sorted(layer_states.items()):
+                    jobs.append((layer_ckpt_name(ckpt_dir, idx, mp_rank),
+                                 _to_host(lp)))
+                mstate = dict(mstate)
+                mstate["module"] = {
+                    "layers": [None] * len(mstate["module"]["layers"]),
+                    "tied": _to_host(tied_states or {}),
+                    "num_layers": len(mstate["module"]["layers"]),
+                }
+            jobs.append((model_ckpt_name(ckpt_dir, mp_rank),
+                         _to_host(mstate)))
+            if optim_skeleton is not None and 0 not in rank_pieces:
+                rank_pieces[0] = {}
+
+        for rank, pieces in rank_pieces.items():
+            payload: Dict[str, Any] = {"__dstpu_ckpt_v2__": True,
+                                       "pieces": pieces}
+            if rank == 0 and optim_skeleton is not None:
+                payload["state"] = _to_host(optim_skeleton)
+            jobs.append((optim_ckpt_name(ckpt_dir, rank, mp_rank), payload))
+
+        if parallel:
+            futures = [_writer.submit(_write, path, payload)
+                       for path, payload in jobs]
+            return sum(f.result() for f in futures)
+        return sum(_write(path, payload) for path, payload in jobs)
+
+    def _finish(parallel: bool, chain_after: Optional[Future]):
+        # phase 1 (every local file durably renamed), then phase 2: the
+        # cross-process commit barrier + marker + latest.  Writes of
+        # DIFFERENT tags overlap freely; commits chain in save-call
+        # order so `latest` always ends on the newest save (a failed
+        # predecessor doesn't block this commit — its own flush
+        # surfaces the error).
+        nbytes = build_and_write(parallel)
+        if chain_after is not None:
+            try:
+                chain_after.result()
+            except Exception:
+                pass
+        _commit(save_dir, tag, meta, save_latest, nbytes,
+                commit_endpoint=commit_endpoint,
+                commit_timeout_ms=commit_timeout_ms, seq=seq)
+
+    root = os.path.realpath(save_dir)
     if async_save:
-        # snapshot host arrays NOW: offload/infinity masters mutate in
-        # place, and the background write must not see later steps
-        jobs = [(path, jax.tree_util.tree_map(
-            lambda x: x.copy() if isinstance(x, np.ndarray) else x, payload))
-            for path, payload in jobs]
-    futures = [_writer.submit(_write, path, payload)
-               for path, payload in jobs]
-    if async_save:
-        _pending.extend(futures)
+        with _pending_lock:
+            prev = _dir_chain.get(root)
+        done = _writer.submit(_finish, False, prev)
+        with _pending_lock:
+            _dir_chain[root] = done
+        _track_pending(save_dir, tag, [done])
+        COUNTERS.add("ckpt.pending", pending_count())
     else:
-        for f in futures:
-            f.result()
-
-    if save_latest and jax.process_index() == 0:
-        def _latest():
-            for fut in futures:  # latest must not point at a partial write
-                fut.result()
-            with open(os.path.join(save_dir, "latest"), "w") as f:
-                f.write(str(tag))
-
-        if async_save:
-            _pending.append(_writer.submit(_latest))
-        else:
-            _latest()
+        with _pending_lock:
+            prev = _dir_chain.get(root)
+        _finish(True, prev)
+        COUNTERS.add("ckpt.pending", 0)
+    COUNTERS.add("ckpt.stall_ms",
+                 int((time.perf_counter() - t0) * 1e6))
     logger.info(f"saved checkpoint {tag} to {ckpt_dir}"
                 + (" (async)" if async_save else ""))
     return ckpt_dir
 
 
 def read_latest_tag(load_dir: str) -> Optional[str]:
+    """The tag training should resume from: the `latest` pointer when its
+    tag is committed (or the directory predates commit markers), else
+    the newest committed tag — a save that died before its commit
+    barrier is invisible here by construction."""
+    tag = None
     latest = os.path.join(load_dir, "latest")
     if os.path.isfile(latest):
         with open(latest) as f:
-            return f.read().strip()
+            tag = f.read().strip() or None
+    if tag is not None and is_tag_committed(load_dir, tag):
+        return tag
+    if not _dir_has_markers(load_dir):
+        # legacy layout (pre-commit-marker saves, incl. the multi-host
+        # pipeline writer's own barriered format): latest is authoritative
+        return tag
+    fallback = committed_tags(load_dir)
+    if fallback:
+        newest = fallback[-1]
+        if tag is not None:
+            logger.warning(
+                f"checkpoint tag {tag!r} in {load_dir} was never "
+                f"committed (interrupted save?); falling back to the "
+                f"newest committed tag {newest!r}")
+        return newest
     return None
 
 
@@ -342,18 +772,39 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
                           resolve_streams: bool = True):
     """Returns (ckpt_dir, model_state, optim_state_or_None).
 
+    Raises FileNotFoundError when there is nothing to resume from, and
+    CheckpointIntegrityError when the requested tag exists but is
+    uncommitted/incomplete (callers must NOT silently start fresh).
+
     resolve_streams=False leaves Infinity stream markers in place so a
     paged engine can walk the group files RAM-bounded instead of
     materializing the full fp32 set here."""
+    # never race an in-flight background save over the same directory
+    flush_pending(load_dir)
+    explicit = tag is not None
     if tag is None:
         tag = read_latest_tag(load_dir)
         if tag is None:
             raise FileNotFoundError(
-                f"no 'latest' file in {load_dir}; pass an explicit tag")
+                f"no committed checkpoint in {load_dir}; pass an explicit "
+                f"tag")
     ckpt_dir = os.path.join(load_dir, str(tag))
+    if not os.path.isdir(ckpt_dir):
+        raise FileNotFoundError(f"checkpoint tag not found: {ckpt_dir}")
+    if explicit and not is_tag_committed(load_dir, tag) and \
+            _dir_has_markers(load_dir):
+        newest = committed_tags(load_dir)
+        raise CheckpointIntegrityError(
+            f"checkpoint tag {tag!r} in {load_dir} exists but has no "
+            f"commit marker ({COMMIT_MARKER}) — the save was interrupted "
+            f"before commit and the tag may be missing files"
+            + (f"; newest committed tag is {newest[-1]!r}" if newest
+               else ""))
     path = model_ckpt_name(ckpt_dir, mp_rank)
     if not os.path.isfile(path):
-        raise FileNotFoundError(f"checkpoint file not found: {path}")
+        raise CheckpointIntegrityError(
+            f"checkpoint tag {tag!r} at {ckpt_dir} is incomplete: "
+            f"missing model states file {os.path.basename(path)}")
     with open(path, "rb") as f:
         model_state = serialization.msgpack_restore(f.read())
 
@@ -373,7 +824,7 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
 
     pieces = _load_rank_pieces(ckpt_dir, mp_rank)
     if pieces:
-        model_state = _reassemble(model_state, pieces)
+        model_state = _reassemble(model_state, pieces, tag=tag)
 
     optim_state = None
     opath = optim_ckpt_name(ckpt_dir, dp_rank, mp_rank)
@@ -383,7 +834,8 @@ def load_checkpoint_state(load_dir: str, tag: Optional[str] = None,
         if isinstance(optim_state, dict) and \
                 optim_state.get("__dstpu_ckpt_v2__"):
             # v2 sharded layout: the skeleton lives in rank 0's file
-            optim_state = _reassemble(optim_state.get("state"), pieces)
+            optim_state = _reassemble(optim_state.get("state"), pieces,
+                                      tag=tag)
     if resolve_streams:
         if has_stream_markers(model_state):
             model_state = resolve_streamed(model_state, ckpt_dir)

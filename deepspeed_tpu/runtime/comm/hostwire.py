@@ -134,6 +134,40 @@ def _kv_get(client, key: str, timeout_ms: int) -> bytes:
     return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
 
 
+class KVSignals:
+    """Tiny point-to-point signal layer on the coordination-service KV —
+    NOT a collective.  Used for per-rank done-keys in the checkpoint
+    commit barrier (runtime/checkpointing.CommitBarrier): each process
+    posts small string values under explicit keys and any process can
+    block on a key appearing.  Values are plain strings (no base64
+    framing — signals are tiny and never binary), keys are caller-scoped.
+
+    `_endpoint=(client, rank, world)` drives the signals over a fake
+    in-memory KV for tests, like HostWire."""
+
+    def __init__(self, _endpoint=None):
+        self.client, self.rank, self.world = (
+            _endpoint if _endpoint is not None else _client())
+        _assert_client_api(self.client)
+
+    def post(self, key: str, value: str = "1") -> None:
+        if self.client is None:
+            return
+        self.client.key_value_set(key, str(value))
+
+    def wait(self, key: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
+        if self.client is None:
+            raise RuntimeError(
+                "KVSignals.wait: no coordination-service client attached "
+                "(single-process run?) — nothing ever posts keys here")
+        return self.client.blocking_key_value_get(key, int(timeout_ms))
+
+    def delete(self, key: str) -> None:
+        if self.client is None:
+            return
+        self.client.key_value_delete(key)
+
+
 class HostWire:
     """Allgather of byte payloads over the coordination-service KV store.
 

@@ -456,9 +456,18 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         self.checkpoint_tag_validation_fail = \
             self.checkpoint_tag_validation_mode == "fail"
         # TPU addition: overlap checkpoint serialization with training
-        # (writes land on background threads; 'latest' updates last)
+        # (serialize+write+commit land on background threads; the commit
+        # marker and 'latest' update last — runtime/checkpointing.py)
         self.checkpoint_async_save = bool(get_scalar_param(
-            ckpt, "async_save", False))
+            ckpt, c.CHECKPOINT_ASYNC_SAVE, c.CHECKPOINT_ASYNC_SAVE_DEFAULT))
+        self.checkpoint_commit_timeout_ms = int(get_scalar_param(
+            ckpt, c.CHECKPOINT_COMMIT_TIMEOUT_MS,
+            c.CHECKPOINT_COMMIT_TIMEOUT_MS_DEFAULT))
+        if self.checkpoint_commit_timeout_ms <= 0:
+            raise ValueError(
+                f"checkpoint.{c.CHECKPOINT_COMMIT_TIMEOUT_MS} must be a "
+                f"positive millisecond count, got "
+                f"{self.checkpoint_commit_timeout_ms}")
 
         self.sparse_attention = pd.get(c.SPARSE_ATTENTION, None)
         self.vocabulary_size = get_scalar_param(pd, c.VOCABULARY_SIZE,

@@ -179,6 +179,10 @@ CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_MODES = ("ignore", "warn", "fail")
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "warn"
+CHECKPOINT_ASYNC_SAVE = "async_save"
+CHECKPOINT_ASYNC_SAVE_DEFAULT = False
+CHECKPOINT_COMMIT_TIMEOUT_MS = "commit_timeout_ms"
+CHECKPOINT_COMMIT_TIMEOUT_MS_DEFAULT = 300_000
 
 #############################################
 # Sparse attention

@@ -634,10 +634,12 @@ class DeepSpeedEngine:
         """Flush the event stream and write end-of-run summaries.  Under
         multi-host the summary merge is collective — call on every rank
         (or skip entirely; per-step events are already durable).  Also
-        settles any deferred step-log lines and stops the input
-        pipeline's background threads."""
+        settles any deferred step-log lines, stops the input pipeline's
+        background threads, and blocks on any async checkpoint writes
+        still in flight — shutdown never abandons an uncommitted tag."""
         self._drain_step_log(force=True)
         self.close_data_pipeline()
+        ckpt_io.flush_pending()
         if self.run_monitor is not None:
             self.run_monitor.close()
         if self.monitor is not None:
@@ -2269,6 +2271,47 @@ class DeepSpeedEngine:
         })
         return state
 
+    def _async_ckpt_snapshot(self, tree):
+        """Device-copy every jax.Array leaf and kick the D2H transfers;
+        host leaves pass through (the checkpoint layer snapshots
+        in-place-mutating numpy masters itself).  All leaves ride ONE
+        jitted copy program — per-leaf jnp.copy costs a dispatch each
+        (~15 ms of blocked training for an MLP-sized tree on the CPU
+        box), the fused program costs one.  jit never aliases these
+        outputs to their inputs (jnp.copy defeats the input-passthrough
+        sharing), so the copies survive later steps donating the
+        original buffers."""
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        idx = [i for i, l in enumerate(leaves) if isinstance(l, jax.Array)]
+        if idx:
+            if not hasattr(self, "_ckpt_copy_fn"):
+                self._ckpt_copy_fn = jax.jit(
+                    lambda xs: [jnp.copy(x) for x in xs])
+            copies = self._ckpt_copy_fn([leaves[i] for i in idx])
+            for i, c in zip(idx, copies):
+                leaves[i] = c
+        snapped = jax.tree_util.tree_unflatten(treedef, leaves)
+        ckpt_io.prefetch_to_host(snapped)
+        return snapped
+
+    def _checkpoint_meta(self):
+        """Saving-run topology recorded in the commit marker — what a
+        restoring run needs to reshard ZeRO-1/2 partitions (incl. hpZ
+        secondary shards) onto its own (dp, hierarchy) layout."""
+        meta = {
+            "world_size": jax.process_count(),
+            "mp_world_size": self.mp_world_size,
+            "dp_world_size": self.dp_world_size,
+            "zero_stage": self.zero_optimization_stage(),
+            "data_outer": 1,
+            "data_inner": self.dp_world_size,
+            "hierarchical": False,
+            "global_steps": self.global_steps,
+        }
+        if self.zero_plan is not None:
+            meta.update(self.zero_plan.partition_layout())
+        return meta
+
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         self._resolve_pending_overflow()  # counters must be settled
@@ -2329,11 +2372,53 @@ class DeepSpeedEngine:
                 if hasattr(self.optimizer, "state_dict") else None),
             "zero_stage": self.zero_optimization_stage(),
         }
+        async_save = bool(getattr(self._config, "checkpoint_async_save",
+                                  False))
+        if async_save:
+            # non-blocking device snapshot right after the step dispatch:
+            # jnp.copy enqueues an identity program per leaf (it runs the
+            # moment the in-flight step finishes — the training thread
+            # never waits), and copy_to_host_async starts the D2H behind
+            # it.  Donation-safe by construction: the copies are fresh
+            # arrays that never enter any step program's donate_argnums,
+            # so the background writer can np.asarray them long after
+            # later steps have donated the ORIGINAL param/opt buffers
+            # away (same discipline as _DeviceFeed's fresh per-place
+            # arrays).
+            model_state, optim_state = self._async_ckpt_snapshot(
+                (model_state, optim_state))
+        snap = COUNTERS.snapshot()
         ckpt_io.save_checkpoint_state(
             save_dir, tag, model_state, optim_state, save_latest=save_latest,
-            async_save=bool(getattr(self._config, "checkpoint_async_save",
-                                    False)))
+            async_save=async_save, meta=self._checkpoint_meta(),
+            commit_timeout_ms=getattr(self._config,
+                                      "checkpoint_commit_timeout_ms",
+                                      ckpt_io.COMMIT_TIMEOUT_MS),
+            device_leaves_are_snapshots=async_save)
+        if self.run_monitor is not None:
+            delta = COUNTERS.delta_since(snap)
+            self.run_monitor.emit("ckpt", {
+                "tag": str(tag),
+                "async": async_save,
+                "stall_ms": round(delta.get("ckpt.stall_ms", {})
+                                  .get("bytes", 0) / 1000.0, 3),
+                "pending": ckpt_io.pending_count(),
+                "step": self.global_steps,
+            })
         return True
+
+    def _log_checkpoint_reshard(self, load_dir, ckpt_dir):
+        """Announce a topology transition recorded in the commit marker
+        (saved (dp, hierarchy, stage) != restoring) — the actual
+        re-partition is the device_put under this run's own sharding
+        plan below; this makes it legible instead of silent."""
+        from .zero.partition import describe_reshard
+
+        marker = ckpt_io.read_tag_meta(load_dir, os.path.basename(ckpt_dir))
+        msg = describe_reshard((marker or {}).get("meta"),
+                               self._checkpoint_meta())
+        if msg:
+            log_dist(msg, ranks=[0])
 
     def _checkpoint_tag_validation(self, tag):
         """All ranks must agree on the tag (reference :1671-1686). In
@@ -2356,22 +2441,27 @@ class DeepSpeedEngine:
             ckpt_dir, model_state, optim_state = ckpt_io.load_checkpoint_state(
                 load_dir, tag, resolve_streams=not paged)
         except FileNotFoundError as e:
+            # nothing to resume from — warn and train fresh.  A tag that
+            # EXISTS but is uncommitted/incomplete raises
+            # CheckpointIntegrityError instead, which propagates: silently
+            # restarting from scratch over a damaged checkpoint would
+            # throw the run away.
             logger.warning(f"load_checkpoint: {e}")
             return None, {}
+        self._log_checkpoint_reshard(load_dir, ckpt_dir)
 
         if self._infinity is not None:
             if paged and ckpt_io.has_stream_markers(model_state["module"]):
-                try:
-                    self._infinity.load_streamed(
-                        ckpt_dir,
-                        optim_state["optimizer_state"]
-                        if (load_optimizer_states
-                            and optim_state is not None
-                            and optim_state.get("offload")) else None)
-                except FileNotFoundError as e:
-                    # pre-flight inside load_streamed: nothing was mutated
-                    logger.warning(f"load_checkpoint: {e}")
-                    return None, {}
+                # an incomplete group-file set raises
+                # CheckpointIntegrityError from load_streamed's pre-flight
+                # (nothing was mutated) and propagates — the tag exists,
+                # so "warn and train fresh" would be the wrong outcome
+                self._infinity.load_streamed(
+                    ckpt_dir,
+                    optim_state["optimizer_state"]
+                    if (load_optimizer_states
+                        and optim_state is not None
+                        and optim_state.get("offload")) else None)
             else:
                 # non-paged engines got markers resolved by
                 # load_checkpoint_state (resolve_streams=True above)

@@ -1345,6 +1345,18 @@ class PipelineEngine(DeepSpeedEngine):
         _fsync_dir(ckpt_dir)
         _fsync_dir(save_dir)
         self._gscal.sum(np.zeros(1, np.float32))
+        if me == 0:
+            # the collective barrier above IS this writer's commit
+            # rendezvous: every process's files are durable, so publish
+            # the commit marker (keeps mh tags first-class for
+            # read_latest_tag's committed-tag resolution — a marker-less
+            # tag in a marker-bearing dir would be skipped as torn)
+            ckpt_io.write_commit_marker(
+                save_dir, tag,
+                meta={"world_size": jax.process_count(),
+                      "pipeline_parts": list(module.parts),
+                      "zero_stage": self.zero_optimization_stage()},
+                world_size=jax.process_count())
         if save_latest and me == 0:
             # atomic publish: write-tmp-then-rename so a crash mid-write
             # can't leave a truncated `latest`

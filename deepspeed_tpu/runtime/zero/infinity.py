@@ -635,7 +635,7 @@ class InfinityRuntime:
                    if not os.path.isfile(
                        ckpt_io.stream_group_ckpt_name(ckpt_dir, name))]
         if missing:
-            raise FileNotFoundError(
+            raise ckpt_io.CheckpointIntegrityError(
                 f"streamed checkpoint incomplete: missing group files for "
                 f"{missing} in {ckpt_dir}")
         self._kept.clear()

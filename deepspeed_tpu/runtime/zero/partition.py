@@ -266,6 +266,23 @@ class ZeroShardingPlan:
                 p, NamedSharding(self.mesh_info.mesh, s)),
             params, self.param_spec)
 
+    def partition_layout(self) -> dict:
+        """The facts a checkpoint must record for resharding-on-restore:
+        where stage-1/2 partitions live (full dp vs hpZ inner-only) is a
+        function of all of these, so a restore at ANY different tuple
+        re-partitions (runtime/checkpointing.py stores this in the
+        commit marker; engine.load_checkpoint logs the transition)."""
+        mi = self.mesh_info
+        return {
+            "zero_stage": self.stage,
+            "dp_world_size": mi.axis_size(DATA_AXIS),
+            "data_outer": mi.data_outer_size if mi.hierarchical else 1,
+            "data_inner": (mi.data_inner_size if mi.hierarchical
+                           else mi.axis_size(DATA_AXIS)),
+            "partition_size": self.partition_size,
+            "hierarchical": bool(mi.hierarchical),
+        }
+
     def describe(self) -> str:
         n_shard = 0
         n_total = 0
@@ -283,3 +300,28 @@ class ZeroShardingPlan:
                  else f"{self.partition_size} shards")
         return (f"ZeRO stage {self.stage}: {n_shard}/{n_total} tensors "
                 f"dp-sharded over {where}")
+
+
+def describe_reshard(saved: Optional[dict], current: dict) -> Optional[str]:
+    """Human-readable description of a checkpoint topology transition, or
+    None when the saved and restoring layouts match (nothing to reshard
+    beyond placement).  `saved` is a partition_layout() dict out of the
+    checkpoint's commit marker; unknown/legacy checkpoints (None) return
+    None — there is nothing trustworthy to compare against."""
+    if not saved:
+        return None
+
+    def fmt(lay: dict) -> str:
+        dp = lay.get("dp_world_size", "?")
+        outer = int(lay.get("data_outer", 1) or 1)
+        hier = (f"hierarchy {outer}x{lay.get('data_inner', '?')}"
+                if outer > 1 else "flat")
+        return f"dp={dp} ({hier}), ZeRO stage {lay.get('zero_stage', '?')}"
+
+    keys = ("zero_stage", "dp_world_size", "data_outer", "data_inner")
+    if all(saved.get(k) == current.get(k) for k in keys):
+        return None
+    return (f"resharding checkpoint state: saved at {fmt(saved)} -> "
+            f"restoring at {fmt(current)} (ZeRO-1/2 partitions, including "
+            f"hpZ secondary shards, re-partition to the new layout on "
+            f"device_put)")

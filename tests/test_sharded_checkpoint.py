@@ -82,7 +82,10 @@ def test_missing_rank_file_fails_loudly(tmp_path):
     assert victims
     os.remove(victims[0])
     fresh = _engine(zero_stage=2)
-    with pytest.raises(FileNotFoundError, match="pieces"):
+    # a tag that EXISTS but is missing pieces is corruption, not "no
+    # checkpoint": CheckpointIntegrityError, never FileNotFoundError
+    # (which engines swallow to start fresh)
+    with pytest.raises(ckpt_io.CheckpointIntegrityError, match="pieces"):
         ckpt_io.load_checkpoint_state(str(tmp_path), "broken")
 
 

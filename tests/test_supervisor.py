@@ -1,16 +1,20 @@
 """Restart supervisor (beyond-reference failure recovery; SURVEY §5's
-missing elastic-recovery loop): relaunch-on-failure with backoff, budget
-reset after long-lived children, checkpoint-resumed training across a
-forced crash."""
+missing elastic-recovery loop): relaunch-on-failure with jittered
+exponential backoff under a rolling restart-budget window, budget reset
+after long-lived children, heartbeat-driven elastic restarts, and
+checkpoint-resumed training across a forced crash."""
 
+import json
 import os
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
 
-from deepspeed_tpu.elasticity.supervisor import supervise
+from deepspeed_tpu.elasticity.supervisor import (HeartbeatWatcher,
+                                                 RestartPolicy, supervise)
 
 
 def test_succeeds_first_try(tmp_path):
@@ -132,6 +136,277 @@ def test_signal_killed_child_maps_to_128_plus_signum(tmp_path):
          "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"],
         max_restarts=1, backoff=0.01, backoff_cap=0.02)
     assert rc == 128 + 9
+
+
+# ---------------------------------------------------------------------------
+# RestartPolicy: the backoff/budget state machine (unit, no subprocesses)
+# ---------------------------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class _FixedRng:
+    """uniform(a, b) -> a deterministic point of the interval."""
+
+    def __init__(self, frac=0.5):
+        self.frac = frac
+
+    def uniform(self, a, b):
+        return a + (b - a) * self.frac
+
+
+def test_policy_backoff_doubles_to_cap():
+    p = RestartPolicy(max_restarts=100, backoff=1.0, backoff_cap=8.0,
+                      jitter=0.0, clock=_Clock(), rng=_FixedRng())
+    delays = [p.record_failure(0.0) for _ in range(6)]
+    assert delays == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
+
+
+def test_policy_jitter_bounds():
+    lo = RestartPolicy(max_restarts=100, backoff=10.0, jitter=0.25,
+                       clock=_Clock(), rng=_FixedRng(0.0))
+    hi = RestartPolicy(max_restarts=100, backoff=10.0, jitter=0.25,
+                       clock=_Clock(), rng=_FixedRng(1.0))
+    assert lo.record_failure(0.0) == pytest.approx(7.5)
+    assert hi.record_failure(0.0) == pytest.approx(12.5)
+    # real rng stays inside the band
+    p = RestartPolicy(max_restarts=100, backoff=10.0, jitter=0.25,
+                      clock=_Clock())
+    for _ in range(50):
+        p._delay = 10.0
+        assert 7.5 <= p.record_failure(0.0) <= 12.5
+
+
+def test_policy_budget_exhausts_without_window():
+    p = RestartPolicy(max_restarts=2, backoff=0.1, jitter=0.0,
+                      clock=_Clock(), rng=_FixedRng())
+    assert p.record_failure(0.0) is not None
+    assert p.record_failure(0.0) is not None
+    assert p.record_failure(0.0) is None  # 3rd failure: give up
+
+
+def test_policy_window_refills_budget_as_time_passes():
+    clock = _Clock()
+    p = RestartPolicy(max_restarts=2, backoff=0.1, jitter=0.0,
+                      restart_window=60.0, clock=clock, rng=_FixedRng())
+    assert p.record_failure(0.0) is not None
+    clock.now += 10
+    assert p.record_failure(0.0) is not None
+    # inside the window: a third failure exhausts the budget...
+    clock.now += 10
+    assert p.record_failure(0.0) is None
+    # ...but once the early failures age out of the 60s window the
+    # budget refills (N restarts per T seconds, not N ever)
+    clock.now += 55  # first two failures now > 60s old
+    assert p.failures_in_window == 1
+    assert p.record_failure(0.0) is not None
+
+
+def test_policy_long_lived_child_resets_backoff_and_budget():
+    clock = _Clock()
+    p = RestartPolicy(max_restarts=2, backoff=1.0, jitter=0.0,
+                      success_window=300.0, clock=clock, rng=_FixedRng())
+    assert p.record_failure(0.0) == 1.0
+    assert p.record_failure(0.0) == 2.0
+    # a child that survived past success_window earns everything back
+    assert p.record_failure(4000.0) == 1.0
+    assert p.record_failure(0.0) == 2.0
+    assert p.record_failure(0.0) is None
+
+
+def test_policy_rejects_bad_jitter():
+    with pytest.raises(ValueError, match="jitter"):
+        RestartPolicy(jitter=1.5)
+
+
+def test_supervise_gives_up_nonzero_within_restart_window(tmp_path):
+    """End-to-end: N failures inside the window -> nonzero exit with the
+    child's code."""
+    rc = supervise([sys.executable, "-c", "import sys; sys.exit(9)"],
+                   max_restarts=2, backoff=0.01, backoff_cap=0.02,
+                   restart_window=3600.0)
+    assert rc == 9
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatWatcher: monitor-stream health view (unit, synthetic run dir)
+# ---------------------------------------------------------------------------
+
+
+def _write_events(run_dir, events, rank=0):
+    os.makedirs(run_dir, exist_ok=True)
+    path = os.path.join(run_dir, f"events.rank{rank:05d}.jsonl")
+    with open(path, "a") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return path
+
+
+def _hb(step, stragglers, world=4):
+    return {"v": 1, "type": "heartbeat", "rank": 0, "t": time.time(),
+            "step": step,
+            "beats": [{"rank": r, "step": step, "wall_s": 0.1}
+                      for r in range(world)],
+            "stragglers": stragglers}
+
+
+def test_watcher_healthy_run_stays_quiet(tmp_path):
+    clock = _Clock()
+    run = str(tmp_path / "run")
+    _write_events(run, [_hb(10, [])])
+    w = HeartbeatWatcher(run, stall_timeout=3600.0, clock=clock)
+    assert w.check() is None
+
+
+def test_watcher_detects_stalled_stream(tmp_path):
+    clock = _Clock()
+    run = str(tmp_path / "run")
+    path = _write_events(run, [{"v": 1, "type": "step", "rank": 0,
+                                "t": clock.now, "step": 1}])
+    os.utime(path, (clock.now, clock.now))
+    w = HeartbeatWatcher(run, stall_timeout=60.0, clock=clock)
+    assert w.check() is None          # fresh stream: quiet
+    clock.now += 120                  # stream stops growing
+    trig = w.check()
+    assert trig is not None and "stall-timeout" in trig["reason"]
+    # reset() re-arms liveness for a relaunched child (no instant
+    # re-trigger off the stale files): the fresh child gets a full
+    # stall_timeout before the stale mtimes can matter again
+    w.reset()
+    assert w.check() is None
+    clock.now += 120                  # relaunched child ALSO went quiet
+    assert w.check() is not None
+
+
+def test_watcher_no_events_yet_counts_from_arming(tmp_path):
+    """Before the child writes anything, liveness counts from watcher
+    start — a child too broken to even open its stream still trips."""
+    clock = _Clock()
+    run = str(tmp_path / "empty")
+    os.makedirs(run)
+    w = HeartbeatWatcher(run, stall_timeout=30.0, clock=clock)
+    assert w.check() is None
+    clock.now += 60
+    assert w.check() is not None
+
+
+def test_watcher_straggler_needs_consecutive_strikes(tmp_path):
+    run = str(tmp_path / "run")
+    with open(os.path.join(str(tmp_path), "manifest"), "w"):
+        pass
+    os.makedirs(run, exist_ok=True)
+    with open(os.path.join(run, "manifest.json"), "w") as f:
+        json.dump({"world_size": 4}, f)
+    w = HeartbeatWatcher(run, stall_timeout=0.0, straggler_strikes=3)
+    _write_events(run, [_hb(10, [2])])
+    assert w.check() is None          # strike 1
+    _write_events(run, [_hb(20, [2])])
+    assert w.check() is None          # strike 2
+    _write_events(run, [_hb(30, [])])
+    assert w.check() is None          # healthy beat clears the count
+    _write_events(run, [_hb(40, [2]), _hb(50, [2]), _hb(60, [2])])
+    trig = w.check()                  # 3 consecutive strikes
+    assert trig is not None
+    assert trig["dead_ranks"] == [2]
+    assert trig["surviving_world"] == 3
+    assert "rank(s) [2]" in trig["reason"]
+
+
+def test_watcher_does_not_recount_old_heartbeats(tmp_path):
+    run = str(tmp_path / "run")
+    _write_events(run, [_hb(10, [1]), _hb(20, [1])])
+    w = HeartbeatWatcher(run, stall_timeout=0.0, straggler_strikes=3)
+    assert w.check() is None   # 2 strikes from the backlog
+    assert w.check() is None   # same events again: NOT a 3rd strike
+    assert w.check() is None
+
+
+def test_watcher_reset_discards_triggering_heartbeats(tmp_path):
+    """After a restart, the stale heartbeats that justified it must not
+    re-trigger against the fresh child (the relaunched run appends to
+    the same stream); NEW strikes after the reset still trigger."""
+    run = str(tmp_path / "run")
+    w = HeartbeatWatcher(run, stall_timeout=0.0, straggler_strikes=2)
+    _write_events(run, [_hb(10, [3]), _hb(20, [3])])
+    assert w.check() is not None   # 2 consecutive strikes -> trigger
+    w.reset()
+    assert w.check() is None       # stale events skipped, not recounted
+    assert w.check() is None
+    _write_events(run, [_hb(30, [3]), _hb(40, [3])])
+    assert w.check() is not None   # fresh strikes trigger again
+
+
+def test_supervise_enables_straggler_watch_without_stall_timeout(
+        tmp_path):
+    """--monitor-dir alone (stall-timeout 0) must still arm straggler
+    detection: a child whose stream shows consecutive straggler flags
+    is restarted."""
+    run_dir = tmp_path / "run"
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, os, sys, time
+        run = {str(run_dir)!r}
+        os.makedirs(run, exist_ok=True)
+        if os.environ.get("DSTPU_ELASTIC_RESTART") == "1":
+            sys.exit(0)
+        with open(os.path.join(run, "events.rank00000.jsonl"), "a") as f:
+            for step in (10, 20, 30):
+                f.write(json.dumps({{"v": 1, "type": "heartbeat",
+                                     "rank": 0, "t": time.time(),
+                                     "step": step,
+                                     "beats": [], "stragglers": [1]}})
+                        + "\\n")
+        time.sleep(600)
+    """))
+    t0 = time.time()
+    rc = supervise([sys.executable, str(script)],
+                   max_restarts=3, backoff=0.05, backoff_cap=0.1,
+                   monitor_dir=str(run_dir), stall_timeout=0.0,
+                   straggler_strikes=3, grace=5.0, poll_interval=0.2)
+    assert rc == 0
+    assert time.time() - t0 < 60
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-driven elastic restart, end to end (no jax in the child)
+# ---------------------------------------------------------------------------
+
+
+def test_stalled_child_is_restarted_with_elastic_env(tmp_path):
+    """A child that stops writing monitor events gets torn down
+    (SIGTERM-first) and relaunched with DSTPU_ELASTIC_RESTART/_REASON in
+    its environment; the relaunch succeeds -> supervisor exits 0."""
+    run_dir = tmp_path / "run"
+    script = tmp_path / "job.py"
+    script.write_text(textwrap.dedent(f"""
+        import json, os, sys, time
+        run = {str(run_dir)!r}
+        os.makedirs(run, exist_ok=True)
+        if os.environ.get("DSTPU_ELASTIC_RESTART") == "1":
+            # the relaunch: record the reason we were given and finish
+            open(os.path.join(run, "elastic_env"), "w").write(
+                os.environ.get("DSTPU_ELASTIC_REASON", ""))
+            sys.exit(0)
+        with open(os.path.join(run, "events.rank00000.jsonl"), "a") as f:
+            f.write(json.dumps({{"v": 1, "type": "step", "rank": 0,
+                                 "t": time.time(), "step": 1}}) + "\\n")
+        time.sleep(600)   # hung collective: stream never grows again
+    """))
+    t0 = time.time()
+    rc = supervise([sys.executable, str(script)],
+                   max_restarts=3, backoff=0.05, backoff_cap=0.1,
+                   monitor_dir=str(run_dir), stall_timeout=2.0,
+                   grace=5.0, poll_interval=0.2)
+    assert rc == 0
+    assert time.time() - t0 < 60      # did NOT sit out the 600s sleep
+    reason = (run_dir / "elastic_env").read_text()
+    assert "stall-timeout" in reason
 
 
 def test_sigterm_during_backoff_stops_promptly(tmp_path):

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Checkpoint-stall bench: ms of blocked training per checkpoint, sync
+vs async save through the two-phase-commit writer.
+
+At pod scale a checkpoint stall is a direct throughput tax: with the
+synchronous writer every save blocks training for the full host
+snapshot + msgpack serialize + atomic write + commit.  The async writer
+(`"checkpoint": {"async_save": true}`) keeps only the host snapshot on
+the training thread and moves serialize+write+commit to a background
+thread (runtime/checkpointing.py), so the stall collapses to the D2H
+copy.  Both lanes produce byte-identical committed tags — this tool
+asserts that by loading the final checkpoint of each lane and comparing
+every leaf.
+
+Reported per lane:
+
+  stall_ms_per_save   the engine's own `ckpt.stall_ms` counter delta
+                      (wall time the training thread spent inside
+                      save_checkpoint) / number of saves
+  save_call_ms        median wall of the save_checkpoint call (same
+                      quantity measured from outside)
+  step_ms             end-to-end wall per train-step+save cycle,
+                      including the final flush — the async lane's
+                      background writes are NOT free, they are just
+                      off the training thread
+  ckpt_mb             committed bytes per tag
+
+The headline value is stall_sync / stall_async.  Results are recorded
+through monitor/artifacts.py into bench_artifacts/runs/ + manifest.jsonl
+(the PR-2 durable-artifact rule).
+
+Usage: python tools/ckpt_bench.py [--steps 8] [--dim 512] [--batch 32]
+           [--no-record]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+
+def _mlp(dim, out):
+    """Two-layer MLP TrainModule sized so a checkpoint is meaningfully
+    large (dim=1024 -> ~4 MB params, ~12.6 MB with Adam moments)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.module import TrainModule
+
+    class MLP(TrainModule):
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (dim, dim)) * 0.1,
+                    "b1": jnp.zeros((dim,)),
+                    "w2": jax.random.normal(k2, (dim, out)) * 0.1,
+                    "b2": jnp.zeros((out,))}
+
+        def loss(self, params, batch, rng=None, train=True, **kw):
+            x, y = batch
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            pred = h @ params["w2"] + params["b2"]
+            return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+    return MLP()
+
+
+def _batches(steps, batch, dim, out, seed=0):
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    w = rng.randn(dim, out).astype(np.float32)
+    for _ in range(steps):
+        x = rng.randn(batch, dim).astype(np.float32)
+        yield (x, x @ w)
+
+
+def _lane(async_save, ckpt_dir, args_ns):
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    cfg = {
+        "train_batch_size": args_ns["batch"],
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "checkpoint": {"async_save": bool(async_save)},
+    }
+    engine, *_ = ds.initialize(model=_mlp(args_ns["dim"], 4),
+                               config_params=cfg)
+    steps = args_ns["steps"]
+    it = _batches(steps + args_ns["warmup"], args_ns["batch"],
+                  args_ns["dim"], 4)
+    for _ in range(args_ns["warmup"]):
+        engine.train_batch(it)
+    # warmup save: compiles the snapshot-copy programs and touches the
+    # page cache so the measured saves see steady state (same tag is
+    # overwritten by the first measured save)
+    engine.save_checkpoint(ckpt_dir, tag="step0")
+    ckpt_io.flush_pending()
+    snap_all = COUNTERS.snapshot()
+    stalls_us = []
+    save_walls = []
+    t_all0 = time.perf_counter()
+    for i in range(steps):
+        engine.train_batch(it)
+        snap = COUNTERS.snapshot()
+        t0 = time.perf_counter()
+        engine.save_checkpoint(ckpt_dir, tag=f"step{i}")
+        save_walls.append(time.perf_counter() - t0)
+        stalls_us.append(COUNTERS.delta_since(snap)
+                         .get("ckpt.stall_ms", {}).get("bytes", 0))
+    ckpt_io.flush_pending()  # background writes are part of step_ms
+    wall = time.perf_counter() - t_all0
+    delta = COUNTERS.delta_since(snap_all)
+    nbytes = delta.get("ckpt.bytes", {}).get("bytes", 0)
+    assert delta.get("ckpt.bytes", {}).get("calls") == steps, \
+        "every save must commit exactly once"
+    engine.finalize_monitoring()
+    params = [np.asarray(l) for l in
+              __import__("jax").tree_util.tree_leaves(engine.params)]
+    return {
+        # median: fsync cost on shared boxes is spiky, and the point is
+        # the steady-state stall per checkpoint
+        "stall_ms_per_save": round(float(np.median(stalls_us)) / 1000.0,
+                                   3),
+        "stall_ms_total": round(sum(stalls_us) / 1000.0, 3),
+        "save_call_ms": round(float(np.median(save_walls)) * 1e3, 3),
+        "step_ms": round(wall / steps * 1e3, 3),
+        "ckpt_mb": round(nbytes / 1e6 / steps, 3),
+        "loss": round(float(engine._last_loss), 6),
+    }, params
+
+
+def run_bench(steps=8, warmup=2, batch=32, dim=1024, ckpt_root=None,
+              artifact_root=None, record=True):
+    import numpy as np
+
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    args_ns = {"steps": steps, "warmup": warmup, "batch": batch,
+               "dim": dim}
+    root = ckpt_root or tempfile.mkdtemp(prefix="ckpt_bench_")
+    made_root = ckpt_root is None
+    try:
+        sync, sync_params = _lane(False, os.path.join(root, "sync"),
+                                  args_ns)
+        async_, async_params = _lane(True, os.path.join(root, "async"),
+                                     args_ns)
+        # identical restored state: both lanes trained the same stream,
+        # and the async writer must have committed exactly what sync did
+        for which, lane_dir, live in (("sync", "sync", sync_params),
+                                      ("async", "async", async_params)):
+            tag = ckpt_io.read_latest_tag(os.path.join(root, lane_dir))
+            assert tag == f"step{steps - 1}", (which, tag)
+            _, m, _o = ckpt_io.load_checkpoint_state(
+                os.path.join(root, lane_dir), tag)
+            restored = [np.asarray(l) for l in __import__("jax")
+                        .tree_util.tree_leaves(m["module"])]
+            for a, b in zip(restored, live):
+                np.testing.assert_array_equal(a, b)
+        for a, b in zip(sync_params, async_params):
+            np.testing.assert_array_equal(a, b)
+        assert sync["loss"] == async_["loss"], \
+            f"parity broke: async save changed the training stream " \
+            f"({sync['loss']} vs {async_['loss']})"
+    finally:
+        if made_root:
+            shutil.rmtree(root, ignore_errors=True)
+    result = {
+        "metric": "ckpt_stall",
+        "platform": "cpu",
+        "steps": steps,
+        "batch": batch,
+        "dim": dim,
+        "sync": sync,
+        "async": async_,
+        "value": round(sync["stall_ms_per_save"]
+                       / max(async_["stall_ms_per_save"], 1e-9), 2),
+        "unit": "x_stall_reduction",
+    }
+    if record:
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        result["artifact"] = record_bench_result(
+            result, root=artifact_root, name=result["metric"])
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=32)
+    ap.add_argument("--dim", type=int, default=1024,
+                    help="MLP width (checkpoint size knob; 1024 -> "
+                    "~12.6 MB per tag with Adam moments)")
+    ap.add_argument("--ckpt-dir", type=str, default=None,
+                    help="checkpoint scratch dir (default: a tempdir, "
+                    "removed afterwards)")
+    ap.add_argument("--no-record", action="store_true",
+                    help="skip the bench_artifacts/ write")
+    args = ap.parse_args()
+
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    result = run_bench(steps=args.steps, warmup=args.warmup,
+                       batch=args.batch, dim=args.dim,
+                       ckpt_root=args.ckpt_dir,
+                       record=not args.no_record)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
