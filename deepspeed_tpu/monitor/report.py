@@ -248,20 +248,40 @@ def render_markdown(run: Dict[str, Any]) -> str:
     if intra or inter:
         lines.append("## Gradient wire levels (hierarchical reduction)")
         lines.append("")
-        lines.append("| level | fabric | collectives | bytes |")
-        lines.append("|---|---|---|---|")
+        lines.append("| level | fabric | collectives | wire bytes | "
+                     "logical payload |")
+        lines.append("|---|---|---|---|---|")
+
+        def _logical(name):
+            d = any_comm.get(name)
+            # wire bytes include inner/block padding; the logical twin
+            # prices the same wire pad-free (absent on pre-quant runs)
+            return _fmt_bytes(d["bytes"]) if d else "—"
+
         if intra:
             lines.append(f"| intra-group | fast (ICI/intra-process) | "
                          f"{intra['calls']:,} | "
-                         f"{_fmt_bytes(intra['bytes'])} |")
+                         f"{_fmt_bytes(intra['bytes'])} | "
+                         f"{_logical('grad_wire.intra_logical')} |")
         if inter:
             lines.append(f"| inter-group | slow (DCN/TCP) | "
                          f"{inter['calls']:,} | "
-                         f"{_fmt_bytes(inter['bytes'])} |")
+                         f"{_fmt_bytes(inter['bytes'])} | "
+                         f"{_logical('grad_wire.inter_logical')} |")
         if intra and inter and inter["bytes"]:
             lines.append("")
             lines.append(f"slow-fabric share of grad-wire traffic: "
                          f"{100.0 * inter['bytes'] / (intra['bytes'] + inter['bytes']):.1f}%")
+        lines.append("")
+
+    qwz = any_comm.get("qwz.gather")
+    if qwz:
+        lines.append("## qwZ quantized parameter gather (ZeRO-3)")
+        lines.append("")
+        lines.append(f"Stage-3 parameters gathered as quantized blocks + "
+                     f"fp16 scales: {_fmt_bytes(qwz['bytes'])} over "
+                     f"{qwz['calls']:,} collectives (master weights stay "
+                     f"full precision).")
         lines.append("")
 
     pipe = next((s["pipe"] for s in summaries.values() if s["pipe"]), None)

@@ -1,10 +1,13 @@
-from .bucketing import BucketPlan, WIRE_MODES
+from .bucketing import BucketPlan, GATHER_WIRES, WIRE_MODES
 from .compressed import CompressedBackend, compressed_allreduce
 from .compressed_ar import (compressed_all_reduce, decompose,
                             decompose_int8_safe, reconstruct)
 from .hostwire import HostWire, HostWireBackend
+from .quant import (QUANT_WIRES, dequantize_blockwise, payload_bytes,
+                    quantize_blockwise)
 
-__all__ = ["BucketPlan", "WIRE_MODES", "CompressedBackend",
-           "compressed_allreduce", "compressed_all_reduce", "decompose",
-           "decompose_int8_safe", "reconstruct", "HostWire",
-           "HostWireBackend"]
+__all__ = ["BucketPlan", "WIRE_MODES", "GATHER_WIRES", "QUANT_WIRES",
+           "CompressedBackend", "compressed_allreduce",
+           "compressed_all_reduce", "decompose", "decompose_int8_safe",
+           "reconstruct", "quantize_blockwise", "dequantize_blockwise",
+           "payload_bytes", "HostWire", "HostWireBackend"]

@@ -38,15 +38,26 @@ recipe (stage2.py:614-745 flatten/reduce machinery, ZeRO §5 of
   lowers per level: `psum_scatter` over `data_inner` (fast fabric, full
   bucket) -> inter-group collective over `data_outer` on the 1/inner
   shard only (slow fabric — each level selects its own wire mode, so
-  this hop can ride bf16 or the 24-bit split gather while the fast hop
-  stays exact) -> `all_gather` over `data_inner` back to the full
-  bucket.  Slow-fabric bytes drop by the inner-group factor vs the flat
-  wire.  Under ZeRO >= 2 the final gather is skipped entirely: buckets
-  leave sharded over `data_inner`, which is exactly where the hpZ-style
-  secondary optimizer partitions live (zero/partition.py places shards
-  on `data_inner` only), so the post-step parameter all-gather is
+  this hop can ride bf16, the 24-bit split gather, or the blockwise
+  int8/int4 quantized gather while the fast hop stays exact) ->
+  `all_gather` over `data_inner` back to the full bucket.  Slow-fabric
+  bytes drop by the inner-group factor vs the flat wire.  Under
+  ZeRO >= 2 the final gather is skipped entirely: buckets leave sharded
+  over `data_inner`, which is exactly where the hpZ-style secondary
+  optimizer partitions live (zero/partition.py places shards on
+  `data_inner` only), so the post-step parameter all-gather is
   intra-group and the inter-group cost is just the scatter already
   paid.
+* The "int8" / "int4" wires are qgZ's compression half (comm/quant.py):
+  each rank blockwise-quantizes its contribution ONCE (per-block fp16
+  scales ride the wire alongside the payload), the narrow bytes
+  all-gather, and every rank dequantizes to fp32 and sums locally — the
+  reduction always happens in the wide accumulator, so quantization
+  error never compounds across ranks.  Like "split" they are
+  gather-structured (a psum cannot carry scales), so they cannot run
+  the intra-group scatter level; placed on the OUTER hop they are
+  priced per outer group, exactly where the Frontier-class
+  low-bandwidth-partitioning recipe wants the hardest compression.
 
 Every traced collective records its payload into the monitor COUNTERS
 (`bucket.*`, traced-occurrence semantics like `dist.*`); the engine adds
@@ -67,11 +78,31 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ...comm.mesh import DATA_AXIS
+from .quant import (DEFAULT_BLOCK_SIZE, QUANT_WIRES, payload_bytes,
+                    validate_block_size)
 
-WIRE_MODES = ("fp32", "bf16", "split")
+WIRE_MODES = ("fp32", "bf16", "split", "int8", "int4")
 
-# bytes per element actually handed to the collective, per wire mode
+# wires that ride all-gather semantics (narrow dtypes + sideband data
+# stay ON the wire; an arithmetic reduce would upcast before the
+# transfer and, for the quantized wires, has no way to carry scales)
+GATHER_WIRES = ("split",) + QUANT_WIRES
+
+# bytes per element actually handed to the collective, per fixed-width
+# wire mode (the quantized wires price via quant.payload_bytes — their
+# per-element cost depends on the block size)
 _WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2, "split": 3}  # fp16 m + int8 e
+
+
+def wire_nbytes(n_elems: int, wire: str, block: int, *,
+                padded: bool = True) -> int:
+    """Exact per-rank wire bytes for `n_elems` elements in `wire` mode.
+    `padded=False` prices the logical payload (no block-padding
+    overhead) for the `*_logical` counters; fixed-width wires have no
+    block padding, so both views agree there."""
+    if wire in QUANT_WIRES:
+        return payload_bytes(n_elems, wire, block, padded=padded)
+    return n_elems * _WIRE_ITEMSIZE[wire]
 
 
 def _record(op: str, nbytes: int) -> None:
@@ -122,7 +153,8 @@ class BucketPlan:
     def __init__(self, grad_tree, *, dp_size: int, axis: str = DATA_AXIS,
                  bucket_elems: int, wire: str = "fp32",
                  scatter: bool = False,
-                 levels: Optional[Tuple[WireLevel, WireLevel]] = None):
+                 levels: Optional[Tuple[WireLevel, WireLevel]] = None,
+                 quant_block: int = DEFAULT_BLOCK_SIZE):
         if wire not in WIRE_MODES:
             raise ValueError(
                 f"unknown wire mode {wire!r}; choose from {WIRE_MODES}")
@@ -131,6 +163,11 @@ class BucketPlan:
                              f"got {bucket_elems}")
         if levels is not None:
             inner, outer = levels[0], levels[1]
+            for name, lvl in (("inner", inner), ("outer", outer)):
+                if lvl.wire not in WIRE_MODES:
+                    raise ValueError(
+                        f"unknown {name}-level wire mode {lvl.wire!r}; "
+                        f"choose from {WIRE_MODES}")
             if inner.size * outer.size != int(dp_size):
                 raise ValueError(
                     f"hierarchy levels {outer.size} x {inner.size} do not "
@@ -140,27 +177,25 @@ class BucketPlan:
                     f"hierarchy levels must both be > 1 (got outer="
                     f"{outer.size}, inner={inner.size}); use a flat plan "
                     "for a single-level reduction")
-            if inner.wire == "split":
-                # gather-structured: an intra-level split would
+            if inner.wire in GATHER_WIRES:
+                # gather-structured: an intra-level gather wire would
                 # re-materialize the full bucket on every rank and hand
                 # the OUTER hop full-width payloads — the hierarchy's
-                # whole point inverted.  Config sanitizes this to fp32;
-                # direct constructions must not slip through.
+                # whole point inverted (and a psum_scatter has no way to
+                # carry the quantized wires' per-block scales).  Config
+                # sanitizes an inherited request to fp32; direct
+                # constructions must not slip through.
                 raise ValueError(
-                    "the split wire is gather-structured and cannot run "
-                    "the intra-group scatter level; use fp32 or bf16 for "
-                    "the inner wire")
-            if inner.wire not in WIRE_MODES or outer.wire not in WIRE_MODES:
-                raise ValueError(
-                    f"per-level wire modes must be from {WIRE_MODES}, got "
-                    f"inner={inner.wire!r}, outer={outer.wire!r}")
+                    f"the {inner.wire} wire is gather-structured and "
+                    "cannot run the intra-group scatter level; use fp32 "
+                    "or bf16 for the inner wire")
             self.levels: Optional[Tuple[WireLevel, WireLevel]] = \
                 (inner, outer)
         else:
             self.levels = None
-        if scatter and wire == "split" and levels is None:
-            # the split wire is gather-structured; a scattered gather
-            # would re-materialize the full bucket anyway.  Callers
+        if scatter and wire in GATHER_WIRES and levels is None:
+            # gather wires re-materialize the full bucket on every rank
+            # anyway, so a scattered lowering buys nothing.  Callers
             # (engine._build_bucket_plan) log the fallback.
             scatter = False
         self.axis = axis
@@ -168,6 +203,7 @@ class BucketPlan:
         self.wire = wire
         self.scatter = bool(scatter)
         self.bucket_elems = int(bucket_elems)
+        self.quant_block = validate_block_size(quant_block)
 
         leaves, self.treedef = jax.tree_util.tree_flatten(grad_tree)
         self._leaf_shapes = [tuple(l.shape) for l in leaves]
@@ -196,35 +232,56 @@ class BucketPlan:
         # wire accounting, fixed at plan-build time.  For hierarchical
         # plans the intra/inter split is the headline number: inter
         # (slow-fabric) bytes are the 1/inner-size shard per bucket.
+        # Each figure also gets a *_logical twin pricing the same wire
+        # with zero padding overhead — bucket padding to inner/block
+        # multiples otherwise inflates the byte counters and masks part
+        # of a compression win in BENCH comparisons.
+        blk = self.quant_block
         if self.levels is not None:
             inner, outer = self.levels
-            isz_in = _WIRE_ITEMSIZE[inner.wire]
-            isz_out = _WIRE_ITEMSIZE[outer.wire]
             # dense: scatter + gather legs on the fast fabric; ZeRO>=2
             # keeps buckets scattered — the gather leg never runs
             intra_legs = 1 if self.scatter else 2
             self.wire_bytes_intra_per_reduction = sum(
-                b.padded * isz_in * intra_legs for b in self.buckets)
+                wire_nbytes(b.padded, inner.wire, blk) * intra_legs
+                for b in self.buckets)
+            self.wire_bytes_intra_logical_per_reduction = sum(
+                wire_nbytes(b.n_elems, inner.wire, blk, padded=False)
+                * intra_legs for b in self.buckets)
             self.collectives_intra_per_reduction = (
                 intra_legs * len(self.buckets))
             self.wire_bytes_inter_per_reduction = sum(
-                (b.padded // inner.size) * isz_out for b in self.buckets)
+                wire_nbytes(b.padded // inner.size, outer.wire, blk)
+                for b in self.buckets)
+            self.wire_bytes_inter_logical_per_reduction = sum(
+                wire_nbytes(-(-b.n_elems // inner.size), outer.wire, blk,
+                            padded=False) for b in self.buckets)
+            # split ships mantissa + exponent as TWO gathers; the
+            # quantized wires fuse payload + scales into ONE buffer
             self.collectives_inter_per_reduction = (
                 (2 if outer.wire == "split" else 1) * len(self.buckets))
             self.wire_bytes_per_reduction = (
                 self.wire_bytes_intra_per_reduction
                 + self.wire_bytes_inter_per_reduction)
+            self.wire_bytes_logical_per_reduction = (
+                self.wire_bytes_intra_logical_per_reduction
+                + self.wire_bytes_inter_logical_per_reduction)
             self.collectives_per_reduction = (
                 self.collectives_intra_per_reduction
                 + self.collectives_inter_per_reduction)
         else:
-            itemsize = _WIRE_ITEMSIZE[self.wire]
             self.wire_bytes_per_reduction = sum(
-                b.padded * itemsize for b in self.buckets)
+                wire_nbytes(b.padded, self.wire, blk)
+                for b in self.buckets)
+            self.wire_bytes_logical_per_reduction = sum(
+                wire_nbytes(b.n_elems, self.wire, blk, padded=False)
+                for b in self.buckets)
             self.collectives_per_reduction = (
                 (2 if self.wire == "split" else 1) * len(self.buckets))
             self.wire_bytes_intra_per_reduction = 0
             self.wire_bytes_inter_per_reduction = 0
+            self.wire_bytes_intra_logical_per_reduction = 0
+            self.wire_bytes_inter_logical_per_reduction = 0
             self.collectives_intra_per_reduction = 0
             self.collectives_inter_per_reduction = 0
 
@@ -297,6 +354,23 @@ class BucketPlan:
         return jnp.sum(jnp.ldexp(m_all.astype(jnp.float32),
                                  e_all.astype(jnp.int32)), axis=0)
 
+    def _quant_gather_sum(self, x, wire: str, axis: str, prefix: str):
+        """The blockwise-quantized gather wire (qgZ compression half,
+        comm/quant.py): int8/int4 payload + per-block fp16 scales fused
+        into ONE uint8 buffer (pack_wire) and all-gathered over `axis`;
+        every rank dequantizes each peer's contribution to fp32 and
+        sums LOCALLY — accumulate always in the wide domain, quantize
+        only for the wire, so the error never compounds across ranks.
+        One buffer matters: on latency-bound fabrics a separate scales
+        collective would cost a second round-trip and hand the latency
+        win right back (BENCH.md round-11 methodology note)."""
+        from .quant import quantized_all_gather
+
+        per_rank = quantized_all_gather(
+            x, (axis,), self.quant_block, wire,
+            record=lambda nb: _record(f"{prefix}all_gather", nb))
+        return jnp.sum(per_rank, axis=0)
+
     def _reduce_one_hier(self, flat, spec: BucketSpec):
         """Two-level lowering: intra-group reduce-scatter (full bucket,
         fast fabric) -> inter-group collective on the 1/inner shard
@@ -318,6 +392,12 @@ class BucketPlan:
             # outer group, not per rank
             shard = self._split_gather_sum(shard, shard_elems,
                                            outer.axis, "inter.")
+        elif outer.wire in QUANT_WIRES:
+            # blockwise int8/int4 + fp16 scales on the slow hop only:
+            # the qgZ placement — compression hardest on the slowest
+            # fabric, fp32 accumulation everywhere
+            shard = self._quant_gather_sum(shard, outer.wire, outer.axis,
+                                           "inter.")
         elif outer.wire == "bf16":
             _record("inter.psum", shard_elems * 2)
             shard = lax.psum(shard.astype(jnp.bfloat16),
@@ -337,8 +417,7 @@ class BucketPlan:
 
     def _reduce_one(self, flat, spec: BucketSpec):
         axis, dp = self.axis, self.dp_size
-        itemsize = _WIRE_ITEMSIZE[self.wire]
-        nbytes = spec.padded * itemsize
+        nbytes = wire_nbytes(spec.padded, self.wire, self.quant_block)
         if self.wire == "bf16":
             wired = flat.astype(jnp.bfloat16)
             if self.scatter:
@@ -354,6 +433,11 @@ class BucketPlan:
             # subnormals flushed, the >= 2^127 tail pushed to inf so
             # overflow checks fire; the int8 exponent never wraps)
             total = self._split_gather_sum(flat, spec.padded, axis, "")
+            return (total / dp).astype(flat.dtype)
+        if self.wire in QUANT_WIRES:
+            # blockwise-quantized gather wire (comm/quant.py: subnormal
+            # flush + non-finite marker codes so overflow checks fire)
+            total = self._quant_gather_sum(flat, self.wire, axis, "")
             return (total / dp).astype(flat.dtype)
         # fp32-accumulate (allreduce_always_fp32 semantics)
         wired = flat.astype(jnp.float32)
@@ -409,11 +493,20 @@ class BucketPlan:
             return all(lvl.wire == "fp32" for lvl in self.levels)
         return self.wire == "fp32"
 
+    @property
+    def quantized(self) -> bool:
+        """True when any hop rides a blockwise-quantized wire."""
+        if self.levels is not None:
+            return any(lvl.wire in QUANT_WIRES for lvl in self.levels)
+        return self.wire in QUANT_WIRES
+
     def describe(self) -> str:
         sizes = ", ".join(f"{b.n_elems}" + (f"+{b.padded - b.n_elems}pad"
                                             if b.padded > b.n_elems else "")
                           for b in self.buckets)
         lowering = "reduce-scatter" if self.scatter else "allreduce"
+        if self.quantized:
+            lowering += f", quant block={self.quant_block}"
         if self.levels is not None:
             inner, outer = self.levels
             return (f"BucketPlan: {self.n_leaves} grad leaves -> "

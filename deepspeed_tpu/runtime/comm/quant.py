@@ -1,0 +1,230 @@
+"""Blockwise symmetric int8/int4 wire quantization (ZeRO++ qwZ/qgZ).
+
+ZeRO++ (arXiv:2306.10209) pairs the hierarchical wire's hpZ secondary
+shards with two quantized collectives: qwZ (blockwise-int8 parameter
+all-gather) and qgZ (quantized hierarchical gradient reduce-scatter).
+This module owns the jittable kernels both ride:
+
+* `quantize_blockwise`  fp32/bf16 flat tensor -> (int8 payload | packed
+  int4 nibbles, one fp16 scale per `block` elements).  Symmetric: the
+  per-block scale is amax/qmax, zero-point free, so dequantization is a
+  single multiply and an all-zero block round-trips exactly.
+* `dequantize_blockwise`  the inverse; accepts arbitrary leading batch
+  dims (gathered payloads arrive as [world, nblocks, ...]) and slices
+  the block padding back off.
+* `payload_bytes` / `padded_elems`  EXACT wire-byte accounting
+  (payload + scales), consumed by BucketPlan and the qwZ gather so the
+  `grad_wire.*` / `qwz.*` counters prove the compression instead of
+  estimating it.
+
+Range-safety mirrors `compressed_ar.decompose_int8_safe`:
+
+* fp32 subnormals flush to zero BEFORE the amax (a lone subnormal must
+  not poison a block's scale, and the values are unrepresentable at
+  int8 granularity anyway);
+* non-finite elements (±inf / NaN) are carried as a reserved marker
+  code (-qmax-1, the one two's-complement value symmetric quantization
+  never produces) and reconstruct as NaN, so downstream overflow checks
+  fire instead of receiving a silently clipped value;
+* a block whose scale overflows fp16 (amax > qmax * 65504: ~8.3e6 for
+  int8, ~4.6e5 for int4) dequantizes non-finite — a LOUD skip rather
+  than a silent ~1e3x shrink of the block.  Note this is a narrower
+  finite range than the fp32/bf16/split wires: under dynamic loss
+  scaling the scaler adapts (the skip halves the scale until scaled
+  gradients fit), but fp32-static trainings with legitimately huge
+  gradients should prefer int8 over int4 or keep the slow hop on bf16
+  (accuracy guidance in docs/tutorials/comm_tuning.md);
+* a block whose scale underflows fp16 (amax < qmax * 2^-24) flushes to
+  zero — the quantized-wire analogue of the subnormal flush.
+
+Accumulation never happens in the quantized domain: callers (the
+bucketed wire's inter-group hop, the qwZ gather) dequantize each rank's
+contribution to fp32 and sum locally — the qgZ trick of reducing in a
+wider accumulator so quantization error does not compound across ranks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# wire name -> (integer levels per side, i.e. qmax)
+QUANT_WIRES = ("int8", "int4")
+_QMAX = {"int8": 127, "int4": 7}
+
+DEFAULT_BLOCK_SIZE = 256
+
+_F32_MIN_NORMAL = float(np.float32(2.0 ** -126))
+
+
+def validate_block_size(block) -> int:
+    """Block sizes must be positive EVEN ints: int4 packs two elements
+    per byte, so an odd block would split a byte across blocks."""
+    if isinstance(block, bool) or not isinstance(block, (int, np.integer)):
+        raise ValueError(
+            f"quant_block_size must be a positive even int, got {block!r}")
+    block = int(block)
+    if block <= 0 or block % 2:
+        raise ValueError(
+            f"quant_block_size must be a positive even int, got {block}")
+    return block
+
+
+def qmax(wire: str) -> int:
+    if wire not in _QMAX:
+        raise ValueError(
+            f"unknown quantized wire {wire!r}; choose from {QUANT_WIRES}")
+    return _QMAX[wire]
+
+
+def padded_elems(n_elems: int, block: int) -> int:
+    """Elements after zero-padding to a whole number of blocks."""
+    block = validate_block_size(block)
+    return n_elems + (-n_elems % block)
+
+
+def payload_bytes(n_elems: int, wire: str, block: int, *,
+                  padded: bool = True) -> int:
+    """Exact wire bytes ONE rank contributes for `n_elems` elements:
+    quantized payload plus the fp16 scales riding alongside.
+
+    padded=True prices what actually crosses the fabric (elements
+    rounded up to whole blocks); padded=False is the logical payload —
+    the same wire with zero padding overhead — for the
+    `grad_wire.*_logical` counters that keep BENCH comparisons honest.
+    """
+    q = qmax(wire)
+    if padded:
+        n = padded_elems(n_elems, block)
+        n_blocks = n // block
+    else:
+        n = n_elems
+        n_blocks = -(-n_elems // block) if n_elems else 0
+    data = n if q == 127 else -(-n // 2)  # int4: two elements per byte
+    return data + n_blocks * 2            # + one fp16 scale per block
+
+
+def _flush_subnormals(f32):
+    return jnp.where(jnp.abs(f32) < jnp.float32(_F32_MIN_NORMAL),
+                     jnp.float32(0.0), f32)
+
+
+def quantize_blockwise(x, block: int, wire: str = "int8"):
+    """Flat (or any-shape) tensor -> (payload, fp16 scales).
+
+    payload: int8 [n_blocks, block] for "int8", uint8 [n_blocks,
+    block//2] packed low-nibble-first for "int4".  scales: fp16
+    [n_blocks].  The input is flattened and zero-padded to a whole
+    number of blocks; `dequantize_blockwise(..., n_elems=x.size)`
+    restores the original length.
+    """
+    q = qmax(wire)
+    block = validate_block_size(block)
+    marker = -q - 1  # -128 / -8: unreachable by the symmetric clip
+
+    f32 = _flush_subnormals(x.reshape(-1).astype(jnp.float32))
+    n = f32.shape[0]
+    pad = -n % block
+    if pad:
+        f32 = jnp.concatenate([f32, jnp.zeros((pad,), jnp.float32)])
+    blocks = f32.reshape(-1, block)
+
+    finite = jnp.isfinite(blocks)
+    amax = jnp.max(jnp.where(finite, jnp.abs(blocks), 0.0), axis=1)
+    # the wire-visible (fp16-rounded) scale is also the quantization
+    # scale, so encode/decode agree bit-for-bit; fp16 overflow -> inf
+    # scale (block dequantizes non-finite), underflow -> 0 (block
+    # flushes to zero) — both intentional, see module doc
+    scales = (amax / q).astype(jnp.float16)
+    eff = scales.astype(jnp.float32)[:, None]
+    inv = jnp.where((eff > 0) & jnp.isfinite(eff), 1.0 / eff, 0.0)
+    codes = jnp.clip(jnp.round(blocks * inv), -q, q).astype(jnp.int8)
+    codes = jnp.where(finite, codes, jnp.int8(marker))
+
+    if q == 127:
+        return codes, scales
+    u = codes.astype(jnp.uint8) & jnp.uint8(0x0F)  # two's-complement nibble
+    packed = u[:, 0::2] | (u[:, 1::2] << 4)
+    return packed, scales
+
+
+def dequantize_blockwise(payload, scales, wire: str, n_elems: int):
+    """(payload, scales) -> fp32 [..., n_elems].
+
+    Broadcasts over leading batch dims: an all-gathered wire arrives as
+    payload [world, n_blocks, w] + scales [world, n_blocks] and comes
+    back [world, n_elems] — each rank's contribution dequantized
+    independently, ready for the fp32 accumulate.
+    """
+    q = qmax(wire)
+    marker = -q - 1
+    if q == 127:
+        codes = payload.astype(jnp.int8)
+    else:
+        lo = (payload & jnp.uint8(0x0F)).astype(jnp.int8)
+        hi = ((payload >> 4) & jnp.uint8(0x0F)).astype(jnp.int8)
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        codes = jnp.stack([lo, hi], axis=-1).reshape(
+            payload.shape[:-1] + (payload.shape[-1] * 2,))
+    vals = codes.astype(jnp.float32) * \
+        scales.astype(jnp.float32)[..., None]
+    vals = jnp.where(codes == marker, jnp.float32(jnp.nan), vals)
+    flat = vals.reshape(vals.shape[:-2] + (-1,))
+    return flat[..., :n_elems]
+
+
+def pack_wire(payload, scales):
+    """(payload, scales) -> ONE flat uint8 buffer: payload bytes then
+    the scales bitcast to bytes.  On latency-bound fabrics two
+    collectives cost two round-trips; fusing the scale sideband into
+    the payload buffer keeps the quantized wire at ONE collective per
+    bucket — the scales literally ride alongside the payload."""
+    p = jax.lax.bitcast_convert_type(payload, jnp.uint8).reshape(-1)
+    s = jax.lax.bitcast_convert_type(scales, jnp.uint8).reshape(-1)
+    return jnp.concatenate([p, s])
+
+
+def unpack_wire(buf, wire: str, block: int, n_elems: int):
+    """Inverse of `pack_wire`, with leading batch dims (a gathered wire
+    arrives as [world, nbytes]): -> (payload, scales) shaped for
+    `dequantize_blockwise`."""
+    q = qmax(wire)
+    n = padded_elems(n_elems, block)
+    n_blocks = n // block
+    width = block if q == 127 else block // 2
+    data = n_blocks * width
+    p = buf[..., :data]
+    if q == 127:
+        p = jax.lax.bitcast_convert_type(p.astype(jnp.uint8), jnp.int8)
+    p = p.reshape(buf.shape[:-1] + (n_blocks, width))
+    s_bytes = buf[..., data:].reshape(buf.shape[:-1] + (n_blocks, 2))
+    scales = jax.lax.bitcast_convert_type(s_bytes, jnp.float16)
+    return p, scales
+
+
+def quantized_all_gather(x, axes, block: int, wire: str, record=None):
+    """The whole quantized-gather wire protocol in one place, shared by
+    the gradient wire (BucketPlan._quant_gather_sum) and the qwZ
+    parameter gather (zero/partition.QuantizedWeightGather): quantize
+    `x` blockwise, fuse payload+scales into one buffer, all-gather it
+    over `axes` (innermost-first sequential hops — a later hop resends
+    the accumulated buffer, exactly how the byte accounting prices it),
+    and return every rank's contribution dequantized to fp32 as
+    [world, n_elems] (world = product of the axis sizes, outermost
+    leading).  `record(nbytes)` fires once per hop with this rank's
+    payload bytes.  Callers sum (qgZ) or reassemble (qwZ) — both in the
+    wide domain, never the quantized one."""
+    n_elems = x.size
+    payload, scales = quantize_blockwise(x, block, wire)
+    buf = pack_wire(payload, scales)
+    nbytes = buf.shape[0]
+    for a in reversed(tuple(axes)):
+        if record is not None:
+            record(int(buf.size))
+        buf = jax.lax.all_gather(buf, a, axis=0, tiled=False)
+    buf = buf.reshape((-1, nbytes))
+    p, s = unpack_wire(buf, wire, block, n_elems)
+    return dequantize_blockwise(p, s, wire, n_elems)

@@ -93,11 +93,12 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
 
     "comm": {
       "gradient_reduction": "implicit" | "bucketed",
-      "wire_dtype": "fp32" | "bf16" | "split",
+      "wire_dtype": "fp32" | "bf16" | "split" | "int8" | "int4",
       "reduce_bucket_size": <elements>,  # default: zero_optimization's knob
       "hierarchy": "none" | "auto" | <outer> | {"outer": <outer>},
       "wire_dtype_inner": ...,           # per-level overrides (hierarchy)
-      "wire_dtype_outer": ...
+      "wire_dtype_outer": ...,
+      "quant_block_size": <elements per fp16 scale>   # int8/int4 wires
     }
 
     `implicit` (default) leaves DP reduction to XLA's psum at the
@@ -111,9 +112,14 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
     `hierarchy` factors the data axis for the two-level wire (ZeRO++
     arXiv:2306.10209 recipe): intra-group reduce-scatter, inter-group
     collective on the 1/inner shard, intra-group all-gather.  Per-level
-    wire dtypes let the slow hop compress (bf16/split) while the fast
-    hop stays exact; the inner level is scatter-structured, so a "split"
-    request there lowers to fp32 with a log line.
+    wire dtypes let the slow hop compress (bf16/split, or the blockwise
+    int8/int4 quantized gathers — qgZ, comm/quant.py) while the fast
+    hop stays exact.  The inner level is scatter-structured and cannot
+    carry the gather-structured wires: a "split" request there lowers
+    to fp32 with a log line (legacy behaviour), an EXPLICIT
+    "wire_dtype_inner": "int8"/"int4" raises — a psum_scatter has no
+    way to carry the per-block scales, and silently dropping a
+    requested quantization would misreport the wire.
     """
 
     def __init__(self, param_dict, zero_config, world_size=None):
@@ -129,7 +135,8 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
                 f"got {self.gradient_reduction!r}")
         self.fp32_allreduce = bool(get_scalar_param(
             param_dict, c.FP32_ALLREDUCE, c.FP32_ALLREDUCE_DEFAULT))
-        from .comm.bucketing import WIRE_MODES
+        from .comm.bucketing import GATHER_WIRES, WIRE_MODES
+        from .comm.quant import QUANT_WIRES, validate_block_size
 
         def wire_param(key, default):
             w = get_scalar_param(d, key, default)
@@ -137,6 +144,9 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
                 return None
             w = str(w).lower()
             if w not in WIRE_MODES:
+                # name the offending level AND the full valid set here —
+                # a typo'd dtype must never fall through to a jit-time
+                # failure inside the traced step program
                 raise ValueError(f"comm.{key} must be one of {WIRE_MODES}, "
                                  f"got {w!r}")
             return "fp32" if self.fp32_allreduce else w
@@ -156,7 +166,19 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
         self.wire_dtype_inner = inner_override or self.wire_dtype
         self.wire_dtype_outer = wire_param(c.COMM_WIRE_DTYPE_OUTER, None) \
             or self.wire_dtype
-        if self.wire_dtype_inner == "split":
+        if inner_override in QUANT_WIRES:
+            # an explicitly requested quantized inner wire cannot be
+            # honored (the scatter level has nowhere to put the
+            # per-block scales) and silently lowering it would
+            # misreport the compression — reject, naming the level
+            raise ValueError(
+                f"comm.{c.COMM_WIRE_DTYPE_INNER} = {inner_override!r}: "
+                "the int8/int4 wires are gather-structured (per-block "
+                "scales cannot ride a psum_scatter) and cannot run the "
+                "intra-group scatter level; use fp32 or bf16 for "
+                f"{c.COMM_WIRE_DTYPE_INNER} and put the quantized wire "
+                f"on {c.COMM_WIRE_DTYPE_OUTER}")
+        if self.wire_dtype_inner in GATHER_WIRES:
             if inner_override is not None:
                 # warn only on an EXPLICIT inner-split request; when it
                 # is merely inherited from wire_dtype the flat path may
@@ -170,6 +192,12 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
             self.wire_dtype_inner = "fp32"
         self.reduce_bucket_size = int(get_scalar_param(
             d, c.COMM_REDUCE_BUCKET_SIZE, zero_config.reduce_bucket_size))
+        block = get_scalar_param(d, c.COMM_QUANT_BLOCK_SIZE,
+                                 c.COMM_QUANT_BLOCK_SIZE_DEFAULT)
+        try:
+            self.quant_block_size = validate_block_size(block)
+        except ValueError as e:
+            raise ValueError(f"comm.{c.COMM_QUANT_BLOCK_SIZE}: {e}")
 
 
 class DeepSpeedDataPipelineConfig(DeepSpeedConfigObject):

@@ -90,7 +90,7 @@ COMM_GRADIENT_REDUCTION = "gradient_reduction"
 COMM_GRADIENT_REDUCTION_DEFAULT = "implicit"  # or "bucketed"
 COMM_GRADIENT_REDUCTION_MODES = ("implicit", "bucketed")
 COMM_WIRE_DTYPE = "wire_dtype"
-COMM_WIRE_DTYPE_DEFAULT = "fp32"  # "fp32" | "bf16" | "split"
+COMM_WIRE_DTYPE_DEFAULT = "fp32"  # fp32 | bf16 | split | int8 | int4
 COMM_REDUCE_BUCKET_SIZE = "reduce_bucket_size"  # elements; falls back to
                                                 # zero_optimization's knob
 # Two-level (intra/inter fabric) reduction over a factored data axis:
@@ -100,9 +100,15 @@ COMM_REDUCE_BUCKET_SIZE = "reduce_bucket_size"  # elements; falls back to
 COMM_HIERARCHY = "hierarchy"
 COMM_HIERARCHY_DEFAULT = "none"
 # Per-level wire overrides (default: wire_dtype for both levels; the
-# inner level is scatter-structured so "split" there lowers to fp32).
+# inner level is scatter-structured, so the gather-structured wires
+# (split/int8/int4) cannot run there — an explicit quantized inner
+# request is a ValueError, an inherited one lowers to fp32).
 COMM_WIRE_DTYPE_INNER = "wire_dtype_inner"
 COMM_WIRE_DTYPE_OUTER = "wire_dtype_outer"
+# Blockwise quantization granularity for the int8/int4 wires and the
+# qwZ parameter gather: elements per fp16 scale (positive even int).
+COMM_QUANT_BLOCK_SIZE = "quant_block_size"
+COMM_QUANT_BLOCK_SIZE_DEFAULT = 256
 FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
 

@@ -249,6 +249,7 @@ class DeepSpeedEngine:
             batch_size=self.train_batch_size(),
             steps_per_output=self.steps_per_print() or 50)
         self.bucket_plan = self._build_bucket_plan()
+        self._qwz_gather = self._build_qwz_gather()
         self._step_fns = self._build_step_fns()
         self._last_lr = self._current_lr()
 
@@ -319,6 +320,7 @@ class DeepSpeedEngine:
         self._opt_state = None
         self._offload = None
         self.zero_plan = None
+        self._qwz_gather = None
         self._grad_acc = None
         self._cached = None
         self.optimizer = self._configure_optimizer()  # lr container only
@@ -699,10 +701,11 @@ class DeepSpeedEngine:
             return None
         scatter = (self._config.zero_optimization_stage >= 2
                    and bool(self._config.zero_config.reduce_scatter))
-        if scatter and cc.wire_dtype == "split" \
+        from .comm.bucketing import GATHER_WIRES
+        if scatter and cc.wire_dtype in GATHER_WIRES \
                 and not self.mesh_info.hierarchical:
-            log_dist("split wire is gather-structured; ZeRO>=2 bucket "
-                     "reduction stays allreduce-lowered", ranks=[0])
+            log_dist(f"{cc.wire_dtype} wire is gather-structured; ZeRO>=2 "
+                     "bucket reduction stays allreduce-lowered", ranks=[0])
         levels = None
         if self.mesh_info.hierarchical:
             from .comm.bucketing import WireLevel
@@ -717,9 +720,65 @@ class DeepSpeedEngine:
         plan = BucketPlan(self._params, dp_size=dp,
                           bucket_elems=cc.reduce_bucket_size,
                           wire=cc.wire_dtype, scatter=scatter,
-                          levels=levels)
+                          levels=levels,
+                          quant_block=cc.quant_block_size)
         log_dist(plan.describe(), ranks=[0])
         return plan
+
+    def _build_qwz_gather(self):
+        """qwZ (ZeRO++): blockwise-quantized stage-3 parameter
+        all-gather (zero/partition.QuantizedWeightGather), or None when
+        not requested / not applicable.  The master weights stay full
+        precision; only the compute-side gather is quantized."""
+        qw = getattr(self._config.zero_config, "quantized_weights", None)
+        if not qw:
+            return None
+        blockers = []
+        if self._config.zero_optimization_stage < 3:
+            blockers.append("ZeRO stage < 3 (parameters are replicated — "
+                            "there is no gather to quantize)")
+        if self.mesh_info.axis_size(DATA_AXIS) <= 1:
+            blockers.append("dp==1 (nothing to gather)")
+        for ax in (MODEL_AXIS, PIPE_AXIS, SEQ_AXIS):
+            if self.mesh_info.axis_size(ax) > 1:
+                # on legacy jax the shard_map axis_names shim runs FULL
+                # manual, where the gather's data-only specs would
+                # silently replicate TP-sharded leaves to full width —
+                # a memory hazard, not a fallback; pure-DP only
+                blockers.append(f"{ax} axis > 1 (mixed-axis meshes keep "
+                                "the full-width gather)")
+        if self._offload is not None:
+            blockers.append("ZeRO-Offload (the step runs host-side)")
+        if blockers:
+            log_dist("zero_optimization.quantized_weights requested but "
+                     "unavailable — parameters gather at full width: "
+                     + "; ".join(blockers), ranks=[0])
+            return None
+        from .zero.partition import QuantizedWeightGather
+
+        gather = QuantizedWeightGather(
+            self.zero_plan, self._params, wire=qw,
+            block=self._config.comm_config.quant_block_size)
+        if not gather.active:
+            log_dist("zero_optimization.quantized_weights: no stage-3 "
+                     "leaf is data-sharded (all below min_size_to_shard) "
+                     "— parameters gather at full width", ranks=[0])
+            return None
+        log_dist(gather.describe(), ranks=[0])
+        return gather
+
+    def _account_qwz(self, events: int = 1):
+        """Per-dispatch wire-byte accounting for the quantized stage-3
+        parameter gather, mirroring _account_grad_wire: the exact
+        payload+scales bytes each rank contributes per gather event
+        (one per fused/scanned step program, one per micro step on the
+        split path)."""
+        gather = self._qwz_gather
+        if gather is None:
+            return
+        COUNTERS.add("qwz.gather",
+                     gather.wire_bytes_per_gather * events,
+                     calls=gather.collectives_per_gather * events)
 
     def _account_grad_wire(self, events: int = 1):
         """Per-dispatch wire-byte accounting for the bucketed path: the
@@ -730,19 +789,32 @@ class DeepSpeedEngine:
         Hierarchical plans additionally split the total into
         `grad_wire.intra` (fast-fabric scatter/gather legs) and
         `grad_wire.inter` (the slow-fabric hop on the 1/inner shard —
-        the number a two-level placement exists to shrink)."""
+        the number a two-level placement exists to shrink).  Every
+        counter gets a `*_logical` twin pricing the same wire with zero
+        padding overhead: bucket padding to inner/block multiples would
+        otherwise inflate the padded figures and mask part of a
+        compression win in BENCH comparisons."""
         plan = self.bucket_plan
         if plan is None or self._capture_layers is not None:
             return
         COUNTERS.add("grad_wire.reduce",
                      plan.wire_bytes_per_reduction * events,
                      calls=plan.collectives_per_reduction * events)
+        COUNTERS.add("grad_wire.reduce_logical",
+                     plan.wire_bytes_logical_per_reduction * events,
+                     calls=plan.collectives_per_reduction * events)
         if plan.hierarchical:
             COUNTERS.add("grad_wire.intra",
                          plan.wire_bytes_intra_per_reduction * events,
                          calls=plan.collectives_intra_per_reduction * events)
+            COUNTERS.add("grad_wire.intra_logical",
+                         plan.wire_bytes_intra_logical_per_reduction * events,
+                         calls=plan.collectives_intra_per_reduction * events)
             COUNTERS.add("grad_wire.inter",
                          plan.wire_bytes_inter_per_reduction * events,
+                         calls=plan.collectives_inter_per_reduction * events)
+            COUNTERS.add("grad_wire.inter_logical",
+                         plan.wire_bytes_inter_logical_per_reduction * events,
                          calls=plan.collectives_inter_per_reduction * events)
 
     def _build_step_fns(self):
@@ -763,6 +835,18 @@ class DeepSpeedEngine:
             return jax.tree_util.tree_map(
                 lambda x: x.astype(dtype) if jnp.issubdtype(
                     x.dtype, jnp.floating) else x, tree)
+
+        qwz = self._qwz_gather
+
+        def prep_params(params):
+            """Master params -> the compute-side replica the loss
+            consumes: compute-dtype cast, then (qwZ) the stage-3 gather
+            rides int8/int4 blocks + fp16 scales and dequantizes on
+            device — the master copy itself is never quantized."""
+            cparams = cast(params, compute_dtype)
+            if qwz is not None:
+                cparams = qwz.gather(cparams)
+            return cparams
 
         def run_loss(p, batch, rng, pld_theta, loss_scale):
             """Shared scaled-loss body: returns (scaled_loss, (loss, caps)).
@@ -839,7 +923,7 @@ class DeepSpeedEngine:
                 return wire_plan.unflatten(buckets), loss, {}
 
         def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
-            cparams = cast(params, compute_dtype)
+            cparams = prep_params(params)
             grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
                                               loss_scale)
             new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
@@ -893,7 +977,7 @@ class DeepSpeedEngine:
             here the gradients never outlive the fused program and XLA can
             overlap the optimizer with the tail of the backward."""
             loss_scale = scaler_state["cur_scale"]
-            cparams = cast(params, compute_dtype)
+            cparams = prep_params(params)
             grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
                                               loss_scale)
             grads = plan.constrain_grads(grads)
@@ -933,7 +1017,7 @@ class DeepSpeedEngine:
             global batch instead of gas+1 (train_batch uses this when the
             iterator is stackable)."""
             loss_scale = scaler_state["cur_scale"]
-            cparams = cast(params, compute_dtype)
+            cparams = prep_params(params)
 
             # captured layer outputs ride the scan CARRY (overwritten per
             # micro step — reference hooks overwrite per forward), not the
@@ -1200,6 +1284,7 @@ class DeepSpeedEngine:
             self._params, self._grad_acc, batch, rng,
             self._scaler_state["cur_scale"], theta)
         self._account_grad_wire()
+        self._account_qwz()
         self._consume_extras(extras)
         if self._wall_clock_breakdown:
             # one fused fwd+bwd program: this IS forward+backward time
@@ -1266,6 +1351,7 @@ class DeepSpeedEngine:
             self._params, self._opt_state, self._scaler_state, batch, rng,
             lr, theta)
         self._account_grad_wire()
+        self._account_qwz()
         self._consume_extras(extras)
         if self._wall_clock_breakdown:
             # the fused program IS forward+backward+step
@@ -1785,6 +1871,9 @@ class DeepSpeedEngine:
             self._params, self._opt_state, self._scaler_state, stacked,
             rngs, lr, theta)
         self._account_grad_wire(events=gas)
+        # the scan program gathers the compute params ONCE outside the
+        # micro-step body — one qwZ event per global batch, not per micro
+        self._account_qwz()
         if feed is not None:
             # the scan program is in flight: collate + H2D of the NEXT
             # global batch overlap it (before any sync-closing span)

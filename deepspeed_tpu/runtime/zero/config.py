@@ -131,3 +131,21 @@ class DeepSpeedZeroConfig(DeepSpeedConfigObject):
         self.gather_fp16_weights_on_model_save = g(
             zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE,
             zc.ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT)
+
+        # qwZ: quantize the stage-3 parameter all-gather (ZeRO++).
+        # Normalized to None | "int8" | "int4"; the master weights and
+        # optimizer math stay full precision either way.
+        qw = g(zc.ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS,
+               zc.ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT)
+        if isinstance(qw, bool) or qw is None:
+            self.quantized_weights = "int8" if qw else None
+        else:
+            qw = str(qw).lower()
+            if qw in ("false", "none", "off"):
+                self.quantized_weights = None
+            elif qw in ("true", "int8", "int4"):
+                self.quantized_weights = "int8" if qw == "true" else qw
+            else:
+                raise ValueError(
+                    f"zero_optimization.{zc.ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS} "
+                    f"must be false, true, 'int8' or 'int4', got {qw!r}")

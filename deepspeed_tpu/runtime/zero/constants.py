@@ -67,6 +67,12 @@ ZERO_OPTIMIZATION_PARAM_PERSISTENCE_THRESHOLD_DEFAULT = 100000
 ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE = \
     "stage3_gather_fp16_weights_on_model_save"
 ZERO_OPTIMIZATION_GATHER_FP16_WEIGHTS_ON_MODEL_SAVE_DEFAULT = False
+# qwZ (ZeRO++ arXiv:2306.10209): the stage-3 parameter all-gather moves
+# blockwise-quantized blocks + fp16 scales instead of full-width
+# weights; the master copy stays full precision.  false | true (int8) |
+# "int8" | "int4".  Block size rides comm.quant_block_size.
+ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS = "quantized_weights"
+ZERO_OPTIMIZATION_QUANTIZED_WEIGHTS_DEFAULT = False
 
 ZERO_FORMAT = """
 ZeRO optimization should be enabled as:

@@ -1,0 +1,610 @@
+"""Blockwise int8/int4 quantized collectives — the ZeRO++ trio's qwZ/qgZ
+half (runtime/comm/quant.py kernels, the BucketPlan quantized wire
+modes, the stage-3 QuantizedWeightGather, logical-vs-padded byte
+accounting, and the bench dry-run)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.monitor.counters import COUNTERS
+from deepspeed_tpu.runtime.comm.bucketing import (BucketPlan, WIRE_MODES,
+                                                  WireLevel, wire_nbytes)
+from deepspeed_tpu.runtime.comm.quant import (dequantize_blockwise,
+                                              payload_bytes,
+                                              quantize_blockwise,
+                                              validate_block_size)
+from tests.simple_model import SimpleModel, random_batches
+
+
+# ---------------------------------------------------------------------------
+# quant kernels: round-trip properties
+# ---------------------------------------------------------------------------
+
+def _roundtrip(x, block, wire):
+    p, s = quantize_blockwise(jnp.asarray(x), block, wire)
+    return np.asarray(dequantize_blockwise(p, s, wire, x.size))
+
+
+@pytest.mark.parametrize("wire,q", [("int8", 127), ("int4", 7)])
+@pytest.mark.parametrize("block", [4, 64, 256])
+@pytest.mark.parametrize("n", [5, 64, 257, 1001])
+def test_roundtrip_error_bounded_per_block(wire, q, block, n):
+    """Symmetric blockwise quantization: |err| <= scale/2 per element,
+    scale = block amax / qmax (+ the fp16 scale rounding)."""
+    rng = np.random.RandomState(block * 1000 + n)
+    x = (rng.randn(n) * 10.0 ** rng.uniform(-4, 4, n)).astype(np.float32)
+    y = _roundtrip(x, block, wire)
+    assert y.shape == x.shape
+    amax = np.abs(np.pad(x, (0, -n % block)).reshape(-1, block)).max(1)
+    bound = np.repeat(amax / (2 * q) * 1.01 + amax * 2.0 ** -11,
+                      block)[:n] + 1e-12
+    assert (np.abs(y - x) <= bound).all()
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+def test_roundtrip_specials(wire):
+    """Range-safety mirrors compressed_ar.decompose_int8_safe: fp32
+    subnormals flush to zero, +/-inf and NaN reconstruct NON-finite so
+    downstream overflow checks fire, zeros round-trip exactly."""
+    x = np.array([0.0, -0.0, np.inf, -np.inf, np.nan,
+                  1e-40, 2.0 ** -130, 1.0, -3.0], np.float32)
+    y = _roundtrip(x, 8, wire)
+    assert y[0] == 0.0 and y[1] == 0.0
+    assert not np.isfinite(y[2:5]).any()
+    assert y[5] == 0.0 and y[6] == 0.0  # subnormal flush
+    assert np.isfinite(y[7:]).all()
+    # a non-finite element must not poison its block's finite neighbors
+    q = {"int8": 127, "int4": 7}[wire]
+    assert abs(y[8] - x[8]) <= 3.0 / (2 * q) + 3.0 * 2.0 ** -11
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+def test_roundtrip_all_zero_block_exact(wire):
+    y = _roundtrip(np.zeros(48, np.float32), 16, wire)
+    assert (y == 0.0).all()
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+def test_huge_blocks_saturate_nonfinite(wire):
+    """A block whose fp16 scale overflows dequantizes non-finite (the
+    >= 2^127-tail rule of the split wire, blockwise): gradients that
+    large mean the step is skipped, never silently shrunk."""
+    x = np.full(8, 1e38, np.float32)
+    y = _roundtrip(x, 8, wire)
+    assert not np.isfinite(y).any()
+
+
+def test_int4_packing_odd_and_batch_dims():
+    """int4 packs two elements per byte; odd logical lengths ride the
+    block padding and unpack in order.  Leading batch dims (gathered
+    [world, ...] payloads) broadcast through dequantize."""
+    x = np.arange(-3, 4, dtype=np.float32)  # len 7, odd
+    p, s = quantize_blockwise(jnp.asarray(x), 8, "int4")
+    assert p.dtype == jnp.uint8 and p.shape == (1, 4)
+    y = np.asarray(dequantize_blockwise(p, s, "int4", 7))
+    np.testing.assert_allclose(y, x, atol=3.0 / 14 + 1e-2)
+    stacked = jnp.stack([p, p]), jnp.stack([s, s])
+    yy = np.asarray(dequantize_blockwise(stacked[0], stacked[1],
+                                         "int4", 7))
+    assert yy.shape == (2, 7)
+    np.testing.assert_array_equal(yy[0], yy[1])
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+@pytest.mark.parametrize("n", [16, 100, 257])
+def test_pack_wire_single_buffer_roundtrip(wire, n):
+    """The wire ships ONE uint8 buffer (payload then bitcast scales):
+    pack -> [world, nbytes] gather shape -> unpack reproduces the exact
+    payload/scales pair, and the buffer length is payload_bytes."""
+    from deepspeed_tpu.runtime.comm.quant import pack_wire, unpack_wire
+
+    rng = np.random.RandomState(n)
+    x = rng.randn(n).astype(np.float32)
+    p, s = quantize_blockwise(jnp.asarray(x), 32, wire)
+    buf = pack_wire(p, s)
+    assert buf.dtype == jnp.uint8
+    assert buf.size == payload_bytes(n, wire, 32)
+    stacked = jnp.stack([buf, buf])
+    p2, s2 = unpack_wire(stacked, wire, 32, n)
+    np.testing.assert_array_equal(np.asarray(p2[0]), np.asarray(p))
+    np.testing.assert_array_equal(
+        np.asarray(s2[0]).view(np.uint16), np.asarray(s).view(np.uint16))
+    y = np.asarray(dequantize_blockwise(p2, s2, wire, n))
+    np.testing.assert_array_equal(
+        y[0], np.asarray(dequantize_blockwise(p, s, wire, n)))
+
+
+def test_payload_bytes_exact():
+    # int8: 1 B/elem + 2 B fp16 scale per block
+    assert payload_bytes(256, "int8", 256) == 256 + 2
+    assert payload_bytes(257, "int8", 256) == 512 + 4       # padded
+    assert payload_bytes(257, "int8", 256, padded=False) == 257 + 4
+    # int4: half a byte per element
+    assert payload_bytes(256, "int4", 256) == 128 + 2
+    assert payload_bytes(100, "int4", 32, padded=False) == 50 + 4 * 2
+    # fixed-width wires have no block padding
+    assert wire_nbytes(100, "bf16", 256) == \
+        wire_nbytes(100, "bf16", 256, padded=False) == 200
+
+
+def test_block_size_validation():
+    with pytest.raises(ValueError, match="positive even int"):
+        validate_block_size(0)
+    with pytest.raises(ValueError, match="positive even int"):
+        validate_block_size(7)  # odd: int4 would split a byte
+    with pytest.raises(ValueError, match="positive even int"):
+        validate_block_size(True)
+    assert validate_block_size(2) == 2
+
+
+# ---------------------------------------------------------------------------
+# BucketPlan: quantized wire modes + logical/padded accounting
+# ---------------------------------------------------------------------------
+
+def _tree():
+    return {"a": jax.ShapeDtypeStruct((100,), jnp.float32),
+            "b": jax.ShapeDtypeStruct((60,), jnp.float32)}
+
+
+def test_plan_quant_accounting_padded_vs_logical():
+    plan = BucketPlan(_tree(), dp_size=8, bucket_elems=128, wire="int8",
+                      quant_block=32)
+    assert plan.quantized
+    # payload + scales FUSE into one buffer: 1 collective per bucket
+    # (unlike split's two gathers) — latency parity with bf16/fp32
+    assert plan.collectives_per_reduction == plan.n_buckets
+    assert plan.wire_bytes_per_reduction == sum(
+        payload_bytes(b.padded, "int8", 32) for b in plan.buckets)
+    assert plan.wire_bytes_logical_per_reduction == sum(
+        payload_bytes(b.n_elems, "int8", 32, padded=False)
+        for b in plan.buckets)
+    assert plan.wire_bytes_logical_per_reduction <= \
+        plan.wire_bytes_per_reduction
+    assert "quant block=32" in plan.describe()
+
+
+def test_plan_hier_quant_outer_accounting():
+    levels = (WireLevel("data_inner", 4, "fp32"),
+              WireLevel("data_outer", 2, "int4"))
+    plan = BucketPlan(_tree(), dp_size=8, bucket_elems=128, levels=levels,
+                      quant_block=32)
+    assert plan.quantized and not plan.exact_fp32
+    assert plan.wire_bytes_inter_per_reduction == sum(
+        payload_bytes(b.padded // 4, "int4", 32) for b in plan.buckets)
+    assert plan.wire_bytes_inter_logical_per_reduction == sum(
+        payload_bytes(-(-b.n_elems // 4), "int4", 32, padded=False)
+        for b in plan.buckets)
+    # the quantized gather hop is ONE fused collective per bucket
+    assert plan.collectives_inter_per_reduction == plan.n_buckets
+    # inter drops ~8x vs the fp32 flat wire (4 B -> 0.5 B/elem / inner)
+    flat = BucketPlan(_tree(), dp_size=8, bucket_elems=128)
+    assert plan.wire_bytes_inter_per_reduction * 7 < \
+        flat.wire_bytes_per_reduction
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+def test_plan_rejects_quant_inner_level(wire):
+    """The scatter-structured inner level cannot carry per-block scales
+    — mirroring the split-inner rule, with the level named."""
+    levels = (WireLevel("data_inner", 4, wire),
+              WireLevel("data_outer", 2, "fp32"))
+    with pytest.raises(ValueError, match=f"{wire} wire is gather-structured"):
+        BucketPlan(_tree(), dp_size=8, bucket_elems=128, levels=levels)
+
+
+def test_plan_typo_names_full_valid_set():
+    with pytest.raises(ValueError, match=r"int8.*int4"):
+        BucketPlan(_tree(), dp_size=8, bucket_elems=128, wire="in8")
+    levels = (WireLevel("data_inner", 4, "fp32"),
+              WireLevel("data_outer", 2, "int2"))
+    with pytest.raises(ValueError, match=r"outer-level.*int2"):
+        BucketPlan(_tree(), dp_size=8, bucket_elems=128, levels=levels)
+
+
+def test_plan_flat_quant_scatter_falls_back_to_gather():
+    plan = BucketPlan(_tree(), dp_size=8, bucket_elems=128, wire="int8",
+                      scatter=True)
+    assert not plan.scatter  # gather-structured, like the split wire
+
+
+# ---------------------------------------------------------------------------
+# config surface
+# ---------------------------------------------------------------------------
+
+def _make_engine(comm_cfg=None, stage=0, gas=1, **cfg_extra):
+    cfg = {
+        "train_batch_size": 32 * gas,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": stage},
+        "mesh": {"data": 8},
+        "steps_per_print": 0,
+    }
+    if comm_cfg is not None:
+        cfg["comm"] = comm_cfg
+    cfg.update(cfg_extra)
+    engine, *_ = ds.initialize(model=SimpleModel(), config_params=cfg)
+    return engine
+
+
+FLAT = {"gradient_reduction": "bucketed", "reduce_bucket_size": 128}
+HIER = dict(FLAT, hierarchy={"outer": 2})
+
+
+def test_config_wire_typo_lists_valid_set_and_key():
+    """A typo'd dtype fails at CONFIG time naming the offending key and
+    the full valid set — never a late jit-time shape/dtype failure."""
+    for key in ("wire_dtype", "wire_dtype_outer", "wire_dtype_inner"):
+        with pytest.raises(ValueError) as e:
+            _make_engine(comm_cfg=dict(FLAT, **{key: "int7"}))
+        msg = str(e.value)
+        assert key in msg and "int7" in msg
+        for valid in WIRE_MODES:
+            assert valid in msg, f"{valid} missing from {msg!r}"
+
+
+def test_config_explicit_quant_inner_rejected():
+    """An EXPLICIT quantized inner wire is a config error (the scatter
+    level cannot carry scales); silently lowering it would misreport the
+    wire.  Inherited-from-wire_dtype lowers to fp32 like split does."""
+    with pytest.raises(ValueError, match="wire_dtype_inner.*gather-structured"):
+        _make_engine(comm_cfg=dict(HIER, wire_dtype_inner="int8"))
+    with pytest.raises(ValueError, match="gather-structured"):
+        _make_engine(comm_cfg=dict(HIER, wire_dtype_inner="int4"))
+    eng = _make_engine(comm_cfg=dict(HIER, wire_dtype="int8"))
+    inner, outer = eng.bucket_plan.levels
+    assert inner.wire == "fp32" and outer.wire == "int8"
+
+
+def test_config_quant_block_size_validation():
+    with pytest.raises(ValueError, match="quant_block_size"):
+        _make_engine(comm_cfg=dict(FLAT, quant_block_size=0))
+    with pytest.raises(ValueError, match="quant_block_size"):
+        _make_engine(comm_cfg=dict(FLAT, quant_block_size=33))
+    eng = _make_engine(comm_cfg=dict(FLAT, wire_dtype="int8",
+                                     quant_block_size=64))
+    assert eng.bucket_plan.quant_block == 64
+
+
+def test_config_fp32_allreduce_overrides_quant():
+    eng = _make_engine(comm_cfg=dict(FLAT, wire_dtype="int8"),
+                       fp32_allreduce=True)
+    assert eng.bucket_plan.wire == "fp32" and eng.bucket_plan.exact_fp32
+
+
+def test_config_quantized_weights_validation():
+    from deepspeed_tpu.runtime.zero.config import DeepSpeedZeroConfig
+
+    for raw, want in ((True, "int8"), ("int8", "int8"), ("int4", "int4"),
+                      (False, None), ("off", None)):
+        zc = DeepSpeedZeroConfig(
+            {"zero_optimization": {"stage": 3, "quantized_weights": raw}})
+        assert zc.quantized_weights == want, raw
+    with pytest.raises(ValueError, match="quantized_weights"):
+        DeepSpeedZeroConfig(
+            {"zero_optimization": {"stage": 3,
+                                   "quantized_weights": "int2"}})
+
+
+# ---------------------------------------------------------------------------
+# engine parity: quantized wires track fp32 (3 step paths x stages x
+# hierarchy) — the convergence-pinned gate for qgZ/qwZ
+# ---------------------------------------------------------------------------
+
+_BASELINES = {}
+
+
+def _train(engine, mode, gas, steps=4, seed=3):
+    it = random_batches(steps * gas, batch_size=32, seed=seed)
+    loss = None
+    if mode == "scan":
+        for _ in range(steps):
+            loss = engine.train_batch(it)
+    else:
+        for _ in range(steps * gas):
+            loss = engine.forward(next(it))
+            engine.backward()
+            engine.step()
+    return float(loss), [np.asarray(x) for x in
+                         jax.tree_util.tree_leaves(engine.params)]
+
+
+def _baseline(stage, mode, gas):
+    key = (stage, mode, gas)
+    if key not in _BASELINES:
+        _BASELINES[key] = _train(_make_engine(comm_cfg=FLAT, stage=stage,
+                                              gas=gas), mode, gas)
+    return _BASELINES[key]
+
+
+def _assert_tracks(ref, got, wire):
+    la, pa = ref
+    lb, pb = got
+    assert abs(la - lb) <= 0.02 * max(abs(la), 1.0), (la, lb)
+    rtol = {"int8": 5e-2, "int4": 2.5e-1}[wire]
+    max_abs = {"int8": 5e-2, "int4": 1.2e-1}[wire]
+    # int4 has ~7% per-contribution granularity (scale/2 = amax/14), so
+    # more near-zero gradients flip sign into ~lr-sized Adam drift
+    bad_frac = {"int8": 0.05, "int4": 0.12}[wire]
+    n_bad = n_total = 0
+    for x, y in zip(pa, pb):
+        diff = np.abs(x - y)
+        # bulk within the wire's quantization envelope; a compressed
+        # gradient can flip a near-zero element's sign, which Adam
+        # turns into ~lr of drift — allow such violators to be RARE
+        # (pooled over the whole tree: a tiny bias leaf must not turn
+        # one drifted element into a >5% "fraction")
+        n_bad += int((diff > 1e-3 + rtol * np.abs(x)).sum())
+        n_total += diff.size
+        assert float(diff.max()) < max_abs, float(diff.max())
+    assert n_bad / n_total < bad_frac, \
+        f"{100 * n_bad / n_total:.2f}% of elements off"
+
+
+@pytest.mark.parametrize("stage", [0, 2])
+@pytest.mark.parametrize("mode,gas", [("fused", 1), ("scan", 2),
+                                      ("micro", 2)])
+def test_flat_int8_wire_tracks_fp32(stage, mode, gas):
+    eng = _make_engine(comm_cfg=dict(FLAT, wire_dtype="int8",
+                                     quant_block_size=32),
+                       stage=stage, gas=gas)
+    assert eng.bucket_plan.quantized
+    _assert_tracks(_baseline(stage, mode, gas), _train(eng, mode, gas),
+                   "int8")
+
+
+def test_flat_int4_wire_tracks_fp32():
+    eng = _make_engine(comm_cfg=dict(FLAT, wire_dtype="int4",
+                                     quant_block_size=32))
+    _assert_tracks(_baseline(0, "fused", 1), _train(eng, "fused", 1),
+                   "int4")
+
+
+@pytest.mark.parametrize("wire", ["int8", "int4"])
+@pytest.mark.parametrize("stage", [0, 2])
+def test_hier_quant_outer_tracks_fp32(wire, stage):
+    """The qgZ placement: exact fast hop, quantized slow hop.  ZeRO-2
+    additionally leaves buckets on the hpZ shards (scatter)."""
+    eng = _make_engine(comm_cfg=dict(HIER, wire_dtype_outer=wire,
+                                     quant_block_size=32), stage=stage)
+    inner, outer = eng.bucket_plan.levels
+    assert inner.wire == "fp32" and outer.wire == wire
+    assert eng.bucket_plan.scatter == (stage >= 2)
+    _assert_tracks(_baseline(stage, "fused", 1),
+                   _train(eng, "fused", 1), wire)
+
+
+def test_hier_auto_quant_resolves_flat_single_process():
+    """hierarchy "auto" on a single process flattens; the quantized
+    wire then rides the flat gather path unchanged."""
+    eng = _make_engine(comm_cfg=dict(FLAT, hierarchy="auto",
+                                     wire_dtype="int8",
+                                     quant_block_size=32))
+    assert not eng.mesh_info.hierarchical
+    assert eng.bucket_plan.quantized and not eng.bucket_plan.hierarchical
+    _assert_tracks(_baseline(0, "fused", 1), _train(eng, "fused", 1),
+                   "int8")
+
+
+# ---------------------------------------------------------------------------
+# qwZ: quantized stage-3 parameter gather
+# ---------------------------------------------------------------------------
+
+def _make_qwz(qw, gas=1, hidden=64, comm_cfg=None):
+    cfg = {
+        "train_batch_size": 32 * gas,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3,
+                              **({"quantized_weights": qw} if qw else {})},
+        "mesh": {"data": 8},
+        "steps_per_print": 0,
+    }
+    if comm_cfg is not None:
+        cfg["comm"] = comm_cfg
+    engine, *_ = ds.initialize(model=SimpleModel(hidden_dim=hidden),
+                               config_params=cfg)
+    return engine
+
+
+def _train64(engine, mode, gas, steps=4, seed=3):
+    it = random_batches(steps * gas, batch_size=32, in_dim=64, seed=seed)
+    loss = None
+    if mode == "scan":
+        for _ in range(steps):
+            loss = engine.train_batch(it)
+    else:
+        for _ in range(steps * gas):
+            loss = engine.forward(next(it))
+            engine.backward()
+            engine.step()
+    return float(loss), [np.asarray(x) for x in
+                         jax.tree_util.tree_leaves(engine.params)]
+
+
+@pytest.mark.parametrize("mode,gas", [("fused", 1), ("scan", 2),
+                                      ("micro", 2)])
+def test_qwz_stage3_tracks_unquantized(mode, gas):
+    ref = _train64(_make_qwz(None, gas=gas), mode, gas)
+    eng = _make_qwz("int8", gas=gas)
+    assert eng._qwz_gather is not None and eng._qwz_gather.active
+    got = _train64(eng, mode, gas)
+    _assert_tracks(ref, got, "int8")
+    # the MASTER weights stay full precision
+    assert all(p.dtype == np.float32 for p in got[1])
+
+
+def test_qwz_int4_and_hierarchy_request_stays_flat():
+    """stage 3 x hierarchy: the mesh flattens (param sharding owns the
+    layout) and qwZ rides the flat data axis."""
+    ref = _train64(_make_qwz(None), "fused", 1)
+    eng = _make_qwz("int4", comm_cfg=dict(HIER))
+    assert not eng.mesh_info.hierarchical
+    assert eng._qwz_gather is not None and eng._qwz_gather.wire == "int4"
+    got = _train64(eng, "fused", 1)
+    _assert_tracks(ref, got, "int4")
+
+
+def test_qwz_counter_pins_to_plan_exactly():
+    eng = _make_qwz("int8", gas=2)
+    g = eng._qwz_gather
+    snap = COUNTERS.snapshot()
+    _train64(eng, "scan", 2, steps=2)     # scan: ONE gather per batch
+    delta = COUNTERS.delta_since(snap)["qwz.gather"]
+    assert delta["bytes"] == g.wire_bytes_per_gather * 2
+    assert delta["calls"] == g.collectives_per_gather * 2
+    snap = COUNTERS.snapshot()
+    _train64(eng, "micro", 2, steps=1)    # split: one gather per micro
+    delta = COUNTERS.delta_since(snap)["qwz.gather"]
+    assert delta["bytes"] == g.wire_bytes_per_gather * 2
+
+
+def test_qwz_blocked_below_stage3():
+    eng = _make_engine(stage=2, zero_optimization={
+        "stage": 2, "quantized_weights": "int8"})
+    assert eng._qwz_gather is None  # logged fallback, params full width
+
+
+def test_qwz_blocked_on_mixed_axis_mesh():
+    """TP/pipe meshes keep the full-width gather: under the legacy-jax
+    full-manual shard_map shim the data-only specs would silently
+    replicate TP-sharded leaves — a memory hazard, so pure-DP only."""
+    cfg = {
+        "train_batch_size": 32,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "quantized_weights": "int8"},
+        "mesh": {"data": 4, "model": 2},
+        "steps_per_print": 0,
+    }
+    engine, *_ = ds.initialize(model=SimpleModel(hidden_dim=64),
+                               config_params=cfg)
+    assert engine._qwz_gather is None
+
+
+def test_qwz_gather_bytes_beat_full_width():
+    eng = _make_qwz("int8", hidden=64)
+    g = eng._qwz_gather
+    # the sharded leaf is 64x64 fp32 = 16 KiB full width; each rank
+    # contributes its 1/8 shard quantized: ~512 B + scales vs 2 KiB
+    assert g.wire_bytes_per_gather * 3 < 64 * 64 * 4 // 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# byte accounting: counters == the plan, exactly (tier-1 gate)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode,gas", [("fused", 1), ("scan", 2),
+                                      ("micro", 2)])
+def test_quant_inter_counter_pins_to_plan_exactly(mode, gas):
+    """`grad_wire.inter` equals the plan-predicted QUANTIZED bytes
+    (payload + fp16 scales, incl. block padding); the `_logical` twin
+    carries the pad-free payload."""
+    eng = _make_engine(comm_cfg=dict(HIER, wire_dtype_outer="int8",
+                                     quant_block_size=32), gas=gas)
+    plan = eng.bucket_plan
+    snap = COUNTERS.snapshot()
+    steps = 2
+    _train(eng, mode, gas, steps=steps)
+    delta = COUNTERS.delta_since(snap)
+    events = steps * gas
+    inter = delta["grad_wire.inter"]
+    assert inter["bytes"] == plan.wire_bytes_inter_per_reduction * events
+    assert inter["calls"] == plan.collectives_inter_per_reduction * events
+    logical = delta["grad_wire.inter_logical"]
+    assert logical["bytes"] == \
+        plan.wire_bytes_inter_logical_per_reduction * events
+    assert logical["bytes"] <= inter["bytes"]
+    total = delta["grad_wire.reduce"]
+    assert total["bytes"] == plan.wire_bytes_per_reduction * events
+    assert delta["grad_wire.reduce_logical"]["bytes"] == \
+        plan.wire_bytes_logical_per_reduction * events
+
+
+def test_quant_inter_bytes_beat_bf16_by_2x():
+    """Acceptance shape of BENCH round-11: the quantized slow hop moves
+    >= 2x fewer logical bytes than bf16 (int8 ~2x, int4 ~4x)."""
+    def inter_logical(wire):
+        eng = _make_engine(comm_cfg=dict(HIER, wire_dtype_outer=wire))
+        return eng.bucket_plan.wire_bytes_inter_logical_per_reduction
+
+    bf16 = inter_logical("bf16")
+    assert inter_logical("int4") * 2 <= bf16 // 2 * 2  # ~4x
+    assert inter_logical("int8") <= bf16 // 2 + \
+        2 * 2 * _make_engine(comm_cfg=HIER).bucket_plan.n_buckets
+
+
+def test_overflow_fires_through_quant_wire():
+    """Non-finite gradients crossing the quantized wire must surface as
+    an overflow skip (marker codes reconstruct NaN), never a silently
+    clipped step."""
+    eng = _make_engine(comm_cfg=dict(FLAT, wire_dtype="int8"),
+                       gradient_clipping=0.0)
+    it = random_batches(2, batch_size=32, seed=0)
+    eng.forward(next(it)); eng.backward(); eng.step()
+    before = [np.asarray(x) for x in
+              jax.tree_util.tree_leaves(eng.params)]
+    x, y = next(it)
+    x = x.copy()
+    x[0, 0] = np.inf  # forward produces inf/nan grads
+    eng.forward((x, y)); eng.backward(); eng.step()
+    eng._resolve_pending_overflow()
+    after = [np.asarray(p) for p in
+             jax.tree_util.tree_leaves(eng.params)]
+    for b, a in zip(before, after):
+        np.testing.assert_array_equal(b, a)  # step skipped
+    assert eng._skipped_steps >= 1
+
+
+# ---------------------------------------------------------------------------
+# report rendering + bench tool CPU dry-run (tier-1 cover)
+# ---------------------------------------------------------------------------
+
+def test_report_renders_logical_and_qwz_sections(tmp_path):
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    eng = _make_engine(
+        comm_cfg=dict(HIER, wire_dtype_outer="int8", quant_block_size=32),
+        monitor={"enabled": True, "output_path": str(tmp_path),
+                 "job_name": "run", "flush_interval": 1})
+    _train(eng, "fused", 1, steps=2)
+    eng.finalize_monitoring()
+    md = render_markdown(load_run(str(tmp_path / "run")))
+    assert "logical payload" in md
+    assert "grad_wire.inter_logical" in md
+
+    eng = _make_qwz("int8")
+    eng.run_monitor = None  # reuse engine only for counters below
+    snap = COUNTERS.snapshot()
+    _train64(eng, "fused", 1, steps=1)
+    assert "qwz.gather" in COUNTERS.delta_since(snap)
+
+
+def test_grad_wire_bench_quant_dry_run(tmp_path):
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        bench = importlib.import_module("grad_wire_bench")
+    finally:
+        sys.path.pop(0)
+    result = bench.run_dry(str(tmp_path), steps=2)
+    for lane in ("bucketed_int8", "hier_outer_int8", "hier_outer_int4",
+                 "zero2_hier_int8"):
+        assert result[lane]["step_ms"] > 0, lane
+        assert result[lane]["counted_wire_bytes"] > 0, lane
+    hier8 = result["hier_outer_int8"]
+    assert hier8["counted_inter_bytes"] == \
+        hier8["inter_bytes_per_step"] * 2
+    assert hier8["counted_inter_logical_bytes"] <= \
+        hier8["counted_inter_bytes"]
+    # the artifact landed through monitor/artifacts.py
+    assert (tmp_path / "manifest.jsonl").exists()
+    assert list(tmp_path.glob("*_grad_wire_cpu_mesh_quant_dryrun.json"))

@@ -91,7 +91,7 @@ def test_plan_flatten_unflatten_roundtrip():
 def test_plan_validation():
     tree = {"a": jax.ShapeDtypeStruct((8,), jnp.float32)}
     with pytest.raises(ValueError, match="wire"):
-        BucketPlan(tree, dp_size=2, bucket_elems=16, wire="int4")
+        BucketPlan(tree, dp_size=2, bucket_elems=16, wire="fp8")
     with pytest.raises(ValueError, match="reduce_bucket_size"):
         BucketPlan(tree, dp_size=2, bucket_elems=0)
     # the split wire is gather-structured: scatter lowers back to gather
@@ -107,7 +107,7 @@ def test_config_surface():
         _make_engine(comm={"gradient_reduction": "sometimes"})
     with pytest.raises(ValueError, match="wire_dtype"):
         _make_engine(comm={"gradient_reduction": "bucketed",
-                           "wire_dtype": "int4"})
+                           "wire_dtype": "fp8"})
     # reference fp32_allreduce key forces the fp32 wire
     eng = _make_engine(comm={"gradient_reduction": "bucketed",
                              "wire_dtype": "bf16"}, fp32_allreduce=True)

@@ -10,6 +10,8 @@ engine offers (runtime/comm/bucketing.py):
   bucketed_bf16  same buckets, bf16 on the wire (half the bytes)
   bucketed_split same buckets, the EleutherAI 24-bit frexp wire
                  (fp16 mantissa + int8 exponent all-gathers)
+  bucketed_int8  same buckets, blockwise int8 + fp16 scales (the qgZ
+                 compression half, comm/quant.py)
   zero2 / zero2_bucketed   the ZeRO-2 lane: implicit vs the bucketed
                  reduce-scatter lowering
 
@@ -30,11 +32,15 @@ slow boundary per bucket:
   hier             fp32 both levels (exact; parity with `bucketed`)
   hier_outer_bf16  slow hop compressed to bf16, fast hop exact
   hier_outer_split slow hop on the 24-bit frexp gather
+  hier_outer_int8  slow hop on blockwise int8 + fp16 scales (qgZ)
+  hier_outer_int4  slow hop on packed int4 nibbles + fp16 scales
   zero2_hier       hierarchical reduce-scatter + hpZ secondary shards
                    (post-step param gather stays intra-group)
+  zero2_hier_int8  same + the quantized slow hop
 
 Each hier row reports the measured grad_wire.intra / grad_wire.inter
-counter split beside the plan prediction.
+counter split beside the plan prediction, and the pad-free logical
+payload so bucket padding never masks a compression win.
 
 Results are recorded through monitor/artifacts.py into
 bench_artifacts/runs/ + manifest (the PR-2 durable-artifact rule).
@@ -63,6 +69,8 @@ VARIANTS = [
                           "wire_dtype": "bf16"}),
     ("bucketed_split", 0, {"gradient_reduction": "bucketed",
                            "wire_dtype": "split"}),
+    ("bucketed_int8", 0, {"gradient_reduction": "bucketed",
+                          "wire_dtype": "int8"}),
     ("zero2", 2, None),
     ("zero2_bucketed", 2, {"gradient_reduction": "bucketed"}),
 ]
@@ -75,7 +83,10 @@ def hier_variants(outer: int):
         ("hier", 0, dict(base)),
         ("hier_outer_bf16", 0, dict(base, wire_dtype_outer="bf16")),
         ("hier_outer_split", 0, dict(base, wire_dtype_outer="split")),
+        ("hier_outer_int8", 0, dict(base, wire_dtype_outer="int8")),
+        ("hier_outer_int4", 0, dict(base, wire_dtype_outer="int4")),
         ("zero2_hier", 2, dict(base)),
+        ("zero2_hier_int8", 2, dict(base, wire_dtype_outer="int8")),
     ]
 
 
@@ -87,7 +98,11 @@ def _free_port():
     return port
 
 
-def bench(args, nproc: int, proc_id: int):
+def measure_variants(variants, steps: int, size: str, seq: int,
+                     warmup: int = 5):
+    """Run each (name, stage, comm-config) lane through the engine and
+    return ({name: entry}, n_params) — shared by the TCP/CPU bench
+    paths and the tier-1 dry-run."""
     import jax
     import numpy as np
 
@@ -96,20 +111,14 @@ def bench(args, nproc: int, proc_id: int):
     from deepspeed_tpu.monitor.counters import COUNTERS
 
     dp = jax.device_count()
-    model_cfg = gpt2_config(args.size, vocab_size=512,
-                            max_seq_len=args.seq, dropout=0.0,
+    model_cfg = gpt2_config(size, vocab_size=512,
+                            max_seq_len=seq, dropout=0.0,
                             embed_dropout=0.0)
     n_params = GPT(model_cfg).num_params()
     rng = np.random.RandomState(0)  # identical stream on every process
-    tok = rng.randint(0, 512, (dp, args.seq + 1)).astype(np.int32)
+    tok = rng.randint(0, 512, (dp, seq + 1)).astype(np.int32)
     batch = (tok[:, :-1], tok[:, 1:])
 
-    variants = list(VARIANTS)
-    if args.hierarchy:
-        # processes are the slow-fabric boundary on the TCP lane; the
-        # single-process mesh has no real boundary — split it 2-ways so
-        # the lowering still runs end-to-end (overhead floor)
-        variants += hier_variants(nproc if nproc > 1 else 2)
     results = {}
     for name, stage, comm in variants:
         cfg = {
@@ -128,13 +137,13 @@ def bench(args, nproc: int, proc_id: int):
         if comm is not None:
             assert engine.bucket_plan is not None, \
                 f"{name}: bucketed wire did not engage"
-        for _ in range(5):  # compile + warm
+        for _ in range(warmup):  # compile + warm
             engine.forward(batch)
             engine.backward()
             engine.step()
         snap = COUNTERS.snapshot()
         t = []
-        for _ in range(args.steps):
+        for _ in range(steps):
             t0 = time.perf_counter()
             loss = engine.forward(batch)
             engine.backward()
@@ -153,9 +162,13 @@ def bench(args, nproc: int, proc_id: int):
                 "lowering": ("reduce-scatter" if plan.scatter
                              else "allreduce"),
                 "wire_bytes_per_step": plan.wire_bytes_per_reduction,
+                "logical_bytes_per_step":
+                    plan.wire_bytes_logical_per_reduction,
                 "collectives_per_step": plan.collectives_per_reduction,
                 "counted_wire_bytes": int(wire.get("bytes", 0)),
             })
+            if plan.quantized:
+                entry["quant_block"] = plan.quant_block
             if plan.hierarchical:
                 inner, outer = plan.levels
                 entry.update({
@@ -165,14 +178,33 @@ def bench(args, nproc: int, proc_id: int):
                         plan.wire_bytes_intra_per_reduction,
                     "inter_bytes_per_step":
                         plan.wire_bytes_inter_per_reduction,
+                    "inter_logical_bytes_per_step":
+                        plan.wire_bytes_inter_logical_per_reduction,
                     "counted_intra_bytes": int(deltas.get(
                         "grad_wire.intra", {}).get("bytes", 0)),
                     "counted_inter_bytes": int(deltas.get(
                         "grad_wire.inter", {}).get("bytes", 0)),
+                    "counted_inter_logical_bytes": int(deltas.get(
+                        "grad_wire.inter_logical", {}).get("bytes", 0)),
                 })
         results[name] = entry
+    return results, n_params
+
+
+def bench(args, nproc: int, proc_id: int):
+    variants = list(VARIANTS)
+    if args.hierarchy:
+        # processes are the slow-fabric boundary on the TCP lane; the
+        # single-process mesh has no real boundary — split it 2-ways so
+        # the lowering still runs end-to-end (overhead floor)
+        variants += hier_variants(nproc if nproc > 1 else 2)
+    results, n_params = measure_variants(variants, args.steps, args.size,
+                                         args.seq)
 
     if proc_id == 0:
+        import jax
+
+        dp = jax.device_count()
         base = results["unfused"]["step_ms"]
         for name in results:
             results[name]["vs_unfused"] = round(
@@ -216,6 +248,40 @@ def single_process(args):
 
     jax.config.update("jax_platforms", "cpu")
     bench(args, 1, 0)
+
+
+def run_dry(artifact_root: str, steps: int = 2, size: str = "nano",
+            seq: int = 16, outer: int = 2):
+    """Tier-1 CPU dry-run of the QUANTIZED grad-wire lanes (the
+    ckpt_bench/input_pipeline_bench pattern): runs in-process on the
+    suite's virtual mesh so the qgZ path — quantized flat wire, int8/int4
+    outer hops, counters, artifact recording — can never silently rot.
+    Returns the recorded result dict."""
+    variants = [
+        ("unfused", 0, None),
+        ("bucketed_int8", 0, {"gradient_reduction": "bucketed",
+                              "wire_dtype": "int8"}),
+    ] + [v for v in hier_variants(outer)
+         if v[0] in ("hier_outer_int8", "hier_outer_int4",
+                     "zero2_hier_int8")]
+    results, n_params = measure_variants(variants, steps, size, seq,
+                                         warmup=1)
+    import jax
+
+    from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+    result = {
+        "metric": "grad_wire_cpu_mesh_quant_dryrun",
+        "platform": "cpu",
+        "n_params": int(n_params),
+        "world": {"processes": 1, "devices": jax.device_count()},
+        "steps": steps,
+        "value": results["hier_outer_int8"]["inter_bytes_per_step"],
+        "unit": "inter_bytes_per_step",
+        **results,
+    }
+    result["artifact"] = record_bench_result(result, root=artifact_root)
+    return result
 
 
 def _record(out: str):
