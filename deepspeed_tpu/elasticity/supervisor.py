@@ -28,6 +28,14 @@ two ways:
   framework's elastic checkpoints ("latest" committed tag) resume it
   there.
 
+Beside the env handoff, every restart decision is appended to
+`restarts.jsonl` in the monitor dir (reason, dead ranks, backoff
+chosen, watchdog diagnostics path if any), and the in-process
+StepWatchdog's `watchdog_trip.json` escalation (runtime/resilience.py)
+is polled as a third trigger — a hung step inside a still-"alive"
+process restarts promptly with its diagnostic snapshot linked from the
+ledger.
+
 Usage (also `ds_elastic supervise -- ...`):
 
     python -m deepspeed_tpu.elasticity.supervisor \
@@ -56,7 +64,25 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from ..runtime.resilience import WATCHDOG_TRIP_FILE, read_watchdog_trip
 from ..utils.logging import logger
+
+RESTART_LEDGER = "restarts.jsonl"
+
+
+def _ledger_append(path: Optional[str], entry: Dict) -> None:
+    """Append one JSON line to the restart ledger (post-mortems must
+    not depend on supervisor scrollback).  Best-effort: a full disk
+    must not take the supervisor down with it."""
+    if path is None:
+        return
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(json.dumps(entry, default=str) + "\n")
+            f.flush()
+    except OSError as e:
+        logger.warning(f"supervisor: restart ledger write failed: {e}")
 
 
 class RestartPolicy:
@@ -140,6 +166,13 @@ class HeartbeatWatcher:
     * **straggler** — a rank flagged by `straggler_factor` x median in
       `straggler_strikes` CONSECUTIVE heartbeat events (one slow step
       is noise; a persistently slow rank is a failing host).
+    * **watchdog trip** — the in-process StepWatchdog
+      (runtime/resilience.py) detected a hung step/barrier and wrote
+      `watchdog_trip.json` into the run dir with a machine-readable
+      reason + diagnostic-snapshot path.  This escalation path fires
+      as soon as the trip file appears instead of waiting out the
+      (much longer) stall-timeout, and carries the diagnostics path
+      into the restart ledger.
 
     `reset()` re-arms the liveness clock after a relaunch."""
 
@@ -166,11 +199,21 @@ class HeartbeatWatcher:
         """Re-arm after a relaunch: clear strikes, skip everything
         already in the stream (the heartbeats that justified the LAST
         restart must not re-trigger against the fresh child — the
-        relaunched run appends to the same files), and floor the
-        liveness clock at now."""
+        relaunched run appends to the same files), floor the liveness
+        clock at now, and CONSUME any watchdog trip file.  Deleting the
+        trip file (not just mtime-guarding it) matters: the mtime lives
+        in the filesystem's clock domain while `_armed_at` lives in
+        `clock`'s — on a skewed NFS server a stale trip would otherwise
+        out-date every re-arm and restart each healthy child on sight.
+        The diagnostic snapshot it points at stays on disk (the ledger
+        recorded the path)."""
         self._strikes.clear()
         self._hb_offset = self._stream_size()
         self._armed_at = self._clock()
+        try:
+            os.remove(os.path.join(self.run_dir, WATCHDOG_TRIP_FILE))
+        except OSError:
+            pass
 
     def _world_size(self) -> Optional[int]:
         try:
@@ -226,8 +269,33 @@ class HeartbeatWatcher:
                 out.append(e)
         return out
 
+    def _watchdog_trigger(self) -> Optional[dict]:
+        """A StepWatchdog escalation newer than the last (re)arm, as a
+        restart trigger dict (None otherwise)."""
+        path = os.path.join(self.run_dir, WATCHDOG_TRIP_FILE)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return None
+        if mtime <= self._armed_at:
+            return None  # a previous incarnation's trip; reset() re-arms
+        trip = read_watchdog_trip(self.run_dir)
+        if trip is None:
+            return None
+        return {
+            "reason": (f"watchdog trip on rank {trip.get('rank', '?')}: "
+                       f"{trip.get('reason', 'step deadline exceeded')}"),
+            "dead_ranks": [],
+            "surviving_world": None,
+            "diagnostics": trip.get("snapshot"),
+        }
+
     def check(self) -> Optional[dict]:
         now = self._clock()
+        # in-process watchdog escalation beats the coarse stall clock
+        trip = self._watchdog_trigger()
+        if trip is not None:
+            return trip
         # liveness: SOME stream must keep growing
         if self.stall_timeout > 0:
             last = self._last_activity()
@@ -275,11 +343,18 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
               stall_timeout: float = 0.0, straggler_strikes: int = 3,
               grace: float = 15.0, poll_interval: float = 0.5,
               policy: Optional[RestartPolicy] = None,
-              watcher: Optional[HeartbeatWatcher] = None):
+              watcher: Optional[HeartbeatWatcher] = None,
+              ledger_path: Optional[str] = None):
     """Run `command` (list) until it exits 0 or the restart budget is
     exhausted.  See the module docstring for the exit-driven and
     heartbeat-driven restart paths; `policy`/`watcher` may be passed
-    pre-built (tests, custom clocks)."""
+    pre-built (tests, custom clocks).
+
+    Every restart decision (and the final give-up) is appended to
+    `restarts.jsonl` in the monitor dir (override with `ledger_path`) —
+    reason, dead ranks, backoff chosen, watchdog diagnostics path if
+    any — so post-mortems read a machine-parsable ledger instead of
+    supervisor scrollback; `tools/run_report.py` renders it."""
     if policy is None:
         policy = RestartPolicy(max_restarts=max_restarts, backoff=backoff,
                                backoff_cap=backoff_cap, jitter=jitter,
@@ -290,6 +365,11 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
         # detection still runs off the heartbeat events
         watcher = HeartbeatWatcher(monitor_dir, stall_timeout,
                                    straggler_strikes=straggler_strikes)
+    if ledger_path is None:
+        ledger_dir = monitor_dir or (watcher.run_dir
+                                     if watcher is not None else None)
+        if ledger_dir is not None:
+            ledger_path = os.path.join(ledger_dir, RESTART_LEDGER)
     attempt = 0
     child = None
     stop_signal = None
@@ -384,7 +464,21 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 return 128 + int(stop_signal)
             elastic = trigger or None
             delay = policy.record_failure(ran_for)
+            ledger_entry = {
+                "t": time.time(),
+                "attempt": attempt,
+                "ran_for_s": round(ran_for, 3),
+                "exit_code": rc,
+                "reason": (trigger["reason"] if trigger
+                           else f"exit code {rc}"),
+                "dead_ranks": (trigger or {}).get("dead_ranks") or [],
+                "surviving_world": (trigger or {}).get("surviving_world"),
+                "diagnostics": (trigger or {}).get("diagnostics"),
+                "restarts_used": policy.failures_in_window,
+            }
             if delay is None:
+                _ledger_append(ledger_path, dict(
+                    ledger_entry, event="give_up", backoff_s=None))
                 logger.error(
                     f"supervisor: restart budget exhausted "
                     f"({policy.max_restarts} restart(s)"
@@ -392,6 +486,8 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                        if policy.restart_window > 0 else "")
                     + f") after {attempt} attempt(s); last exit code {rc}")
                 return to_exit_code(rc) or 1  # never exit 0 on give-up
+            _ledger_append(ledger_path, dict(
+                ledger_entry, event="restart", backoff_s=round(delay, 3)))
             logger.warning(
                 f"supervisor: "
                 + (f"elastic trigger ({trigger['reason']})" if trigger

@@ -50,7 +50,19 @@ Instrumented sites:
   serialize+write+commit); `ckpt.bytes` — serialized bytes per
   COMMITTED tag (added by the commit job, so an interrupted save never
   counts); `ckpt.pending` — background writer-queue depth sampled at
-  each save (mean = bytes/calls, like input.queue_depth).
+  each save (mean = bytes/calls, like input.queue_depth);
+  `ckpt.skipped_tags` — uncommitted/corrupt tags read_latest_tag
+  skipped back over while resolving a resume point.
+* the chaos runtime (`fault.*` / `watchdog.*`, runtime/resilience.py,
+  rendered by monitor/report.py as the "Resilience" section):
+  `fault.injected` — FaultPlan injections fired; `fault.retried` —
+  retry_transient attempts after a transient failure;
+  `fault.recovered_ms` — wall time ops spent recovering before
+  eventually succeeding (bytes slot carries integer MICROSECONDS);
+  `watchdog.trips` — StepWatchdog deadline trips (each one also dumps
+  a diagnostic snapshot + supervisor escalation file);
+  `input.worker_respawns` — dead prefetch workers replaced by the
+  consumer (counted under input.* but rendered with Resilience).
 """
 
 from __future__ import annotations

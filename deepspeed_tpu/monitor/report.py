@@ -50,7 +50,9 @@ def read_events(path: str) -> List[Dict[str, Any]]:
 
 
 def load_run(run_dir: str) -> Dict[str, Any]:
-    """Load a run directory: manifest (optional) + every rank's events."""
+    """Load a run directory: manifest (optional) + every rank's events
+    + the supervisor restart ledger and watchdog trip file when
+    present (elasticity/supervisor.py, runtime/resilience.py)."""
     manifest = None
     mpath = os.path.join(run_dir, "manifest.json")
     if os.path.exists(mpath):
@@ -65,7 +67,28 @@ def load_run(run_dir: str) -> Dict[str, Any]:
     if not ranks:
         raise FileNotFoundError(
             f"no events.rank*.jsonl under {run_dir!r}")
-    return {"dir": run_dir, "manifest": manifest, "ranks": ranks}
+    restarts: List[Dict[str, Any]] = []
+    rpath = os.path.join(run_dir, "restarts.jsonl")
+    if os.path.exists(rpath):
+        with open(rpath) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    restarts.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail of a live ledger
+    watchdog_trip = None
+    wpath = os.path.join(run_dir, "watchdog_trip.json")
+    if os.path.exists(wpath):
+        try:
+            with open(wpath) as f:
+                watchdog_trip = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            watchdog_trip = None
+    return {"dir": run_dir, "manifest": manifest, "ranks": ranks,
+            "restarts": restarts, "watchdog_trip": watchdog_trip}
 
 
 def _mean(xs):
@@ -166,15 +189,17 @@ def render_markdown(run: Dict[str, Any]) -> str:
             acc = any_comm.setdefault(name, {"calls": 0, "bytes": 0})
             acc["calls"] += d["calls"]
             acc["bytes"] += d["bytes"]
-    # input.*/ckpt.* counters carry pipeline/checkpoint metrics (µs,
-    # queue depths), not wire bytes — split them out of the comm table
-    # into their own sections
+    # input.*/ckpt.*/fault.*/watchdog.* counters carry pipeline/
+    # checkpoint/resilience metrics (µs, queue depths, injection
+    # counts), not wire bytes — split them out of the comm table into
+    # their own sections
     input_counters = {k: v for k, v in any_comm.items()
                       if k.startswith("input.")}
     ckpt_counters = {k: v for k, v in any_comm.items()
                      if k.startswith("ckpt.")}
     wire_counters = {k: v for k, v in any_comm.items()
-                     if not k.startswith(("input.", "ckpt."))}
+                     if not k.startswith(("input.", "ckpt.", "fault.",
+                                          "watchdog."))}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
         lines.append("")
@@ -238,6 +263,69 @@ def render_markdown(run: Dict[str, Any]) -> str:
             lines.append(f"| mean async writer queue depth | "
                          f"{pend['bytes'] / pend['calls']:.2f} "
                          f"(sampled at {pend['calls']:,} saves) |")
+        lines.append("")
+
+    # resilience: fault injection + transient-retry + watchdog activity
+    # (runtime/resilience.py) — a run that absorbed faults should say
+    # so in its report, not hide it in the counter soup
+    res_rows = []
+    inj = any_comm.get("fault.injected")
+    if inj:
+        res_rows.append(f"| faults injected | {inj['calls']:,} |")
+    ret = any_comm.get("fault.retried")
+    if ret:
+        res_rows.append(f"| transient retries | {ret['calls']:,} |")
+    rec = any_comm.get("fault.recovered_ms")
+    if rec:
+        total_ms = rec["bytes"] / 1000.0  # stored as integer µs
+        res_rows.append(f"| time to recover (retry backoff, wall) | "
+                        f"{total_ms:,.1f} ms over {rec['calls']:,} "
+                        f"recovered op(s) |")
+    trips = any_comm.get("watchdog.trips")
+    if trips:
+        res_rows.append(f"| watchdog trips | {trips['calls']:,} |")
+    resp = any_comm.get("input.worker_respawns")
+    if resp:
+        res_rows.append(f"| prefetch workers respawned | "
+                        f"{resp['calls']:,} |")
+    skip = any_comm.get("ckpt.skipped_tags")
+    if skip:
+        res_rows.append(f"| uncommitted checkpoint tags skipped | "
+                        f"{skip['calls']:,} |")
+    wd = run.get("watchdog_trip")
+    if wd:
+        res_rows.append(f"| last watchdog trip | rank "
+                        f"{wd.get('rank', '?')}: "
+                        f"{wd.get('reason', '?')} (snapshot: "
+                        f"`{wd.get('snapshot', '—')}`) |")
+    if res_rows:
+        lines.append("## Resilience")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        lines.extend(res_rows)
+        lines.append("")
+
+    # supervisor restart ledger (elasticity/supervisor.py restarts.jsonl)
+    restarts = run.get("restarts") or []
+    if restarts:
+        lines.append("## Restarts (supervisor ledger)")
+        lines.append("")
+        lines.append("| # | event | reason | ran for | exit | "
+                     "dead ranks | backoff | diagnostics |")
+        lines.append("|---|---|---|---|---|---|---|---|")
+        for i, r in enumerate(restarts):
+            dead = ",".join(str(d) for d in (r.get("dead_ranks") or [])) \
+                or "—"
+            backoff = (f"{r['backoff_s']:.1f}s"
+                       if r.get("backoff_s") is not None else "—")
+            diag = f"`{r['diagnostics']}`" if r.get("diagnostics") else "—"
+            lines.append(
+                f"| {i + 1} | {r.get('event', 'restart')} | "
+                f"{r.get('reason', '?')} | "
+                f"{_fmt(r.get('ran_for_s'), 1, 's')} | "
+                f"{r.get('exit_code', '—')} | {dead} | {backoff} | "
+                f"{diag} |")
         lines.append("")
 
     # hierarchical gradient wire: the per-level (fast/slow fabric) byte

@@ -68,6 +68,7 @@ from flax import serialization
 
 from ..monitor.counters import COUNTERS
 from ..utils.logging import logger
+from .resilience import fault_filter, fault_point, retry_transient
 
 _SHARD_MARKER = "__dstpu_sharded_leaf__"
 COMMIT_MARKER = ".ckpt_commit.json"
@@ -167,14 +168,35 @@ def _atomic_write(path: str, blob: bytes) -> int:
     """tmp + fsync + rename: readers never observe a torn file.  The
     tmp name carries pid AND a process-local sequence number: two
     background commits landing the same target (e.g. `latest` for
-    overlapping async tags) must not collide on one tmp file."""
-    tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, path)
-    return len(blob)
+    overlapping async tags) must not collide on one tmp file.
+
+    Transient storage faults (EIO, injected) retry with bounded backoff
+    (runtime/resilience.py) — each attempt writes a FRESH tmp file, so
+    a half-written casualty of attempt N can never be renamed by
+    attempt N+1.  `ckpt.atomic_write` is a chaos injection site; a
+    `corrupt` rule truncates the blob (the torn-write shape the commit
+    marker + integrity errors exist to catch)."""
+    blob = fault_filter("ckpt.atomic_write.payload", blob)
+
+    def op() -> int:
+        fault_point("ckpt.atomic_write")
+        tmp = f"{path}.tmp.{os.getpid()}.{next(_TMP_SEQ)}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            # best-effort: do not leave the failed attempt's tmp behind
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return len(blob)
+
+    return retry_transient(op, site=f"ckpt.atomic_write {path}")
 
 
 # ---------------------------------------------------------------------------
@@ -198,18 +220,26 @@ class CommitBarrier:
     before the new commit ran.  Save calls are collective and ordered,
     so each process's local counter agrees; an elastic restart restarts
     every process, re-agreeing at 0 (jax.distributed has no partial
-    restart).
+    restart).  `scope` additionally namespaces the keys per SAVE
+    DIRECTORY (a hash of the collective save call's save_dir argument,
+    `_barrier_scope`): without it, same-tag saves into two DIFFERENT
+    directories — two experiment lanes in one job, a copy-then-save
+    flow — rendezvous on one key, and the coordination service's
+    write-once KV rejects the second commit with ALREADY_EXISTS (found
+    by the chaos campaign's base/chaos lane pair against the real
+    coordination service).
 
     `_endpoint=(client, rank, world)` lets tests drive the barrier over
     a fake in-memory KV (tests/test_hostwire.FakeCoordClient)."""
 
     def __init__(self, tag: str, timeout_ms: int = COMMIT_TIMEOUT_MS,
-                 seq: int = 0, _endpoint=None):
+                 seq: int = 0, scope: str = "", _endpoint=None):
         from .comm.hostwire import KVSignals
 
         self.signals = KVSignals(_endpoint=_endpoint)
         self.tag = str(tag)
         self.seq = int(seq)
+        self.scope = str(scope)
         self.timeout_ms = int(timeout_ms)
 
     @property
@@ -217,7 +247,8 @@ class CommitBarrier:
         return self.signals.world
 
     def _key(self, kind: str, rank: Optional[int] = None) -> str:
-        base = f"dstpu-ckpt/{self.tag}/{self.seq}/{kind}"
+        scope = f"{self.scope}/" if self.scope else ""
+        base = f"dstpu-ckpt/{scope}{self.tag}/{self.seq}/{kind}"
         return base if rank is None else f"{base}/{rank}"
 
     def commit(self, commit_fn) -> None:
@@ -519,18 +550,32 @@ def read_tag_meta(load_dir: str, tag) -> Optional[Dict[str, Any]]:
         return None
 
 
-def committed_tags(load_dir: str) -> List[str]:
-    """Committed tags under `load_dir`, oldest -> newest commit time."""
-    out = []
+def _partition_tags(load_dir: str) -> Tuple[List[str], List[str]]:
+    """One directory scan, one marker read per tag dir: (committed tags
+    oldest -> newest commit time, uncommitted/corrupt tag dirs sorted
+    by name).  Shared by committed_tags/uncommitted_tags so the
+    fallback resume path doesn't pay the marker IO twice on slow
+    network filesystems."""
+    committed, uncommitted = [], []
     try:
         entries = os.listdir(load_dir)
     except OSError:
-        return []
+        return [], []
     for name in entries:
+        if not os.path.isdir(os.path.join(load_dir, name)):
+            continue
         marker = read_tag_meta(load_dir, name)
         if marker is not None:
-            out.append((float(marker.get("committed_unix", 0.0)), name))
-    return [name for _, name in sorted(out)]
+            committed.append((float(marker.get("committed_unix", 0.0)),
+                              name))
+        else:
+            uncommitted.append(name)
+    return ([name for _, name in sorted(committed)], sorted(uncommitted))
+
+
+def committed_tags(load_dir: str) -> List[str]:
+    """Committed tags under `load_dir`, oldest -> newest commit time."""
+    return _partition_tags(load_dir)[0]
 
 
 def _dir_has_markers(load_dir: str) -> bool:
@@ -564,6 +609,17 @@ def write_commit_marker(save_dir: str, tag,
     _fsync_dir(ckpt_dir)
 
 
+def _barrier_scope(save_dir: str) -> str:
+    """Stable per-save-directory namespace for the commit barrier's KV
+    keys.  Hashes the save_dir STRING as passed (not realpath: the
+    collective contract is that every process passes the same argument,
+    while mount-point realpaths can legitimately differ across
+    hosts)."""
+    import hashlib
+
+    return hashlib.md5(str(save_dir).encode()).hexdigest()[:12]
+
+
 def _commit(save_dir: str, tag, meta: Optional[Dict[str, Any]],
             save_latest: bool, nbytes: int,
             commit_endpoint=None,
@@ -574,8 +630,10 @@ def _commit(save_dir: str, tag, meta: Optional[Dict[str, Any]],
     order, so `latest` can never name an uncommitted tag.  Module-level
     so crash tests can monkeypatch it away, simulating a writer killed
     between the file writes and the commit."""
+    fault_point("ckpt.commit")
     barrier = CommitBarrier(str(tag), timeout_ms=commit_timeout_ms,
-                            seq=seq, _endpoint=commit_endpoint)
+                            seq=seq, scope=_barrier_scope(save_dir),
+                            _endpoint=commit_endpoint)
 
     def publish():
         write_commit_marker(save_dir, tag, meta,
@@ -708,6 +766,7 @@ def save_checkpoint_state(save_dir: str, tag: str, model_state: Dict[str, Any],
         # order so `latest` always ends on the newest save (a failed
         # predecessor doesn't block this commit — its own flush
         # surfaces the error).
+        fault_point("ckpt.background_write")
         nbytes = build_and_write(parallel)
         if chain_after is not None:
             try:
@@ -739,11 +798,23 @@ def save_checkpoint_state(save_dir: str, tag: str, model_state: Dict[str, Any],
     return ckpt_dir
 
 
+def uncommitted_tags(load_dir: str) -> List[str]:
+    """Tag directories under `load_dir` WITHOUT a (readable) commit
+    marker — interrupted or corrupt saves the skip-back must never
+    resume from.  Only meaningful when the directory uses markers."""
+    return _partition_tags(load_dir)[1]
+
+
 def read_latest_tag(load_dir: str) -> Optional[str]:
     """The tag training should resume from: the `latest` pointer when its
     tag is committed (or the directory predates commit markers), else
     the newest committed tag — a save that died before its commit
-    barrier is invisible here by construction."""
+    barrier is invisible here by construction.
+
+    Every uncommitted/corrupt tag skipped on the way back is logged by
+    name and counted in `ckpt.skipped_tags`, so a post-mortem can see
+    HOW MANY saves died (one interrupted save is preemption noise; a
+    pile of them is a storage or commit-barrier problem)."""
     tag = None
     latest = os.path.join(load_dir, "latest")
     if os.path.isfile(latest):
@@ -755,14 +826,23 @@ def read_latest_tag(load_dir: str) -> Optional[str]:
         # legacy layout (pre-commit-marker saves, incl. the multi-host
         # pipeline writer's own barriered format): latest is authoritative
         return tag
-    fallback = committed_tags(load_dir)
+    fallback, skipped = _partition_tags(load_dir)
+    if skipped:
+        COUNTERS.add("ckpt.skipped_tags", calls=len(skipped))
+        for name in skipped:
+            logger.warning(
+                f"checkpoint tag {name!r} in {load_dir} has no commit "
+                f"marker (interrupted or corrupt save) — skipped as a "
+                f"resume candidate")
     if fallback:
         newest = fallback[-1]
         if tag is not None:
             logger.warning(
                 f"checkpoint tag {tag!r} in {load_dir} was never "
                 f"committed (interrupted save?); falling back to the "
-                f"newest committed tag {newest!r}")
+                f"newest committed tag {newest!r}"
+                + (f" (skipped {len(skipped)} uncommitted tag(s): "
+                   f"{skipped})" if skipped else ""))
         return newest
     return None
 

@@ -34,6 +34,9 @@ from typing import Dict, Tuple
 
 import numpy as np
 
+from ..resilience import (fault_point, is_transient_not_timeout,
+                          retry_transient)
+
 DEFAULT_TIMEOUT_MS = 120_000
 
 # -- scaling envelope (documented contract) ---------------------------------
@@ -122,16 +125,60 @@ def _kv_set(client, key: str, payload: bytes) -> None:
     blocking_key_value_get are stable everywhere — so the wire rides the
     string API with base64 framing.  The 4/3 expansion is priced into
     CHUNK_BYTES: a 2 MiB raw chunk is ~2.7 MiB encoded, still under the
-    4 MiB gRPC message cap."""
+    4 MiB gRPC message cap.
+
+    Transient coordinator faults (UNAVAILABLE, connection reset,
+    injected) retry with bounded backoff (runtime/resilience.py).  The
+    wire's keys are write-once per (tag, step, gen), so a retry racing
+    its own landed first attempt surfaces as ALREADY_EXISTS from the
+    real coordination service — that means the value IS durably there,
+    i.e. success."""
     import base64
 
-    client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
+    encoded = base64.b64encode(payload).decode("ascii")
+    _kv_set_write_once(client, key, encoded, "hostwire.kv_set")
+
+
+def _kv_set_write_once(client, key: str, value: str, site: str) -> None:
+    """Transient-retried set of a WRITE-ONCE key.  The subtle invariant
+    lives here exactly once: ALREADY_EXISTS counts as success ONLY on a
+    retry (our own first attempt landed before its ack was lost); on
+    the first attempt it means a FOREIGN writer holds the key
+    (mis-ranked launch, seq bug) — proceeding would silently serve
+    peers someone else's bytes, so that stays a loud failure."""
+    attempt = [0]
+
+    def op():
+        attempt[0] += 1
+        fault_point(site)
+        try:
+            client.key_value_set(key, value)
+        except Exception as e:
+            if attempt[0] > 1 and \
+                    "ALREADY_EXISTS" in str(e).upper().replace(" ", "_"):
+                return
+            raise
+
+    retry_transient(op, site=f"{site} {key}")
 
 
 def _kv_get(client, key: str, timeout_ms: int) -> bytes:
     import base64
 
-    return base64.b64decode(client.blocking_key_value_get(key, timeout_ms))
+    # ONE deadline across retries: a DEADLINE_EXCEEDED first attempt
+    # leaves ~nothing for the retries, so retrying a timeout cannot
+    # multiply the caller's budget (genuine dead peers still surface in
+    # ~timeout_ms); transient transport blips mid-budget retry with the
+    # time that is left
+    deadline = time.monotonic() + timeout_ms / 1000.0
+
+    def op():
+        fault_point("hostwire.kv_get")
+        left = max(1, int((deadline - time.monotonic()) * 1000))
+        return base64.b64decode(
+            client.blocking_key_value_get(key, left))
+
+    return retry_transient(op, site=f"hostwire.kv_get {key}")
 
 
 class KVSignals:
@@ -153,14 +200,27 @@ class KVSignals:
     def post(self, key: str, value: str = "1") -> None:
         if self.client is None:
             return
-        self.client.key_value_set(key, str(value))
+        # write-once semantics shared with the data wire: a retry's
+        # ALREADY_EXISTS resolves to success, a first attempt's stays
+        # loud (_kv_set_write_once)
+        _kv_set_write_once(self.client, key, str(value), "kv.post")
 
     def wait(self, key: str, timeout_ms: int = DEFAULT_TIMEOUT_MS) -> str:
         if self.client is None:
             raise RuntimeError(
                 "KVSignals.wait: no coordination-service client attached "
                 "(single-process run?) — nothing ever posts keys here")
-        return self.client.blocking_key_value_get(key, int(timeout_ms))
+
+        def op():
+            fault_point("kv.wait")
+            return self.client.blocking_key_value_get(key, int(timeout_ms))
+
+        # the blocking timeout IS the dead-peer detector here (commit
+        # barrier): transient transport blips retry, deadlines do not —
+        # retrying them would multiply commit_timeout_ms and delay the
+        # CheckpointIntegrityError the caller exists to raise
+        return retry_transient(op, site=f"kv.wait {key}",
+                               classify=is_transient_not_timeout)
 
     def delete(self, key: str) -> None:
         if self.client is None:
@@ -192,6 +252,17 @@ class HostWire:
         self.chunk_bytes = int(chunk_bytes)
         self.max_payload_bytes = int(max_payload_bytes)
         self._step = 0
+        # generation/attempt id scoping the keys of each gather ATTEMPT:
+        # bumped whenever a gather fails mid-flight, so a retried gather
+        # (or one racing keys stranded by a rank that died between the
+        # read and clean barriers — those are never deleted) posts and
+        # reads under FRESH keys instead of consuming a dead attempt's
+        # payload or colliding with its write-once keys.  Failures are
+        # symmetric across ranks (a dead peer times everyone out; an
+        # injected fault is scheduled on every rank or surfaces as the
+        # others' barrier timeout), so collectively-retried gathers
+        # re-agree on the generation.
+        self._gen = 0
 
     def allgather_bytes(self, payload: bytes) -> list:
         """payload from every process, in rank order.
@@ -203,6 +274,7 @@ class HostWire:
         from ...monitor.counters import COUNTERS
 
         COUNTERS.add("hostwire.allgather", len(payload))
+        fault_point("hostwire.allgather")
         if len(payload) > self.max_payload_bytes:
             raise ValueError(
                 f"hostwire payload of {len(payload)} bytes exceeds the "
@@ -214,7 +286,18 @@ class HostWire:
         if self.client is None or self.world == 1:
             self._step += 1
             return [payload]
-        key = f"{self.tag}/{self._step}"
+        try:
+            return self._allgather(payload)
+        except BaseException:
+            # the attempt died mid-protocol (peer timeout, injected
+            # fault, operator interrupt): its keys may be stranded —
+            # nobody can safely clean them (a dead rank couldn't have
+            # either) — so the NEXT attempt moves to a fresh generation
+            self._gen += 1
+            raise
+
+    def _allgather(self, payload: bytes) -> list:
+        key = f"{self.tag}/{self._step}g{self._gen}"
         cb = self.chunk_bytes
         nparts = max(1, -(-len(payload) // cb))
         _kv_set(self.client, f"{key}/{self.rank}/n",
@@ -222,6 +305,9 @@ class HostWire:
         for i in range(nparts):
             _kv_set(self.client, f"{key}/{self.rank}/{i}",
                     payload[i * cb:(i + 1) * cb])
+        # chaos hook for the nastiest window: this rank's payload is up
+        # but it dies before the read/clean barriers, stranding keys
+        fault_point("hostwire.allgather.posted")
         # ONE deadline for the whole gather: timeout_ms bounds the call,
         # not each of the W x nparts gets (a dead peer must surface in
         # ~timeout_ms regardless of payload size)

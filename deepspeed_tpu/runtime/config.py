@@ -258,6 +258,108 @@ class DeepSpeedDataPipelineConfig(DeepSpeedConfigObject):
         return self.enabled and self.device_prefetch
 
 
+class DeepSpeedFaultsConfig(DeepSpeedConfigObject):
+    """Chaos-ready runtime (runtime/resilience.py).
+
+    "faults": {
+      "seed": 0,
+      "enabled": true,          # injection gate; default: rules present
+      "rules": [{"site": ..., "kind": "raise"|"delay_ms"|"corrupt"|
+                 "hang"|"kill", ...schedule...}],
+      "retry": {"max_attempts": 4, "base_delay_ms": 50,
+                "max_delay_ms": 2000, "jitter": 0.25},
+      "watchdog": {"enabled": false, "deadline_s": 600, "poll_s": 1.0,
+                   "snapshot_dir": null}   # default: the monitor run dir
+    }
+
+    `rules` drive deterministic fault injection (every rule is validated
+    here — a typo'd site key or kind fails at config time, never inside
+    a training step); `retry` and `watchdog` are HARDENING knobs that
+    apply whether or not injection is enabled.  The engine installs the
+    plan/policy process-globally at initialize() and arms the watchdog
+    beside the run monitor."""
+
+    def __init__(self, param_dict):
+        super().__init__()
+        from .resilience import FaultPlan, RetryPolicy
+
+        d = param_dict.get(c.FAULTS) or {}
+        known = {c.FAULTS_ENABLED, c.FAULTS_SEED, c.FAULTS_RULES,
+                 c.FAULTS_RETRY, c.FAULTS_WATCHDOG}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"faults: unknown key(s) {sorted(unknown)}; expected a "
+                f"subset of {sorted(known)}")
+        self.seed = int(get_scalar_param(d, c.FAULTS_SEED,
+                                         c.FAULTS_SEED_DEFAULT))
+        rules = d.get(c.FAULTS_RULES) or []
+        if not isinstance(rules, list):
+            raise ValueError(
+                f"faults.{c.FAULTS_RULES} must be a list of rule objects, "
+                f"got {type(rules).__name__}")
+        enabled = d.get(c.FAULTS_ENABLED)
+        try:
+            # parse eagerly: rule validation errors belong to config time
+            self.plan = FaultPlan.from_config(
+                rules, seed=self.seed,
+                enabled=None if enabled is None else bool(enabled))
+        except ValueError as e:
+            raise ValueError(f"faults.{c.FAULTS_RULES}: {e}")
+        self.enabled = self.plan.enabled
+
+        r = d.get(c.FAULTS_RETRY) or {}
+        known_r = {c.FAULTS_RETRY_MAX_ATTEMPTS, c.FAULTS_RETRY_BASE_DELAY_MS,
+                   c.FAULTS_RETRY_MAX_DELAY_MS, c.FAULTS_RETRY_JITTER}
+        unknown = set(r) - known_r
+        if unknown:
+            raise ValueError(
+                f"faults.{c.FAULTS_RETRY}: unknown key(s) {sorted(unknown)}; "
+                f"expected a subset of {sorted(known_r)}")
+        try:
+            self.retry_policy = RetryPolicy(
+                max_attempts=get_scalar_param(
+                    r, c.FAULTS_RETRY_MAX_ATTEMPTS,
+                    c.FAULTS_RETRY_MAX_ATTEMPTS_DEFAULT),
+                base_delay_ms=get_scalar_param(
+                    r, c.FAULTS_RETRY_BASE_DELAY_MS,
+                    c.FAULTS_RETRY_BASE_DELAY_MS_DEFAULT),
+                max_delay_ms=get_scalar_param(
+                    r, c.FAULTS_RETRY_MAX_DELAY_MS,
+                    c.FAULTS_RETRY_MAX_DELAY_MS_DEFAULT),
+                jitter=get_scalar_param(r, c.FAULTS_RETRY_JITTER,
+                                        c.FAULTS_RETRY_JITTER_DEFAULT))
+        except ValueError as e:
+            raise ValueError(f"faults.{c.FAULTS_RETRY}: {e}")
+
+        w = d.get(c.FAULTS_WATCHDOG) or {}
+        known_w = {c.FAULTS_WATCHDOG_ENABLED, c.FAULTS_WATCHDOG_DEADLINE_S,
+                   c.FAULTS_WATCHDOG_POLL_S, c.FAULTS_WATCHDOG_SNAPSHOT_DIR}
+        unknown = set(w) - known_w
+        if unknown:
+            raise ValueError(
+                f"faults.{c.FAULTS_WATCHDOG}: unknown key(s) "
+                f"{sorted(unknown)}; expected a subset of {sorted(known_w)}")
+        self.watchdog_enabled = bool(get_scalar_param(
+            w, c.FAULTS_WATCHDOG_ENABLED, c.FAULTS_WATCHDOG_ENABLED_DEFAULT))
+        self.watchdog_deadline_s = float(get_scalar_param(
+            w, c.FAULTS_WATCHDOG_DEADLINE_S,
+            c.FAULTS_WATCHDOG_DEADLINE_S_DEFAULT))
+        self.watchdog_poll_s = float(get_scalar_param(
+            w, c.FAULTS_WATCHDOG_POLL_S, c.FAULTS_WATCHDOG_POLL_S_DEFAULT))
+        self.watchdog_snapshot_dir = get_scalar_param(
+            w, c.FAULTS_WATCHDOG_SNAPSHOT_DIR, None)
+        if self.watchdog_enabled and self.watchdog_deadline_s <= 0:
+            raise ValueError(
+                f"faults.watchdog.{c.FAULTS_WATCHDOG_DEADLINE_S} must be "
+                f"> 0, got {self.watchdog_deadline_s}")
+        if self.watchdog_enabled and self.watchdog_poll_s <= 0:
+            # poll_s 0 would busy-spin the daemon thread on a core
+            raise ValueError(
+                f"faults.watchdog.{c.FAULTS_WATCHDOG_POLL_S} must be "
+                f"> 0, got {self.watchdog_poll_s}")
+
+
 def get_fp16_enabled(param_dict):
     return get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_ENABLED,
                             c.FP16_ENABLED_DEFAULT)
@@ -396,6 +498,10 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         # async input pipeline (runtime/dataloader.py PrefetchLoader +
         # engine._DeviceFeed) — default ON
         self.data_pipeline_config = DeepSpeedDataPipelineConfig(pd)
+
+        # chaos-ready runtime: fault injection + retry + watchdog
+        # (runtime/resilience.py)
+        self.faults_config = DeepSpeedFaultsConfig(pd)
 
         # pipeline: use_p2p_channels forces the multi-host channel
         # executor even single-process (the driver's virtual-multichip

@@ -131,6 +131,37 @@ DATA_PIPELINE_DEVICE_PREFETCH = "device_prefetch"  # double-buffer H2D
 DATA_PIPELINE_DEVICE_PREFETCH_DEFAULT = True
 
 #############################################
+# Chaos-ready runtime (TPU-specific addition; see runtime/resilience.py
+# and docs/tutorials/resilience.md).  `rules` drive deterministic fault
+# INJECTION (gated on `enabled`, default on iff rules are present);
+# `retry` tunes the transient-fault backoff applied to hostwire KV
+# traffic and checkpoint file IO; `watchdog` arms the in-process hang
+# detector that snapshots + escalates to the elasticity supervisor.
+#############################################
+FAULTS = "faults"
+FAULTS_ENABLED = "enabled"
+FAULTS_SEED = "seed"
+FAULTS_SEED_DEFAULT = 0
+FAULTS_RULES = "rules"
+FAULTS_RETRY = "retry"
+FAULTS_RETRY_MAX_ATTEMPTS = "max_attempts"
+FAULTS_RETRY_MAX_ATTEMPTS_DEFAULT = 4
+FAULTS_RETRY_BASE_DELAY_MS = "base_delay_ms"
+FAULTS_RETRY_BASE_DELAY_MS_DEFAULT = 50.0
+FAULTS_RETRY_MAX_DELAY_MS = "max_delay_ms"
+FAULTS_RETRY_MAX_DELAY_MS_DEFAULT = 2000.0
+FAULTS_RETRY_JITTER = "jitter"
+FAULTS_RETRY_JITTER_DEFAULT = 0.25
+FAULTS_WATCHDOG = "watchdog"
+FAULTS_WATCHDOG_ENABLED = "enabled"
+FAULTS_WATCHDOG_ENABLED_DEFAULT = False
+FAULTS_WATCHDOG_DEADLINE_S = "deadline_s"
+FAULTS_WATCHDOG_DEADLINE_S_DEFAULT = 600.0
+FAULTS_WATCHDOG_POLL_S = "poll_s"
+FAULTS_WATCHDOG_POLL_S_DEFAULT = 1.0
+FAULTS_WATCHDOG_SNAPSHOT_DIR = "snapshot_dir"
+
+#############################################
 # Precision: fp16 section doubles as the precision section via "type"
 # (EleutherAI fork: PRECISION, runtime/constants.py:127-161)
 #############################################

@@ -13,7 +13,11 @@ runs on background thread(s) feeding a bounded queue, so the host
 assembles batch N+1 while the device executes step N.  Batch ORDER is
 deterministic regardless of worker count (round-robin task assignment +
 in-order consumption), threads shut down cleanly on close()/GC/
-StopIteration, and worker exceptions re-raise at the consumer.
+StopIteration, and worker exceptions re-raise at the consumer — after a
+bounded respawn-with-backoff budget: an index-protocol worker that dies
+is replaced by a fresh thread resuming at the exact batch it died on
+(`input.worker_respawns` counts them), so one transient worker death no
+longer kills training (runtime/resilience.py chaos campaigns pin this).
 """
 
 from __future__ import annotations
@@ -30,6 +34,15 @@ import jax
 
 from ..monitor.counters import COUNTERS
 from ..utils.logging import logger
+from .resilience import fault_point
+
+# a dead prefetch worker no longer kills training: the consumer
+# respawns it (resuming at the exact failed batch, so order and content
+# are unchanged) up to MAX_RESPAWNS times per epoch, with doubling
+# backoff between respawns.  After the budget the original exception
+# re-raises — a deterministically failing dataset must still surface.
+WORKER_MAX_RESPAWNS = 2
+WORKER_RESPAWN_BACKOFF_S = 0.05
 
 
 class RepeatingLoader:
@@ -211,11 +224,18 @@ def _bounded_put(stop: threading.Event, q: queue.Queue, item) -> bool:
 # keep the iterator alive from its own worker threads (a cycle that
 # defers GC teardown and can run the finalizer on a producer)
 
-def _index_producer(stop, loader, tasks, worker_id, n_workers, q):
+def _index_producer(stop, loader, tasks, worker_id, n_workers, q,
+                    start=0):
+    """`start` skips this worker's first `start` tasks — a RESPAWNED
+    worker resumes at exactly the batch its predecessor died on (the
+    consumer counts what each queue delivered), so the batch stream
+    stays byte-identical through a worker death."""
     try:
-        for i in range(worker_id, len(tasks), n_workers):
+        for i in range(worker_id + n_workers * start, len(tasks),
+                       n_workers):
             if stop.is_set():
                 return
+            fault_point("dataloader.worker")
             if not _bounded_put(stop, q, loader._materialize(tasks[i])):
                 return
     except BaseException as e:  # noqa: BLE001 — carried to the consumer
@@ -227,6 +247,7 @@ def _index_producer(stop, loader, tasks, worker_id, n_workers, q):
 def _stream_producer(stop, it, q):
     try:
         while not stop.is_set():
+            fault_point("dataloader.worker")
             try:
                 item = next(it)
             except StopIteration:
@@ -251,7 +272,9 @@ class _PrefetchIterator:
       so ONE producer thread pulls next() into a single bounded queue.
     """
 
-    def __init__(self, loader, depth: int, num_workers: int):
+    def __init__(self, loader, depth: int, num_workers: int,
+                 max_respawns: int = WORKER_MAX_RESPAWNS,
+                 respawn_backoff_s: float = WORKER_RESPAWN_BACKOFF_S):
         self._stop = threading.Event()
         self._exhausted = False
         indexable = (hasattr(loader, "_batch_indices")
@@ -267,10 +290,21 @@ class _PrefetchIterator:
         per_q = max(1, -(-depth // workers))
         self._queues = [queue.Queue(maxsize=per_q) for _ in range(workers)]
         self._next_q = 0
+        # worker-death recovery (index mode only: a stream iterator's
+        # position dies with its thread): budget + doubling backoff,
+        # plus the per-queue delivered counts a respawn resumes from
+        self._loader = loader if indexable else None
+        self._tasks = None
+        self._n_workers = workers
+        self._delivered = [0] * workers
+        self._respawns_left = max(0, int(max_respawns))
+        self._respawns_done = 0
+        self._respawn_backoff_s = float(respawn_backoff_s)
         if indexable:
             # snapshot the epoch's batch order ONCE (cheap numpy) so every
             # worker agrees on the task list even if set_epoch races later
             tasks = list(loader._batch_indices())
+            self._tasks = tasks
             self._threads = [
                 threading.Thread(
                     target=_index_producer,
@@ -287,6 +321,37 @@ class _PrefetchIterator:
             t.start()
         self._finalizer = weakref.finalize(
             self, _shutdown, self._stop, self._queues, self._threads)
+
+    def _respawn_worker(self, w: int, exc: BaseException) -> bool:
+        """Replace dead index-mode worker `w` with a fresh thread that
+        resumes at the batch it died on.  Returns False when recovery
+        is off the table (stream mode / budget exhausted / shut down)."""
+        if self._loader is None or self._respawns_left <= 0 or \
+                self._stop.is_set():
+            return False
+        self._respawns_left -= 1
+        backoff = self._respawn_backoff_s * (2 ** self._respawns_done)
+        self._respawns_done += 1
+        COUNTERS.add("input.worker_respawns")
+        logger.warning(
+            f"PrefetchLoader: worker {w} died ({type(exc).__name__}: "
+            f"{exc}); respawning at batch offset {self._delivered[w]} in "
+            f"{backoff * 1000:.0f} ms ({self._respawns_left} respawn(s) "
+            f"left)")
+        time.sleep(backoff)
+        t = threading.Thread(
+            target=_index_producer,
+            args=(self._stop, self._loader, self._tasks, w,
+                  self._n_workers, self._queues[w]),
+            kwargs={"start": self._delivered[w]},
+            name=f"dstpu-prefetch-{w}r", daemon=True)
+        self._threads[w] = t
+        # the finalizer must join the CURRENT thread set
+        self._finalizer.detach()
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._stop, self._queues, self._threads)
+        t.start()
+        return True
 
     # -- consumer ----------------------------------------------------------
 
@@ -326,8 +391,15 @@ class _PrefetchIterator:
             self.close()
             raise StopIteration
         if isinstance(item, _WorkerError):
+            # a dead worker stops at its failed batch with everything
+            # before it already delivered in order — respawn it to
+            # RETRY that batch (bounded budget + doubling backoff) so
+            # one transient worker death no longer kills training
+            if self._respawn_worker(self._next_q, item.exc):
+                return self.__next__()
             self.close()
             raise item.exc
+        self._delivered[self._next_q] += 1
         self._next_q = (self._next_q + 1) % len(self._queues)
         return item
 
@@ -349,7 +421,9 @@ class PrefetchLoader:
     wrap DeepSpeedDataLoader under RepeatingLoader unchanged."""
 
     def __init__(self, loader: Iterable[Any], prefetch_depth: int = 2,
-                 num_workers: int = 1):
+                 num_workers: int = 1,
+                 max_respawns: int = WORKER_MAX_RESPAWNS,
+                 respawn_backoff_s: float = WORKER_RESPAWN_BACKOFF_S):
         if prefetch_depth < 1:
             raise ValueError(
                 f"PrefetchLoader: prefetch_depth must be >= 1, "
@@ -361,6 +435,8 @@ class PrefetchLoader:
         self.loader = loader
         self.prefetch_depth = int(prefetch_depth)
         self.num_workers = int(num_workers)
+        self.max_respawns = int(max_respawns)
+        self.respawn_backoff_s = float(respawn_backoff_s)
         self._live_iter: Optional[weakref.ReferenceType] = None
 
     def __len__(self):
@@ -377,7 +453,9 @@ class PrefetchLoader:
         if prev is not None:
             prev.close()
         it = _PrefetchIterator(self.loader, self.prefetch_depth,
-                               self.num_workers)
+                               self.num_workers,
+                               max_respawns=self.max_respawns,
+                               respawn_backoff_s=self.respawn_backoff_s)
         self._live_iter = weakref.ref(it)
         return it
 

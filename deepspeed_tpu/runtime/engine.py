@@ -51,6 +51,7 @@ from . import constants as const
 from .config import DeepSpeedConfig
 from .dataloader import (DeepSpeedDataLoader, PrefetchLoader,
                          RepeatingLoader, timed_next)
+from . import resilience
 from .fp16.loss_scaler import create_loss_scaler
 from .fp16.onebit import OnebitAdam, OnebitLamb
 from .lr_schedules import SCHEDULERS
@@ -270,6 +271,7 @@ class DeepSpeedEngine:
         self._user_device_feed = None   # latest user-iterator feed
         self._step_log_ring = deque()   # deferred steps_per_print scalars
         self.run_monitor = self._init_run_monitor()
+        self._watchdog = self._init_resilience()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -347,6 +349,7 @@ class DeepSpeedEngine:
         self._user_device_feed = None
         self._step_log_ring = deque()
         self.run_monitor = self._init_run_monitor()
+        self._watchdog = self._init_resilience()
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
         if isinstance(config, str):
@@ -568,6 +571,34 @@ class DeepSpeedEngine:
         return RunMonitor(mc, tensorboard=self.monitor,
                           manifest_extra=extra)
 
+    def _init_resilience(self):
+        """Install the chaos-runtime pieces from the "faults" config
+        block (runtime/resilience.py): the process-global fault plan
+        (cleared when this engine has no rules, so stale injection from
+        a previous engine can never leak into a new run), the transient
+        retry policy, and — when enabled — the StepWatchdog armed
+        beside the run monitor (its snapshots land in the monitor run
+        dir, where the elasticity supervisor's HeartbeatWatcher polls
+        for the escalation file)."""
+        fc = getattr(self._config, "faults_config", None)
+        if fc is None:
+            return None
+        plan = fc.plan if fc.enabled else None
+        if plan is not None:
+            plan.rank = comm.get_rank()
+        resilience.install_fault_plan(plan)
+        resilience.install_retry_policy(fc.retry_policy)
+        if not fc.watchdog_enabled:
+            return None
+        run_dir = (self.run_monitor.run_dir
+                   if self.run_monitor is not None else None)
+        snap_dir = fc.watchdog_snapshot_dir or run_dir or \
+            os.path.join(os.getcwd(), "dstpu_watchdog")
+        return resilience.StepWatchdog(
+            fc.watchdog_deadline_s, snap_dir,
+            escalate_dir=run_dir or snap_dir,
+            poll_s=fc.watchdog_poll_s, rank=comm.get_rank())
+
     def _maybe_monitor_flops(self, fn, *args, per_step_mult=1.0):
         """Resolve flops-per-step ONCE via the flops profiler's cost
         analysis (AOT lowering against the jit cache); the monitor then
@@ -642,6 +673,9 @@ class DeepSpeedEngine:
         self._drain_step_log(force=True)
         self.close_data_pipeline()
         ckpt_io.flush_pending()
+        if getattr(self, "_watchdog", None) is not None:
+            self._watchdog.stop()
+            self._watchdog = None
         if self.run_monitor is not None:
             self.run_monitor.close()
         if self.monitor is not None:
@@ -1510,6 +1544,13 @@ class DeepSpeedEngine:
         """Weight update at accumulation boundaries (reference :1201)."""
         if self.micro_steps == 0 or not self.is_gradient_accumulation_boundary():
             return
+        # chaos runtime: every optimizer-step boundary (all four step
+        # paths funnel through here) advances the fault plan's step
+        # schedule, fires the `engine.step` injection site, and beats
+        # the hang watchdog
+        resilience.step_boundary(self.global_steps)
+        if self._watchdog is not None:
+            self._watchdog.beat(self.global_steps)
         if self._offload is not None:
             return self._offload_step()
         if getattr(self, "_pending_full", None) is not None:

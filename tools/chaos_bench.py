@@ -1,0 +1,444 @@
+#!/usr/bin/env python
+"""Chaos bench: scripted fault campaigns against the training runtime.
+
+The point of the chaos runtime (runtime/resilience.py) is a provable
+claim: a run that absorbs injected faults finishes with the SAME losses
+as the fault-free run, with zero supervisor restarts — transient
+KV/storage/worker failures are absorbed by retry/respawn instead of
+being promoted to process death.  This tool runs that claim as a bench
+and records the fault/retry/recovery accounting as durable artifacts
+(the PR-2 rule).
+
+Campaigns:
+
+* **CPU dry-run** (default; also wired into tier-1 via
+  tests/test_resilience.py, like grad_wire_bench/ckpt_bench): two lanes
+  on the virtual mesh —
+    baseline   fault-free training + checkpointing
+    chaos      identical training with a FaultPlan injecting a
+               transient checkpoint-write raise, a prefetch-worker
+               death, and a step delay
+  asserts byte-identical loss sequences, a committed final checkpoint,
+  and PINS the fault counters (fault.injected / fault.retried /
+  input.worker_respawns) exactly.  A third mini-lane injects a `hang`
+  at the step boundary under an armed StepWatchdog and asserts the
+  trip: diagnostic snapshot + `watchdog_trip.json` escalation that the
+  supervisor's HeartbeatWatcher picks up as a restart trigger.
+
+* **--nproc 2** (TCP): the same two lanes across 2 jax.distributed
+  processes, where the KV faults hit the REAL coordination-service
+  transport: transient raises on the commit-barrier done-key post and
+  the heartbeat-wire KV gets, plus the checkpoint-write raise and the
+  worker death.  Loss parity is asserted on every rank; the recorded
+  artifact carries per-rank fault/retry counters.
+
+Usage: python tools/chaos_bench.py [--nproc 2] [--steps 6]
+           [--no-record]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+DIM = 64
+BATCH = 32
+
+
+class _SyntheticRegression:
+    """Deterministic indexable dataset (the index protocol is what lets
+    PrefetchLoader parallelize collate — and what the worker-death
+    respawn path needs to replay the exact failed batch)."""
+
+    def __init__(self, n, dim=DIM, out=4, seed=0):
+        import numpy as np
+
+        rng = np.random.RandomState(seed)
+        self.x = rng.randn(n, dim).astype(np.float32)
+        w = rng.randn(dim, out).astype(np.float32)
+        self.y = (self.x @ w).astype(np.float32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return (self.x[i], self.y[i])
+
+
+def _mlp(dim=DIM, out=4):
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.runtime.module import TrainModule
+
+    class MLP(TrainModule):
+        def init(self, rng):
+            k1, k2 = jax.random.split(rng)
+            return {"w1": jax.random.normal(k1, (dim, dim)) * 0.1,
+                    "b1": jnp.zeros((dim,)),
+                    "w2": jax.random.normal(k2, (dim, out)) * 0.1,
+                    "b2": jnp.zeros((out,))}
+
+        def loss(self, params, batch, rng=None, train=True, **kw):
+            x, y = batch
+            h = jnp.tanh(x @ params["w1"] + params["b1"])
+            pred = h @ params["w2"] + params["b2"]
+            return jnp.mean((pred - y.astype(pred.dtype)) ** 2)
+
+    return MLP()
+
+
+def run_lane(steps, ckpt_dir, faults=None, monitor_path=None,
+             job_name="chaos", save_every=2, num_workers=2, batch=BATCH,
+             watchdog=None):
+    """One campaign lane: train `steps` global batches off the engine-
+    owned prefetched loader, checkpointing every `save_every` steps.
+    Returns (losses, counter_deltas, engine_done_marker)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    cfg = {
+        "train_batch_size": batch,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "data_pipeline": {"num_workers": num_workers},
+    }
+    faults_cfg = {}
+    if faults:
+        faults_cfg["rules"] = faults
+    if watchdog:
+        faults_cfg["watchdog"] = watchdog
+    if faults_cfg:
+        cfg["faults"] = faults_cfg
+    if monitor_path is not None:
+        cfg["monitor"] = {"enabled": True, "output_path": monitor_path,
+                          "job_name": job_name, "flush_interval": 1,
+                          "flops": False, "heartbeat_interval": 1}
+    dataset = _SyntheticRegression(steps * batch)
+    engine, *_ = ds.initialize(model=_mlp(), config_params=cfg,
+                               training_data=dataset,
+                               dist_init_required=False)
+    snap = COUNTERS.snapshot()
+    losses = []
+    for i in range(steps):
+        losses.append(float(engine.train_batch()))
+        if save_every and (i + 1) % save_every == 0:
+            engine.save_checkpoint(ckpt_dir, tag=f"step{i + 1}")
+    ckpt_io.flush_pending()
+    delta = COUNTERS.delta_since(snap)
+    engine.finalize_monitoring()
+    committed = ckpt_io.read_latest_tag(ckpt_dir) if save_every else None
+    return losses, delta, committed
+
+
+# the dry-run chaos schedule: three distinct fault kinds, all absorbed
+# (a raise retried, a worker death respawned, a delay ridden out) —
+# tests pin the resulting counters EXACTLY against this list
+DRY_CHAOS_RULES = [
+    # first checkpoint file write dies once with a transient error;
+    # retry_transient absorbs it (storage-hiccup model)
+    {"site": "ckpt.atomic_write", "kind": "raise", "calls": [0],
+     "times": 1},
+    # a prefetch worker dies mid-epoch; the consumer respawns it at the
+    # exact failed batch (dead-data-worker model)
+    {"site": "dataloader.worker", "kind": "raise", "calls": [1],
+     "times": 1},
+    # one slow step (GC pause / snapshot stall model)
+    {"site": "engine.step", "kind": "delay_ms", "delay_ms": 5,
+     "steps": [1], "times": 1},
+]
+
+
+def run_dry(artifact_root=None, steps=4, record=True, root=None):
+    """Tier-1 CPU campaign (in-process; the grad_wire/ckpt_bench
+    dry-run pattern): baseline vs chaos lanes must produce IDENTICAL
+    losses with the chaos lane's fault counters pinned, plus the
+    watchdog hang lane.  Returns the recorded result dict."""
+    from deepspeed_tpu.elasticity.supervisor import HeartbeatWatcher
+    from deepspeed_tpu.monitor.counters import COUNTERS
+
+    made_root = root is None
+    root = root or tempfile.mkdtemp(prefix="chaos_bench_")
+    try:
+        base_losses, base_delta, base_tag = run_lane(
+            steps, os.path.join(root, "ck_base"))
+        chaos_losses, chaos_delta, chaos_tag = run_lane(
+            steps, os.path.join(root, "ck_chaos"),
+            faults=DRY_CHAOS_RULES)
+
+        assert base_losses == chaos_losses, (
+            f"chaos lane diverged: {base_losses} vs {chaos_losses} — "
+            f"an injected fault leaked into training instead of being "
+            f"absorbed")
+        assert base_tag == chaos_tag == f"step{steps - steps % 2}", \
+            (base_tag, chaos_tag)
+        injected = chaos_delta.get("fault.injected", {}).get("calls", 0)
+        retried = chaos_delta.get("fault.retried", {}).get("calls", 0)
+        respawns = chaos_delta.get("input.worker_respawns",
+                                   {}).get("calls", 0)
+        recovered = chaos_delta.get("fault.recovered_ms", {})
+        assert injected == len(DRY_CHAOS_RULES), chaos_delta
+        assert retried == 1 and respawns == 1, chaos_delta
+        assert recovered.get("calls", 0) == 1, chaos_delta
+        assert not base_delta.get("fault.injected"), base_delta
+
+        # watchdog lane: a hang at the step boundary must trip the
+        # watchdog, dump the snapshot, and leave the supervisor
+        # escalation file where HeartbeatWatcher finds it
+        run_root = os.path.join(root, "runs")
+        run_dir = os.path.join(run_root, "wd")
+        watcher = HeartbeatWatcher(run_dir, stall_timeout=0.0)
+        wd_snap = COUNTERS.snapshot()
+        # deadline sizing: it must exceed the worst-case LEGITIMATE
+        # inter-beat gap (first-step compile + a synchronous save's
+        # fsync can reach ~1s on a loaded 1-core box) while the hang
+        # clears it with margin — a spurious trip here would be the
+        # bench failing its own product
+        wd_losses, wd_delta, _ = run_lane(
+            steps, os.path.join(root, "ck_wd"),
+            faults=[{"site": "engine.step", "kind": "hang",
+                     "hang_s": 4.0, "steps": [2]}],
+            monitor_path=run_root, job_name="wd",
+            watchdog={"enabled": True, "deadline_s": 1.8, "poll_s": 0.05})
+        trips = COUNTERS.delta_since(wd_snap).get("watchdog.trips",
+                                                  {}).get("calls", 0)
+        assert trips == 1, f"hang did not trip the watchdog ({wd_delta})"
+        assert wd_losses == base_losses, "the hang changed training"
+        trip_path = os.path.join(run_dir, "watchdog_trip.json")
+        assert os.path.isfile(trip_path), "no escalation file"
+        with open(trip_path) as f:
+            trip = json.load(f)
+        assert trip["snapshot"] and os.path.isfile(trip["snapshot"]), trip
+        with open(trip["snapshot"]) as f:
+            snapshot = json.load(f)
+        assert snapshot["stacks"] and snapshot["counters"], \
+            "snapshot missing stacks/counters"
+        trigger = watcher.check()
+        assert trigger is not None and "watchdog trip" in \
+            trigger["reason"], trigger
+        assert trigger["diagnostics"] == trip["snapshot"], trigger
+
+        result = {
+            "metric": "chaos_cpu_dryrun",
+            "platform": "cpu",
+            "steps": steps,
+            "faults_injected": injected,
+            "transient_retries": retried,
+            "worker_respawns": respawns,
+            "recovered_ms": round(recovered.get("bytes", 0) / 1000.0, 3),
+            "watchdog_trips": trips,
+            "loss_parity": "exact",
+            "supervisor_restarts": 0,
+            "value": injected + trips,
+            "unit": "faults_absorbed_or_escalated",
+            "losses": [round(x, 6) for x in base_losses],
+        }
+        if record:
+            from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+            result["artifact"] = record_bench_result(
+                result, root=artifact_root, name=result["metric"])
+        return result
+    finally:
+        # never leak the campaign's fault plan into the caller's process
+        from deepspeed_tpu.runtime import resilience
+
+        resilience.install_fault_plan(None)
+        resilience.install_retry_policy(None)
+        if made_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# 2-process TCP campaign: KV faults hit the real coordination service
+# ---------------------------------------------------------------------------
+
+# rank-scoped so the two ranks inject DIFFERENT faults (the asymmetric
+# case is the hard one: the other rank must ride out its peer's retry
+# window inside the ordinary KV timeouts)
+def tcp_chaos_rules():
+    return [
+        # transient KV raise on the commit barrier's done-key post
+        {"site": "kv.post", "kind": "raise", "calls": [0], "times": 1,
+         "rank": 0},
+        # transient KV raise inside the heartbeat wire's part-key get
+        {"site": "hostwire.kv_get", "kind": "raise", "calls": [1],
+         "times": 1, "rank": 1},
+        # checkpoint-write raise on the writing rank (at stage 0 with
+        # replicated params only process 0 lands files)
+        {"site": "ckpt.atomic_write", "kind": "raise", "calls": [0],
+         "times": 1, "rank": 0},
+        # prefetch worker death on rank 1
+        {"site": "dataloader.worker", "kind": "raise", "calls": [1],
+         "times": 1, "rank": 1},
+    ]
+
+
+def _worker(args):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coord,
+                               num_processes=args.nproc,
+                               process_id=args.proc_id)
+    import deepspeed_tpu  # noqa: F401  (gloo-collectives flag first)
+    from deepspeed_tpu.monitor.counters import COUNTERS  # noqa: F401
+
+    root = args.scratch
+    base_losses, base_delta, base_tag = run_lane(
+        args.steps, os.path.join(root, "ck_base"),
+        monitor_path=os.path.join(root, "runs"), job_name="base",
+        num_workers=2)
+    chaos_losses, chaos_delta, chaos_tag = run_lane(
+        args.steps, os.path.join(root, "ck_chaos"),
+        faults=tcp_chaos_rules(),
+        monitor_path=os.path.join(root, "runs"), job_name="chaos",
+        num_workers=2)
+
+    assert base_losses == chaos_losses, (
+        f"rank {args.proc_id}: chaos lane diverged "
+        f"({base_losses} vs {chaos_losses})")
+    assert base_tag == chaos_tag and chaos_tag is not None, \
+        (base_tag, chaos_tag)
+    assert not base_delta.get("fault.injected"), base_delta
+    print("CHAOS_RANK " + json.dumps({
+        "rank": args.proc_id,
+        "losses": [round(x, 6) for x in chaos_losses],
+        "final_tag": chaos_tag,
+        "faults_injected": chaos_delta.get("fault.injected",
+                                           {}).get("calls", 0),
+        "transient_retries": chaos_delta.get("fault.retried",
+                                             {}).get("calls", 0),
+        "worker_respawns": chaos_delta.get("input.worker_respawns",
+                                           {}).get("calls", 0),
+        "recovered_ms": round(chaos_delta.get("fault.recovered_ms",
+                                              {}).get("bytes", 0)
+                              / 1000.0, 3),
+    }), flush=True)
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_tcp(nproc=2, steps=6, record=True, scratch=None, timeout=900):
+    """Launch the N-process campaign; parent collects per-rank results,
+    asserts the invariants, and records the artifact."""
+    made = scratch is None
+    scratch = scratch or tempfile.mkdtemp(prefix="chaos_tcp_")
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--proc-id", str(i), "--nproc", str(nproc),
+             "--coord", coord, "--steps", str(steps),
+             "--scratch", scratch],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out[-4000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if made:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+    ranks = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("CHAOS_RANK "):
+                ranks.append(json.loads(line[len("CHAOS_RANK "):]))
+    assert len(ranks) == nproc, outs
+    ranks.sort(key=lambda r: r["rank"])
+    # every rank saw the identical (global-mean) loss stream and agreed
+    # on the final committed tag
+    assert all(r["losses"] == ranks[0]["losses"] for r in ranks), ranks
+    assert all(r["final_tag"] == ranks[0]["final_tag"] for r in ranks)
+    total_injected = sum(r["faults_injected"] for r in ranks)
+    # every rule is rank-scoped and times=1: the campaign injects
+    # EXACTLY one fault per rule
+    expected = len(tcp_chaos_rules())
+    assert total_injected == expected, (total_injected, expected, ranks)
+    assert sum(r["transient_retries"] for r in ranks) >= 3, ranks
+    assert sum(r["worker_respawns"] for r in ranks) == 1, ranks
+
+    result = {
+        "metric": f"chaos_{nproc}proc_tcp",
+        "platform": "cpu",
+        "world": {"processes": nproc},
+        "steps": steps,
+        "fault_kinds": ["kv.post raise", "hostwire.kv_get raise",
+                        "ckpt.atomic_write raise",
+                        "dataloader.worker death"],
+        "faults_injected": total_injected,
+        "transient_retries": sum(r["transient_retries"] for r in ranks),
+        "worker_respawns": sum(r["worker_respawns"] for r in ranks),
+        "recovered_ms": round(sum(r["recovered_ms"] for r in ranks), 3),
+        "loss_parity": "exact",
+        "supervisor_restarts": 0,
+        "value": total_injected,
+        "unit": "faults_absorbed",
+        "ranks": ranks,
+    }
+    if record:
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        result["artifact"] = record_bench_result(result,
+                                                 name=result["metric"])
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--no-record", action="store_true")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
+    ap.add_argument("--coord", default="")
+    ap.add_argument("--scratch", default="")
+    args = ap.parse_args()
+    if args.worker:
+        _worker(args)
+        return 0
+    if args.nproc <= 1:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        result = run_dry(steps=max(4, args.steps),
+                         record=not args.no_record)
+    else:
+        result = run_tcp(nproc=args.nproc, steps=args.steps,
+                         record=not args.no_record)
+    print(json.dumps(result, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,6 +50,13 @@ def selftest() -> int:
             COUNTERS.add("input.host_wait_ms", 1500, calls=1)
             COUNTERS.add("input.h2d_bytes", 4096, calls=2)
             COUNTERS.add("input.queue_depth", 2, calls=1)
+            # resilience: injected faults absorbed by retry/respawn +
+            # a watchdog trip — rendered as the "Resilience" section
+            COUNTERS.add("fault.injected", calls=1)
+            COUNTERS.add("fault.retried", calls=2)
+            COUNTERS.add("fault.recovered_ms", 2500, calls=1)
+            COUNTERS.add("watchdog.trips", calls=1)
+            COUNTERS.add("input.worker_respawns", calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -58,6 +65,19 @@ def selftest() -> int:
                              {"stage": 0, "ticks": 9, "compute_ticks": 8,
                               "bubble_frac": 0.1111}]})
         mon.close()
+        # a supervisor restart ledger beside the event streams
+        # (elasticity/supervisor.py) renders as the "Restarts" section
+        import json as _json
+
+        with open(os.path.join(root, "selftest", "restarts.jsonl"),
+                  "w") as f:
+            f.write(_json.dumps({
+                "t": 0.0, "event": "restart", "attempt": 1,
+                "ran_for_s": 12.5, "exit_code": -15,
+                "reason": "watchdog trip on rank 0: step deadline",
+                "dead_ranks": [], "backoff_s": 5.0,
+                "diagnostics": "watchdog_snapshot.rank00000.1.json",
+            }) + "\n")
         run = load_run(os.path.join(root, "selftest"))
         bad = [err for events in run["ranks"].values()
                for e in events for err in validate_event(e)]
@@ -71,10 +91,17 @@ def selftest() -> int:
                        "11.1%", "forward", "Gradient wire levels",
                        "inter-group", "slow-fabric share",
                        "Input pipeline", "host wait", "H2D batch transfer",
-                       "mean prefetch queue depth"):
+                       "mean prefetch queue depth",
+                       "Resilience", "faults injected", "transient retries",
+                       "watchdog trips", "prefetch workers respawned",
+                       "Restarts (supervisor ledger)", "watchdog trip on "
+                       "rank 0"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
+        assert "`fault.injected`" not in md and \
+            "`watchdog.trips`" not in md, \
+            "fault.*/watchdog.* rows must not leak into the comm table"
     print("run_report selftest ok")
     return 0
 
