@@ -197,9 +197,14 @@ def render_markdown(run: Dict[str, Any]) -> str:
                       if k.startswith("input.")}
     ckpt_counters = {k: v for k, v in any_comm.items()
                      if k.startswith("ckpt.")}
+    # grad_wire.exposed_ms / qwz.prefetch_hits carry µs (the
+    # ckpt.stall_ms convention), not wire bytes — they render in the
+    # gradient-wire section below, not the comm byte table
+    _WIRE_TIME_COUNTERS = ("grad_wire.exposed_ms", "qwz.prefetch_hits")
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
-                                          "watchdog."))}
+                                          "watchdog."))
+                     and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
         lines.append("")
@@ -333,8 +338,15 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # section so the slow-fabric saving is legible without arithmetic
     intra = any_comm.get("grad_wire.intra")
     inter = any_comm.get("grad_wire.inter")
-    if intra or inter:
+    exposed = any_comm.get("grad_wire.exposed_ms")
+    hits = any_comm.get("qwz.prefetch_hits")
+    if (intra or inter) and not (exposed or hits):
         lines.append("## Gradient wire levels (hierarchical reduction)")
+    elif intra or inter or exposed or hits:
+        lines.append("## Gradient wire levels")
+        if not (intra or inter):
+            lines.append("")
+    if intra or inter:
         lines.append("")
         lines.append("| level | fabric | collectives | wire bytes | "
                      "logical payload |")
@@ -360,6 +372,23 @@ def render_markdown(run: Dict[str, Any]) -> str:
             lines.append("")
             lines.append(f"slow-fabric share of grad-wire traffic: "
                          f"{100.0 * inter['bytes'] / (intra['bytes'] + inter['bytes']):.1f}%")
+        lines.append("")
+
+    if exposed:
+        # µs stored in the bytes slot (the ckpt.stall_ms convention):
+        # host time blocked on the overlapped wire AFTER the backward —
+        # the non-hidden remainder comm.overlap exists to shrink
+        total_ms = exposed["bytes"] / 1000.0
+        per = total_ms / exposed["calls"] if exposed["calls"] else 0.0
+        lines.append(f"exposed (non-overlapped) wire time: "
+                     f"{total_ms:,.1f} ms over {exposed['calls']:,} "
+                     f"step drain(s) ({per:.2f} ms/step)")
+        lines.append("")
+    if hits:
+        head_ms = hits["bytes"] / 1000.0
+        lines.append(f"qwZ prefetch hits: {hits['calls']:,} gather(s) "
+                     f"ready before the forward asked "
+                     f"({head_ms:,.1f} ms total head start)")
         lines.append("")
 
     qwz = any_comm.get("qwz.gather")

@@ -450,6 +450,206 @@ class BucketPlan:
             red = lax.psum(wired, axis)
         return (red / dp).astype(flat.dtype)
 
+    # -- overlap lowering (runtime/comm/overlap.py host exchange) -----
+    #
+    # The overlapped wire splits each bucket's reduction in two at the
+    # point where bytes would cross the slow fabric: `overlap_encode`
+    # runs in the GRADS program (after the hierarchical plan's
+    # intra-group psum_scatter — the fast-fabric leg stays an XLA
+    # collective) and emits this rank's wire payload as one flat uint8
+    # buffer; the host exchange moves every rank's buffer while the
+    # device runs the next micro-step's program; `overlap_combine` runs
+    # in the COMBINE program over the gathered [world, nbytes] matrix
+    # and reduces with EXPRESSIONS BIT-IDENTICAL to the serial path's:
+    # an explicit rank-ordered linear fold where the serial wire rides
+    # psum/psum_scatter (XLA:CPU lowers both to exactly that ordered
+    # sum — pinned by tests), and the gather wires' own jnp.sum
+    # accumulation where the serial wire is gather-structured.  Losses
+    # and params under overlap are bitwise those of the serial wire.
+
+    def _encode_elems(self, spec: BucketSpec) -> int:
+        """Elements one rank contributes to the exchange for `spec`:
+        the full padded bucket on a flat plan, the 1/inner-size shard
+        after the intra-group scatter on a hierarchical one."""
+        if self.levels is not None:
+            return spec.padded // self.levels[0].size
+        return spec.padded
+
+    def _overlap_wire(self) -> str:
+        """The wire mode whose payload crosses the host exchange: the
+        outer level's on hierarchical plans, the single wire flat."""
+        return self.levels[1].wire if self.levels is not None else self.wire
+
+    @property
+    def overlap_layout(self):
+        """[(offset, nbytes, elems)] of each bucket inside the fused
+        per-rank exchange buffer + the buffer's total size."""
+        wire = self._overlap_wire()
+        layout, off = [], 0
+        for b in self.buckets:
+            elems = self._encode_elems(b)
+            nb = wire_nbytes(elems, wire, self.quant_block)
+            layout.append((off, nb, elems))
+            off += nb
+        return layout, off
+
+    def _encode_one(self, x, wire: str):
+        """fp32 values -> this rank's uint8 wire bytes for one bucket
+        (sized exactly `wire_nbytes(x.size, wire, quant_block)`)."""
+        if wire == "fp32":
+            return lax.bitcast_convert_type(
+                x.astype(jnp.float32), jnp.uint8).reshape(-1)
+        if wire == "bf16":
+            return lax.bitcast_convert_type(
+                x.astype(jnp.bfloat16), jnp.uint8).reshape(-1)
+        if wire == "split":
+            from .compressed_ar import decompose_int8_safe
+
+            m, e = decompose_int8_safe(x)
+            return jnp.concatenate([
+                lax.bitcast_convert_type(m, jnp.uint8).reshape(-1),
+                lax.bitcast_convert_type(e.astype(jnp.int8),
+                                         jnp.uint8).reshape(-1)])
+        from .quant import pack_wire, quantize_blockwise
+
+        payload, scales = quantize_blockwise(x, self.quant_block, wire)
+        return pack_wire(payload, scales)
+
+    def overlap_encode(self, buckets) -> jnp.ndarray:
+        """Flat local-grad buckets -> ONE fused uint8 exchange buffer
+        for this rank.  Must run inside the grads program's shard_map
+        region: hierarchical plans run the intra-group psum_scatter
+        here (the fast-fabric leg — identical op to the serial path's),
+        so only the 1/inner shard rides the host exchange."""
+        wire = self._overlap_wire()
+        parts = []
+        for flat, spec in zip(buckets, self.buckets):
+            x = flat
+            if self.levels is not None:
+                inner = self.levels[0]
+                isz_in = _WIRE_ITEMSIZE[inner.wire]
+                wired = flat.astype(jnp.bfloat16 if inner.wire == "bf16"
+                                    else jnp.float32)
+                _record("intra.psum_scatter", spec.padded * isz_in)
+                x = lax.psum_scatter(wired, inner.axis,
+                                     scatter_dimension=0,
+                                     tiled=True).astype(jnp.float32)
+            parts.append(self._encode_one(x, wire))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def overlap_encode_out_spec(self):
+        """Out spec stacking each rank's exchange buffer rank-major:
+        (outer, inner) on hierarchical meshes, the data axis flat."""
+        if self.levels is not None:
+            return P((self.levels[1].axis, self.levels[0].axis))
+        return P(self.axis)
+
+    @staticmethod
+    def _decode_rows(rows, wire: str, elems: int, block: int):
+        """[world, nbytes] uint8 -> per-rank fp32/narrow values, shaped
+        [world, elems] (bf16 rows stay bf16 so the fold accumulates at
+        the same width the serial psum did)."""
+        if wire == "fp32":
+            return lax.bitcast_convert_type(
+                rows.reshape(rows.shape[0], elems, 4), jnp.float32)
+        if wire == "bf16":
+            return lax.bitcast_convert_type(
+                rows.reshape(rows.shape[0], elems, 2), jnp.bfloat16)
+        raise ValueError(wire)  # split/quant decode inline in combine
+
+    @staticmethod
+    def _fold(vals):
+        """Rank-ordered linear sum over the leading world dim — the
+        association XLA:CPU's psum/psum_scatter lowers to (pinned by
+        tests/test_step_overlap.py), NOT jnp.sum's pairwise tree."""
+        acc = vals[0]
+        for r in range(1, vals.shape[0]):
+            acc = acc + vals[r]
+        return acc
+
+    def _combine_one(self, rows, spec: BucketSpec, dtype):
+        """One bucket's gathered [world, nbytes] rows -> the reduced
+        bucket (or this rank's shard under a scattered lowering),
+        mirroring `_reduce_one` / `_reduce_one_hier` expression for
+        expression."""
+        elems = self._encode_elems(spec)
+        wire = self._overlap_wire()
+        blk = self.quant_block
+
+        if self.levels is not None:
+            inner, outer = self.levels
+            # this rank consumes its outer peers' shards at its own
+            # inner index (rank-major rows: rank = o * inner + i)
+            i = lax.axis_index(inner.axis)
+            rows = jnp.take(rows, jnp.arange(outer.size) * inner.size + i,
+                            axis=0)
+
+        if wire == "split":
+            m = lax.bitcast_convert_type(
+                rows[:, :elems * 2].reshape(rows.shape[0], elems, 2),
+                jnp.float16)
+            e = lax.bitcast_convert_type(
+                rows[:, elems * 2:].reshape(rows.shape[0], elems, 1),
+                jnp.int8).reshape(rows.shape[0], elems)
+            total = jnp.sum(jnp.ldexp(m.astype(jnp.float32),
+                                      e.astype(jnp.int32)), axis=0)
+        elif wire in QUANT_WIRES:
+            from .quant import unpack_wire, dequantize_blockwise
+
+            p, s = unpack_wire(rows, wire, blk, elems)
+            total = jnp.sum(dequantize_blockwise(p, s, wire, elems),
+                            axis=0)
+        else:
+            vals = self._decode_rows(rows, wire, elems, blk)
+            if wire == "bf16":
+                # XLA's bf16 psum/psum_scatter accumulate at f32 width
+                # and round the RESULT to bf16 (pinned by
+                # tests/test_step_overlap.py) — mirror exactly
+                vals = vals.astype(jnp.float32)
+            if self.scatter and self.levels is None:
+                chunk = spec.padded // self.dp_size
+                r = lax.axis_index(self.axis)
+                vals = lax.dynamic_slice_in_dim(vals, r * chunk, chunk,
+                                                axis=1)
+            total = self._fold(vals)
+            if wire == "bf16":
+                total = total.astype(jnp.bfloat16)
+            if self.levels is None:
+                # flat psum parity: bf16 casts the (rounded) result up
+                # then divides (serial: psum(bf16).astype(f32)/dp);
+                # fp32 divides first then casts
+                if wire == "bf16":
+                    return total.astype(dtype) / self.dp_size
+                return (total.astype(jnp.float32) / self.dp_size
+                        ).astype(dtype)
+
+        if self.levels is None:
+            return (total / self.dp_size).astype(dtype)
+
+        # hierarchical tail: mirror _reduce_one_hier after the outer hop
+        inner, outer = self.levels
+        shard = total.astype(jnp.float32) / self.dp_size
+        if self.scatter:
+            return shard.astype(dtype)
+        gathered = shard.astype(jnp.bfloat16) if inner.wire == "bf16" \
+            else shard
+        isz_in = _WIRE_ITEMSIZE[inner.wire]
+        _record("intra.all_gather", spec.padded * isz_in)
+        out = lax.all_gather(gathered, inner.axis, axis=0, tiled=True)
+        return out.astype(dtype)
+
+    def overlap_combine(self, matrix) -> List[jnp.ndarray]:
+        """Gathered [world, total_nbytes] exchange matrix -> reduced
+        buckets.  Must run inside the combine program's shard_map
+        region (same axis names as the grads program)."""
+        layout, _total = self.overlap_layout
+        out = []
+        for (off, nb, _elems), spec in zip(layout, self.buckets):
+            rows = lax.slice(matrix, (0, off),
+                             (matrix.shape[0], off + nb))
+            out.append(self._combine_one(rows, spec, jnp.float32))
+        return out
+
     # -- shard_map plumbing -------------------------------------------
 
     def bucket_out_specs(self):

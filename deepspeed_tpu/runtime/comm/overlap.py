@@ -1,0 +1,389 @@
+"""Host-threaded wire exchange: comm/compute overlap for the bucketed
+gradient wire and the qwZ parameter gather.
+
+Why a HOST transport and not an XLA restructure: on the XLA:CPU runtime
+this repo benches on, collective thunks execute inline in the per-device
+thunk sequence — probed exhaustively while building this module: a
+collective issued before / interleaved with / data-independent of the
+remaining compute runs in exactly the same wall-clock as one issued
+after it (fused == barrier-serialized, to the millisecond), and the
+gloo wire's time is ~78% CPU-busy (process_time/wall), so even
+thread-level concurrency cannot hide it on a saturated box.  What CAN
+overlap is a transport whose waits are real OS blocking: raw sockets
+move the same payload ~10x cheaper than the in-program collective and
+spend most of that in `recv` — idle time the device pipeline runs
+straight through.  On TPU fabrics the same schedule-driven structure
+lets XLA's latency-hiding scheduler do the overlap in-program; on this
+fabric the host exchange IS the overlap mechanism, and the bench
+measures the exposure honestly either way (BENCH.md overlap round).
+
+The pieces:
+
+* `ExchangeTicket` — one in-flight exchange: `wait()` returns the
+  rank-ordered `[world, nbytes]` payload matrix and records how long the
+  caller was blocked (the EXPOSED wire time the monitor's
+  `grad_wire.exposed_ms` counter reports).
+* `LocalExchange` — single-process transport: every rank is addressable,
+  so the "exchange" is a background-thread materialization of the local
+  shards.  The threaded driver machinery (submit/wait ordering, ticket
+  lifecycle, teardown) is exactly the multi-process one, so tier-1
+  covers it without sockets.
+* `SocketExchange` — N-process transport: a full mesh of persistent TCP
+  connections (rendezvoused through the coordination-service KV the
+  hostwire already rides), one receiver thread per peer demuxing
+  sequence-tagged frames, one sender worker serializing submissions in
+  order.  Frames are self-describing (per-rank payload table), so the
+  receiver needs no topology assumptions.
+
+Exchanges are identified by a monotonically increasing sequence number.
+Every process submits the same exchanges in the same order (the engine
+step flow is deterministic across ranks), so a frame's sequence number
+alone pairs it with its ticket.
+"""
+
+from __future__ import annotations
+
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils.logging import logger
+
+# frame: [seq u64][n_entries u32] then per entry [rank u32][nbytes u64],
+# then the concatenated payloads in entry order
+_HDR = struct.Struct("<QI")
+_ENT = struct.Struct("<QI")  # (nbytes, rank) — fixed width, order below
+
+_CONNECT_TIMEOUT_S = 60.0
+_ACCEPT_TIMEOUT_S = 60.0
+
+
+def _now() -> float:
+    return time.perf_counter()
+
+
+class ExchangeTicket:
+    """One in-flight exchange.  `wait()` blocks until every expected
+    rank's payload has landed and returns the `[world, nbytes]` uint8
+    matrix (rank-major).  Timing:
+
+    * `done_at`   when the last payload landed (transport-side stamp)
+    * `wait_us`   how long wait() was actually blocked — the caller's
+                  EXPOSED wire time (0 when the exchange finished
+                  behind compute)
+    """
+
+    def __init__(self, seq: int, world: int):
+        self.seq = seq
+        self.world = world
+        self._cond = threading.Condition()
+        self._blocks: Dict[int, np.ndarray] = {}
+        self._error: Optional[BaseException] = None
+        self.created_at = _now()
+        self.done_at: Optional[float] = None
+        self.wait_us = 0
+
+    # -- transport side -----------------------------------------------
+
+    def post(self, rank: int, block: np.ndarray) -> None:
+        with self._cond:
+            self._blocks[int(rank)] = block
+            if len(self._blocks) >= self.world:
+                self.done_at = _now()
+            self._cond.notify_all()
+
+    def fail(self, exc: BaseException) -> None:
+        with self._cond:
+            if self._error is None:
+                self._error = exc
+            self._cond.notify_all()
+
+    # -- consumer side ------------------------------------------------
+
+    @property
+    def ready(self) -> bool:
+        with self._cond:
+            return self._error is not None or \
+                len(self._blocks) >= self.world
+
+    def wait(self, timeout_s: float = 300.0) -> np.ndarray:
+        t0 = _now()
+        with self._cond:
+            deadline = t0 + timeout_s
+            while self._error is None and len(self._blocks) < self.world:
+                remaining = deadline - _now()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"overlap exchange seq={self.seq}: only "
+                        f"{sorted(self._blocks)} of {self.world} rank "
+                        f"payloads arrived within {timeout_s:.0f}s")
+                self._cond.wait(remaining)
+            self.wait_us += int((_now() - t0) * 1e6)
+            if self._error is not None:
+                raise RuntimeError(
+                    f"overlap exchange seq={self.seq} failed"
+                ) from self._error
+            blocks = [self._blocks[r] for r in range(self.world)]
+        return np.stack(blocks)
+
+
+class _ExchangeBase:
+    """Shared submit-worker machinery: one persistent worker thread
+    materializes each submission's device shards (np.asarray blocks the
+    WORKER on the producing program, never the driver) and hands the
+    blocks to the transport in submission order."""
+
+    def __init__(self, world: int):
+        self.world = int(world)
+        self._seq = 0
+        self._tasks: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._worker_loop, name="dstpu-overlap-send",
+                daemon=True)
+            self._worker.start()
+
+    def _worker_loop(self):
+        while True:
+            task = self._tasks.get()
+            if task is None:
+                return
+            ticket, local_blocks = task
+            try:
+                blocks = [(rank, np.asarray(get()).view(np.uint8))
+                          for rank, get in local_blocks]
+                self._send(ticket, blocks)
+                for rank, block in blocks:
+                    ticket.post(rank, block)
+            except BaseException as e:  # surfaced at ticket.wait()
+                ticket.fail(e)
+
+    def _send(self, ticket: ExchangeTicket,
+              blocks: List[Tuple[int, np.ndarray]]) -> None:
+        raise NotImplementedError
+
+    def submit(self, local_blocks: List[Tuple[int, Callable[[], np.ndarray]]]
+               ) -> ExchangeTicket:
+        """Start one exchange.  `local_blocks` is [(global_rank, getter)]
+        for every rank this process owns; `getter()` returns the rank's
+        payload (a device array or shard — materialized on the worker
+        thread, so calling submit never blocks on the producing
+        program).  Returns the ticket to `wait()` on."""
+        if self._closed:
+            raise RuntimeError("exchange is closed")
+        with self._lock:
+            seq = self._seq
+            self._seq += 1
+            ticket = self._register(seq)
+        self._ensure_worker()
+        self._tasks.put((ticket, local_blocks))
+        return ticket
+
+    def _register(self, seq: int) -> ExchangeTicket:
+        return ExchangeTicket(seq, self.world)
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._worker is not None and self._worker.is_alive():
+            self._tasks.put(None)
+            self._worker.join(timeout=10)
+        self._worker = None
+
+
+class LocalExchange(_ExchangeBase):
+    """Single-process transport: every rank's payload is already
+    addressable — the worker thread materializes them and the ticket
+    completes.  No sockets, same driver surface."""
+
+    def _send(self, ticket, blocks):
+        missing = self.world - len(blocks)
+        if missing:
+            raise RuntimeError(
+                f"LocalExchange: {len(blocks)} local payloads for a "
+                f"world of {self.world} — a multi-process mesh needs "
+                "the socket transport")
+
+
+class SocketExchange(_ExchangeBase):
+    """N-process transport over a full mesh of persistent TCP
+    connections.  Rendezvous rides the coordination-service KV (each
+    process publishes `host:port`); processes with a lower pid accept,
+    higher pids connect, and a 4-byte hello identifies the dialing
+    process.  One receiver thread per peer demuxes frames by sequence
+    number into the matching ticket."""
+
+    def __init__(self, world: int, *, tag: str = "ox0",
+                 host: Optional[str] = None):
+        super().__init__(world)
+        from .hostwire import _client, _kv_get, _kv_set
+
+        import jax
+
+        self.pid = jax.process_index()
+        self.nproc = jax.process_count()
+        client, _, _ = _client()
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(("", 0))
+        self._listener.listen(self.nproc)
+        port = self._listener.getsockname()[1]
+        my_host = host or socket.gethostbyname(socket.gethostname())
+        _kv_set(client, f"dstpu/overlap/{tag}/addr{self.pid}",
+                f"{my_host}:{port}".encode())
+
+        self._peers: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._tickets: Dict[int, ExchangeTicket] = {}
+        self._tickets_lock = threading.Lock()
+        self._stash: Dict[int, List[Tuple[int, np.ndarray]]] = {}
+        self._receivers: List[threading.Thread] = []
+
+        # higher pids dial lower pids; the 4-byte hello names the dialer
+        for q in range(self.pid):
+            addr = _kv_get(client, f"dstpu/overlap/{tag}/addr{q}",
+                           int(_CONNECT_TIMEOUT_S * 1000)).decode()
+            h, p = addr.rsplit(":", 1)
+            s = socket.create_connection((h, int(p)),
+                                         timeout=_CONNECT_TIMEOUT_S)
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            s.sendall(struct.pack("<I", self.pid))
+            self._peers[q] = s
+        self._listener.settimeout(_ACCEPT_TIMEOUT_S)
+        for _ in range(self.pid + 1, self.nproc):
+            s, _ = self._listener.accept()
+            s.settimeout(None)
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            hello = _read_exact(s, 4)
+            q = struct.unpack("<I", hello)[0]
+            self._peers[q] = s
+        self._listener.close()
+
+        for q, s in self._peers.items():
+            self._send_locks[q] = threading.Lock()
+            t = threading.Thread(target=self._recv_loop, args=(q, s),
+                                 name=f"dstpu-overlap-recv{q}",
+                                 daemon=True)
+            t.start()
+            self._receivers.append(t)
+
+    # -- transport ----------------------------------------------------
+
+    def _register(self, seq: int) -> ExchangeTicket:
+        ticket = ExchangeTicket(seq, self.world)
+        with self._tickets_lock:
+            self._tickets[seq] = ticket
+            for rank, block in self._stash.pop(seq, []):
+                ticket.post(rank, block)
+        return ticket
+
+    def _send(self, ticket, blocks):
+        table = b"".join(_ENT.pack(b.nbytes, rank) for rank, b in blocks)
+        header = _HDR.pack(ticket.seq, len(blocks)) + table
+        payload = b"".join(b.tobytes() for _, b in blocks)
+        for q in self._peers:
+            with self._send_locks[q]:
+                self._peers[q].sendall(header + payload)
+
+    def _recv_loop(self, peer: int, s: socket.socket):
+        try:
+            while True:
+                hdr = _read_exact(s, _HDR.size)
+                if hdr is None:
+                    return
+                seq, n = _HDR.unpack(hdr)
+                entries = []
+                for _ in range(n):
+                    nbytes, rank = _ENT.unpack(_read_exact(s, _ENT.size))
+                    entries.append((rank, nbytes))
+                for rank, nbytes in entries:
+                    buf = np.frombuffer(_read_exact(s, nbytes),
+                                        dtype=np.uint8)
+                    self._route(seq, rank, buf)
+        except (OSError, ValueError, TypeError, struct.error):
+            if not self._closed:
+                logger.warning(
+                    f"overlap exchange: connection to process {peer} "
+                    "dropped; in-flight exchanges will fail")
+                with self._tickets_lock:
+                    tickets = list(self._tickets.values())
+                for t in tickets:
+                    t.fail(ConnectionError(f"peer {peer} dropped"))
+
+    def _route(self, seq: int, rank: int, block: np.ndarray):
+        with self._tickets_lock:
+            t = self._tickets.get(seq)
+            if t is None:
+                # frame arrived before submit() registered the ticket
+                self._stash.setdefault(seq, []).append((rank, block))
+                return
+        t.post(rank, block)
+
+    def retire(self, ticket: ExchangeTicket):
+        """Drop a completed ticket's registration (the engine retires
+        tickets after combining, bounding the map to in-flight ones)."""
+        with self._tickets_lock:
+            self._tickets.pop(ticket.seq, None)
+
+    def close(self):
+        was_closed = self._closed
+        super().close()
+        if was_closed:
+            return
+        for s in self._peers.values():
+            try:
+                s.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                s.close()
+            except OSError:
+                pass
+        for t in self._receivers:
+            t.join(timeout=5)
+        self._receivers = []
+
+
+def _read_exact(s: socket.socket, n: int) -> Optional[bytes]:
+    parts = []
+    got = 0
+    while got < n:
+        chunk = s.recv(min(1 << 20, n - got))
+        if not chunk:
+            if parts:  # EOF mid-frame: the peer died mid-send
+                raise ConnectionError("peer closed mid-frame")
+            return None  # clean EOF at a frame boundary (shutdown)
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts)
+
+
+_EXCHANGE_SEQ = [0]
+
+
+def make_exchange(world: int, tag: Optional[str] = None):
+    """The right transport for the current topology: sockets across
+    processes, the in-process fast path otherwise.  Each construction
+    gets a fresh rendezvous tag (the coordination KV is write-once and
+    engine construction order is identical on every process, so the
+    per-process counter agrees globally)."""
+    import jax
+
+    if jax.process_count() > 1:
+        if tag is None:
+            tag = f"ox{_EXCHANGE_SEQ[0]}"
+            _EXCHANGE_SEQ[0] += 1
+        return SocketExchange(world, tag=tag)
+    return LocalExchange(world)

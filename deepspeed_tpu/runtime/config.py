@@ -88,6 +88,28 @@ def check_hierarchy_divides(outer: int, dp_size: int) -> None:
             f"divisors of {dp_size}")
 
 
+def parse_comm_overlap(value):
+    """Normalize the `comm.overlap` knob to "none" | "auto" | "on".
+    Booleans are accepted (the reference `overlap_comm` style): true
+    means "on" (demand overlap; unservable configs fall back with a
+    warning), false means "none"."""
+    if value is None:
+        value = c.COMM_OVERLAP_DEFAULT
+    if isinstance(value, bool):
+        return "on" if value else "none"
+    if isinstance(value, str):
+        mode = value.lower()
+        if mode in ("none", "off", "false"):
+            return "none"
+        if mode == "auto":
+            return "auto"
+        if mode in ("on", "true"):
+            return "on"
+    raise ValueError(
+        f"comm.{c.COMM_OVERLAP} must be one of {c.COMM_OVERLAP_MODES} "
+        f"(or a bool), got {value!r}")
+
+
 class DeepSpeedCommConfig(DeepSpeedConfigObject):
     """Gradient-reduction wire selection (runtime/comm/bucketing.py).
 
@@ -190,6 +212,8 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
                     "run the intra-group scatter level; wire_dtype_inner "
                     "lowers to fp32")
             self.wire_dtype_inner = "fp32"
+        self.overlap = parse_comm_overlap(
+            get_scalar_param(d, c.COMM_OVERLAP, c.COMM_OVERLAP_DEFAULT))
         self.reduce_bucket_size = int(get_scalar_param(
             d, c.COMM_REDUCE_BUCKET_SIZE, zero_config.reduce_bucket_size))
         block = get_scalar_param(d, c.COMM_QUANT_BLOCK_SIZE,

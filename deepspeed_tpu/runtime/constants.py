@@ -109,6 +109,16 @@ COMM_WIRE_DTYPE_OUTER = "wire_dtype_outer"
 # qwZ parameter gather: elements per fp16 scale (positive even int).
 COMM_QUANT_BLOCK_SIZE = "quant_block_size"
 COMM_QUANT_BLOCK_SIZE_DEFAULT = 256
+# Comm/compute overlap (runtime/comm/overlap.py + step_builder.py):
+#   "none"  serial wire (default)
+#   "auto"  overlap where the engine can serve it (bucketed wire at
+#           stage<3, qwZ gather at stage 3), logged fallback otherwise
+#   true / "on"  demand overlap; unservable configs (onebit, Infinity,
+#           offload, pipe-parallel stages, no overlappable wire) fall
+#           back to the serial path with a WARNING — never silently
+COMM_OVERLAP = "overlap"
+COMM_OVERLAP_DEFAULT = "none"
+COMM_OVERLAP_MODES = ("none", "auto", "on")
 FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
 

@@ -59,7 +59,7 @@ from .module import TrainModule
 from .comm.bucketing import BucketPlan
 from .pipe.p2p import batch_shardable
 from .progressive_layer_drop import ProgressiveLayerDrop
-from .utils import ThroughputTimer, clip_grad_norm, has_overflow
+from .utils import ThroughputTimer, has_overflow
 from ..utils.timer import SynchronizedWallClockTimer
 from .zero.partition import ZeroShardingPlan
 
@@ -251,6 +251,8 @@ class DeepSpeedEngine:
             steps_per_output=self.steps_per_print() or 50)
         self.bucket_plan = self._build_bucket_plan()
         self._qwz_gather = self._build_qwz_gather()
+        self._overlap_mode = self._resolve_overlap()
+        self._build_overlap()
         self._step_fns = self._build_step_fns()
         self._last_lr = self._current_lr()
 
@@ -325,6 +327,24 @@ class DeepSpeedEngine:
         self._qwz_gather = None
         self._grad_acc = None
         self._cached = None
+        self._overlap_mode = None
+        self._overlap_exchange = None
+        self._qwz_overlap = None
+        self._overlap_pending = []
+        cc = getattr(self._config, "comm_config", None)
+        mode = getattr(cc, "overlap", "none") if cc is not None else "none"
+        if mode != "none":
+            # satellite contract: a requested overlap NEVER silently
+            # no-ops — Infinity streams per-block grads host-side and
+            # owns its own pipelining ("on" warns, "auto" informs,
+            # matching _resolve_overlap)
+            msg = ("comm.overlap requested but ZeRO-Infinity streams "
+                   "parameters and gradients host-side; the serial "
+                   "streamed path stays in charge")
+            if mode == "on":
+                logger.warning(msg)
+            else:
+                log_dist(msg, ranks=[0])
         self.optimizer = self._configure_optimizer()  # lr container only
         self._scaler_state = self.loss_scaler.jit_state()
         self.lr_scheduler = self._configure_lr_scheduler(lr_scheduler)
@@ -672,6 +692,7 @@ class DeepSpeedEngine:
         still in flight — shutdown never abandons an uncommitted tag."""
         self._drain_step_log(force=True)
         self.close_data_pipeline()
+        self.close_overlap()
         ckpt_io.flush_pending()
         if getattr(self, "_watchdog", None) is not None:
             self._watchdog.stop()
@@ -801,304 +822,227 @@ class DeepSpeedEngine:
         log_dist(gather.describe(), ranks=[0])
         return gather
 
-    def _account_qwz(self, events: int = 1):
-        """Per-dispatch wire-byte accounting for the quantized stage-3
-        parameter gather, mirroring _account_grad_wire: the exact
-        payload+scales bytes each rank contributes per gather event
-        (one per fused/scanned step program, one per micro step on the
-        split path)."""
-        gather = self._qwz_gather
-        if gather is None:
-            return
-        COUNTERS.add("qwz.gather",
-                     gather.wire_bytes_per_gather * events,
-                     calls=gather.collectives_per_gather * events)
-
-    def _account_grad_wire(self, events: int = 1):
-        """Per-dispatch wire-byte accounting for the bucketed path: the
-        plan's predicted payload, recorded as the step executes (unlike
-        the traced-occurrence `bucket.*`/`dist.*` counters).  The
-        monitor's per-step counter deltas pick this up unchanged, and
-        tests/test_grad_bucketing.py pins it against the plan exactly.
-        Hierarchical plans additionally split the total into
-        `grad_wire.intra` (fast-fabric scatter/gather legs) and
-        `grad_wire.inter` (the slow-fabric hop on the 1/inner shard —
-        the number a two-level placement exists to shrink).  Every
-        counter gets a `*_logical` twin pricing the same wire with zero
-        padding overhead: bucket padding to inner/block multiples would
-        otherwise inflate the padded figures and mask part of a
-        compression win in BENCH comparisons."""
-        plan = self.bucket_plan
-        if plan is None or self._capture_layers is not None:
-            return
-        COUNTERS.add("grad_wire.reduce",
-                     plan.wire_bytes_per_reduction * events,
-                     calls=plan.collectives_per_reduction * events)
-        COUNTERS.add("grad_wire.reduce_logical",
-                     plan.wire_bytes_logical_per_reduction * events,
-                     calls=plan.collectives_per_reduction * events)
-        if plan.hierarchical:
-            COUNTERS.add("grad_wire.intra",
-                         plan.wire_bytes_intra_per_reduction * events,
-                         calls=plan.collectives_intra_per_reduction * events)
-            COUNTERS.add("grad_wire.intra_logical",
-                         plan.wire_bytes_intra_logical_per_reduction * events,
-                         calls=plan.collectives_intra_per_reduction * events)
-            COUNTERS.add("grad_wire.inter",
-                         plan.wire_bytes_inter_per_reduction * events,
-                         calls=plan.collectives_inter_per_reduction * events)
-            COUNTERS.add("grad_wire.inter_logical",
-                         plan.wire_bytes_inter_logical_per_reduction * events,
-                         calls=plan.collectives_inter_per_reduction * events)
-
     def _build_step_fns(self):
-        model = self.module
-        compute_dtype = self.compute_dtype
-        plan = self.zero_plan
-        opt = self.optimizer
-        gas = self.gradient_accumulation_steps()
-        clip = float(self._config.gradient_clipping or 0.0)
-        prescale = self._config.prescale_gradients
-        predivide = float(self._config.gradient_predivide_factor or 1.0)
-        scaler = self.loss_scaler
-        pld_enabled = self.progressive_layer_drop is not None
-        capture = self._capture_layers
-        store_grads = self._store_gradients
+        """All jitted step programs come out of the schedule-driven
+        StepBuilder (runtime/step_builder.py): ONE set of prep/grad/
+        reduce/apply stage closures composed per the resolved
+        StepSchedule — fused, scan, split, onebit, or the overlapped
+        grads/exchange/combine pipeline.  Per-dispatch wire/qwZ counter
+        accounting rides the emitted programs (CountedFn), so the byte
+        math lives in the builder, once."""
+        from .step_builder import StepBuilder
 
-        def cast(tree, dtype):
-            return jax.tree_util.tree_map(
-                lambda x: x.astype(dtype) if jnp.issubdtype(
-                    x.dtype, jnp.floating) else x, tree)
-
-        qwz = self._qwz_gather
-
-        def prep_params(params):
-            """Master params -> the compute-side replica the loss
-            consumes: compute-dtype cast, then (qwZ) the stage-3 gather
-            rides int8/int4 blocks + fp16 scales and dequantizes on
-            device — the master copy itself is never quantized."""
-            cparams = cast(params, compute_dtype)
-            if qwz is not None:
-                cparams = qwz.gather(cparams)
-            return cparams
-
-        def run_loss(p, batch, rng, pld_theta, loss_scale):
-            """Shared scaled-loss body: returns (scaled_loss, (loss, caps)).
-            caps is {} unless layer-output hooks are registered
-            (register_forward_hook) — then the model threads the requested
-            block outputs out of the traced program as aux."""
-            kwargs = {}
-            if pld_enabled:
-                kwargs = {"progressive_layer_drop": True,
-                          "pld_theta": pld_theta}
-            if capture is not None:
-                kwargs["capture_layers"] = capture
-            out = model.loss(p, batch, rng=rng, train=True, **kwargs)
-            caps = {}
-            if capture is not None:
-                out, caps = out
-            loss = out[0] if isinstance(out, tuple) else out
-            scale_factor = loss_scale / (predivide if prescale else 1.0)
-            return loss.astype(jnp.float32) * scale_factor, (loss, caps)
-
-        # -- gradient production: implicit XLA psum vs the bucketed wire
-        wire_plan = self.bucket_plan if capture is None else None
-        if self.bucket_plan is not None and wire_plan is None:
-            log_dist("layer-output capture active: this step program rides "
-                     "the implicit gradient wire (captures are threaded "
-                     "through the global-loss trace)", ranks=[0])
-
-        def implicit_grads(cparams, batch, rng, pld_theta, loss_scale):
-            """Global-mean loss: XLA inserts one psum per grad leaf."""
-            grads, (loss, caps) = jax.grad(
-                lambda p: run_loss(p, batch, rng, pld_theta, loss_scale),
-                has_aux=True)(cparams)
-            return cast(grads, jnp.float32), loss, caps
-
-        if wire_plan is None:
-            compute_grads = implicit_grads
-        else:
-            mesh = self.mesh_info.mesh
-            P = PartitionSpec
-            data_axes = self.mesh_info.data_axes  # outermost first
-            batch_spec = self.mesh_info.data_spec
-            inner_size = self.mesh_info.data_inner_size
-
-            def _global_dp_rank():
-                # linearized rank over the (possibly factored) data
-                # axis: outer-major matches the mesh's device order
-                if len(data_axes) == 1:
-                    return jax.lax.axis_index(data_axes[0])
-                return (jax.lax.axis_index(data_axes[0]) * inner_size
-                        + jax.lax.axis_index(data_axes[1]))
-
-            def _local_step(cp, b, r, ls, th):
-                # per-shard rng decorrelation: the implicit wire draws ONE
-                # global dropout mask; each shard must not repeat it
-                r = jax.random.fold_in(r, _global_dp_rank())
-                grads, (loss, _) = jax.grad(
-                    lambda p: run_loss(p, b, r, th, ls), has_aux=True)(cp)
-                buckets = wire_plan.flatten(cast(grads, jnp.float32))
-                buckets = wire_plan.reduce(buckets)
-                return buckets, jax.lax.pmean(loss, data_axes)
-
-            smapped = jax.shard_map(
-                _local_step, mesh=mesh,
-                in_specs=(P(), P(batch_spec), P(), P(), P()),
-                out_specs=(wire_plan.bucket_out_specs(), P()),
-                axis_names=set(data_axes), check_vma=False)
-
-            def compute_grads(cparams, batch, rng, pld_theta, loss_scale):
-                """LOCAL grads under shard_map, mean-reduced through the
-                BucketPlan: one fused collective per bucket (psum_scatter
-                under ZeRO>=2) instead of one psum per leaf."""
-                buckets, loss = smapped(cparams, batch, rng, loss_scale,
-                                        pld_theta)
-                return wire_plan.unflatten(buckets), loss, {}
-
-        def micro_step(params, acc, batch, rng, loss_scale, pld_theta):
-            cparams = prep_params(params)
-            grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
-                                              loss_scale)
-            new_acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-            new_acc = plan.constrain_grads(new_acc)
-            return loss, new_acc, {"layer_outputs": caps}
-
-        def apply_step(params, opt_state, scaler_state, acc, lr):
-            loss_scale = scaler_state["cur_scale"]
-            overflow = has_overflow(acc)
-            denom = loss_scale * gas
-            if prescale:
-                denom = denom / predivide
-            grads = jax.tree_util.tree_map(lambda g: g / denom, acc)
-            grad_norm = jnp.asarray(0.0, jnp.float32)
-            if clip > 0.0:
-                grads, grad_norm = clip_grad_norm(grads, clip)
-            extras = {}
-            if store_grads:
-                # zeroed on overflow: the step is skipped, so consumers
-                # (e.g. GradientNoiseScale) must not ingest inf/nan grads
-                extras["grads"] = jax.tree_util.tree_map(
-                    lambda g: jnp.where(overflow, 0.0, g), grads)
-            # grads here are already DP-averaged (XLA psum at the loss-mean
-            # boundary), so a 1-bit optimizer on this path runs dense
-            # (comm_axis=None). The compressed hot path is
-            # _build_onebit_step: a shard_map fused step with LOCAL grads
-            # where the optimizer owns the wire.
-            new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
-
-            # branchless skip-step on overflow (reference: step skipped,
-            # scale halved — fp16/loss_scaler + stage2.py:1385-1404)
-            sel = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new, old)
-            new_params = sel(new_params, params)
-            new_opt = sel(new_opt, opt_state)
-
-            new_params = plan.constrain_params(new_params)
-            new_opt = plan.constrain_opt_state(new_opt)
-            new_scaler = scaler.jit_update(scaler_state, overflow)
-            zero_acc = jax.tree_util.tree_map(jnp.zeros_like, acc)
-            return (new_params, new_opt, new_scaler, zero_acc, overflow,
-                    grad_norm, extras)
-
-        def full_step(params, opt_state, scaler_state, batch, rng, lr,
-                      pld_theta):
-            """Whole training step (fwd+bwd+optimizer+scaler) as ONE
-            program — the gas==1 fast path. The split micro/apply pair
-            writes the fp32 gradient tree to HBM at the end of one program
-            and reads it back at the start of the next (plus a second
-            host dispatch per step — expensive over a tunneled runtime);
-            here the gradients never outlive the fused program and XLA can
-            overlap the optimizer with the tail of the backward."""
-            loss_scale = scaler_state["cur_scale"]
-            cparams = prep_params(params)
-            grads, loss, caps = compute_grads(cparams, batch, rng, pld_theta,
-                                              loss_scale)
-            grads = plan.constrain_grads(grads)
-            overflow = has_overflow(grads)
-            denom = loss_scale
-            if prescale:
-                denom = denom / predivide
-            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
-            grad_norm = jnp.asarray(0.0, jnp.float32)
-            if clip > 0.0:
-                grads, grad_norm = clip_grad_norm(grads, clip)
-            extras = {"layer_outputs": caps}
-            if store_grads:
-                # zeroed on overflow (the step is skipped; see apply_step)
-                extras["grads"] = jax.tree_util.tree_map(
-                    lambda g: jnp.where(overflow, 0.0, g), grads)
-            new_params, new_opt = opt.update(grads, opt_state, params, lr=lr)
-            sel = lambda new, old: jax.tree_util.tree_map(
-                lambda n, o: jnp.where(overflow, o, n), new, old)
-            new_params = sel(new_params, params)
-            new_opt = sel(new_opt, opt_state)
-            new_params = plan.constrain_params(new_params)
-            new_opt = plan.constrain_opt_state(new_opt)
-            new_scaler = scaler.jit_update(scaler_state, overflow)
-            return (new_params, new_opt, new_scaler, loss, overflow,
-                    grad_norm, extras)
-
-        donate_micro = jax.jit(micro_step, donate_argnums=(1,))
-        # lr=None (optimizer-default) is a static arg value: jit treats None
-        # as an empty pytree, giving that case its own (single) trace
-        donate_apply = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
-        def scan_batch_step(params, opt_state, scaler_state, batches, rngs,
-                            lr, pld_theta):
-            """Whole GLOBAL batch (gas micro steps + update) as ONE
-            program: micro batches arrive stacked on a leading [gas] dim
-            and a lax.scan accumulates grads — one host dispatch per
-            global batch instead of gas+1 (train_batch uses this when the
-            iterator is stackable)."""
-            loss_scale = scaler_state["cur_scale"]
-            cparams = prep_params(params)
-
-            # captured layer outputs ride the scan CARRY (overwritten per
-            # micro step — reference hooks overwrite per forward), not the
-            # stacked ys: as ys they'd materialize a [gas, ...] buffer per
-            # hooked layer only for the last slice to survive
-            caps0 = {}
-            if capture is not None:
-                caps_struct = jax.eval_shape(
-                    lambda p, b, r, ls, th: run_loss(p, b, r, th, ls)[1][1],
-                    cparams, jax.tree_util.tree_map(lambda x: x[0], batches),
-                    rngs[0], loss_scale, pld_theta)
-                caps0 = jax.tree_util.tree_map(
-                    lambda s: jnp.zeros(s.shape, s.dtype), caps_struct)
-
-            def body(carry, inp):
-                acc, _ = carry
-                batch_i, rng_i = inp
-                grads, loss, caps = compute_grads(cparams, batch_i, rng_i,
-                                                  pld_theta, loss_scale)
-                acc = jax.tree_util.tree_map(jnp.add, acc, grads)
-                return (plan.constrain_grads(acc), caps), loss
-
-            acc0 = jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            acc0 = plan.constrain_grads(acc0)
-            (acc, caps), losses = jax.lax.scan(body, (acc0, caps0),
-                                               (batches, rngs))
-            (new_params, new_opt, new_scaler, zero_acc, overflow,
-             grad_norm, extras) = apply_step(params, opt_state, scaler_state,
-                                             acc, lr)
-            extras = dict(extras)
-            extras["layer_outputs"] = caps
-            return (new_params, new_opt, new_scaler, jnp.mean(losses),
-                    overflow, grad_norm, extras)
-
-        fns = {"micro": donate_micro, "apply": donate_apply}
-        if self._use_onebit_comm():
-            fns["full"] = self._build_onebit_step(cast)
-        elif gas == 1 and self._offload is None:
-            # scaler state (arg 2) is NOT donated: it stays readable between
-            # the fused forward and step(), so engine.loss_scale keeps
-            # reference pre-update semantics until the boundary's step()
-            fns["full"] = jax.jit(full_step, donate_argnums=(0, 1))
-        elif gas > 1 and self._offload is None:
-            fns["full_scan"] = jax.jit(scan_batch_step,
-                                       donate_argnums=(0, 1))
+        fns = StepBuilder(self).build()
+        if self._overlap_mode == "wire" and "grads" not in fns:
+            # the schedule downgraded (e.g. layer-output capture forced
+            # the implicit wire) — say so instead of silently serializing
+            log_dist("comm.overlap: this step build cannot ride the "
+                     "overlapped wire (no bucketed plan in effect); "
+                     "running the serial schedule", ranks=[0])
         return fns
+
+    def _resolve_overlap(self):
+        """Resolve the `comm.overlap` knob against what this engine can
+        actually serve: "wire" (host-exchanged bucketed gradient
+        reduction, stage < 3), "qwz" (host-exchanged + prefetched
+        stage-3 quantized parameter gather), or None with a LOGGED
+        fallback — a requested overlap must never silently no-op."""
+        cc = getattr(self._config, "comm_config", None)
+        mode = getattr(cc, "overlap", "none") if cc is not None else "none"
+        if mode == "none":
+            return None
+        blockers = []
+        if getattr(self.optimizer, "handles_dp_reduction", False) and                 self._use_onebit_comm():
+            blockers.append("the 1-bit optimizer owns the compressed "
+                            "wire (error feedback cannot split across "
+                            "an exchange boundary)")
+        if self._offload is not None:
+            blockers.append("ZeRO-Offload (the step runs host-side)")
+        if self.mesh_info.axis_size(PIPE_AXIS) > 1:
+            blockers.append("pipe-parallel stages (the pipeline "
+                            "schedule owns inter-stage overlap)")
+        if not blockers:
+            if self.bucket_plan is not None:
+                return "wire"
+            if self._qwz_gather is not None:
+                return "qwz"
+            blockers.append(
+                "no overlappable wire is configured (needs "
+                "comm.gradient_reduction=bucketed at stage<3, or "
+                "zero_optimization.quantized_weights at stage 3)")
+        msg = ("comm.overlap=" + str(mode) + " requested but the serial "
+               "path stays in charge: " + "; ".join(blockers))
+        if mode == "on":
+            logger.warning(msg)
+        else:
+            log_dist(msg, ranks=[0])
+        return None
+
+    def _build_overlap(self):
+        """Construct the host exchange + (mode "qwz") the prefetchable
+        encode/decode programs for the resolved overlap mode."""
+        # the exchange survives step-fn rebuilds (retuned bucket plans,
+        # hook/stash flips): its rendezvous keys are write-once and the
+        # peer sockets are good for the engine's lifetime
+        exchange = getattr(self, "_overlap_exchange", None)
+        self._overlap_exchange = exchange
+        self._qwz_overlap = None
+        self._overlap_pending = []
+        self._qwz_prefetch = None
+        self._qwz_cparams_cache = None
+        if self._overlap_mode is None:
+            return
+        from .comm.overlap import make_exchange
+
+        dp = self.mesh_info.axis_size(DATA_AXIS)
+        if exchange is None:
+            self._overlap_exchange = make_exchange(dp)
+        self._overlap_matrix_sharding = NamedSharding(
+            self.mesh_info.mesh, PartitionSpec())
+        if self._overlap_mode == "wire":
+            _, self._overlap_payload_nbytes = \
+                self.bucket_plan.overlap_layout
+            log_dist("comm.overlap: bucketed gradient wire rides the "
+                     "host exchange — reduction of micro-step N "
+                     "overlaps micro-step N+1's compute "
+                     f"({self._overlap_payload_nbytes} B/rank/micro)",
+                     ranks=[0])
+        else:
+            from .step_builder import StepBuilder
+
+            gather = self._qwz_gather
+            compute_dtype = self.compute_dtype
+
+            def cast_fn(tree):
+                return jax.tree_util.tree_map(
+                    lambda x: x.astype(compute_dtype) if jnp.issubdtype(
+                        x.dtype, jnp.floating) else x, tree)
+
+            encode, decode = gather.build_overlap(cast_fn)
+            builder = StepBuilder(self)
+            self._qwz_overlap = (
+                builder._counted(encode, qwz=gather, qwz_events=1),
+                builder._counted(decode))
+            _, self._overlap_payload_nbytes = gather.overlap_layout()
+            log_dist("comm.overlap: qwZ stage-3 parameter gather rides "
+                     "the host exchange, prefetched behind the previous "
+                     "step's apply "
+                     f"({self._overlap_payload_nbytes} B/rank/step)",
+                     ranks=[0])
+
+    def _overlap_submit(self, payload):
+        """Hand one encoded wire payload (a rank-stacked device array)
+        to the host exchange.  The worker thread materializes the local
+        shards (blocking on the producing program THERE, never here)
+        and moves the bytes while the device runs whatever was
+        dispatched next."""
+        total = self._overlap_payload_nbytes
+        blocks = []
+        for shard in payload.addressable_shards:
+            rank = int(shard.index[0].start or 0) // total
+            blocks.append((rank, (lambda d: lambda: d)(shard.data)))
+        return self._overlap_exchange.submit(blocks)
+
+    def _drain_overlap(self):
+        """Settle every in-flight gradient exchange: sync the device to
+        the last grads program (everything after that host-blocked wait
+        is EXPOSED wire time — the number overlap exists to shrink,
+        recorded as `grad_wire.exposed_ms` in the ckpt.stall_ms
+        µs-in-bytes convention), then fold each micro's combined
+        gradients into the accumulator in micro order — bit-identical
+        to the serial wire's per-micro reduction order."""
+        pending = self._overlap_pending
+        if not pending:
+            return
+        if "combine" not in self._step_fns:
+            raise RuntimeError(
+                "overlap: in-flight gradient exchanges but the current "
+                "step build has no combine program — the step programs "
+                "were rebuilt mid-accumulation (register_forward_hook / "
+                "store_gradients between forward and step?)")
+        if self._grad_acc is None:
+            self._grad_acc = self._zero_grad_acc()
+        if self._last_loss is not None:
+            jax.block_until_ready(self._last_loss)
+        exposed_us = 0
+        while pending:
+            ticket = pending[0]
+            before = ticket.wait_us
+            mat = ticket.wait()
+            exposed_us += ticket.wait_us - before
+            mdev = jax.device_put(mat, self._overlap_matrix_sharding)
+            # combine dispatches are async: the NEXT ticket's wire wait
+            # overlaps this combine's device execution.  The ticket is
+            # popped only once COMBINED: a wait() that raises leaves it
+            # (and everything after it) pending, so a retried step()
+            # resumes exactly where the drain stopped instead of
+            # folding earlier tickets' gradients twice.
+            self._grad_acc = self._step_fns["combine"](self._grad_acc,
+                                                       mdev)
+            pending.pop(0)
+            self._retire_ticket(ticket)
+        COUNTERS.add("grad_wire.exposed_ms", int(exposed_us), calls=1)
+
+    def _retire_ticket(self, ticket):
+        retire = getattr(self._overlap_exchange, "retire", None)
+        if retire is not None:
+            retire(ticket)
+
+    def _qwz_kick_prefetch(self):
+        """Dispatch the NEXT step's quantized parameter gather right
+        behind the apply that produced the params: the encode program
+        queues after the apply on the device, and the host exchange
+        then runs behind the step's host-side tail (bookkeeping, input
+        pipeline) and the next forward's dispatch."""
+        if self._qwz_overlap is None:
+            return
+        encode, _decode = self._qwz_overlap
+        self._qwz_cparams_cache = None
+        self._qwz_prefetch = (self._params,
+                              self._overlap_submit(encode(self._params)))
+
+    def _step_cparams(self):
+        """The (possibly prefetched) gathered compute params for this
+        step.  A prefetch that landed before the forward asked for it
+        is a `qwz.prefetch_hits` event (bytes = µs of head start, the
+        µs-in-bytes convention); a stale prefetch (params replaced out
+        of band, e.g. load_checkpoint) is discarded and the gather runs
+        on demand."""
+        if self._qwz_overlap is None:
+            return None
+        cache = self._qwz_cparams_cache
+        if cache is not None and cache[0] is self._params:
+            return cache[1]
+        encode, decode = self._qwz_overlap
+        pre = self._qwz_prefetch
+        self._qwz_prefetch = None
+        if pre is not None and pre[0] is self._params:
+            ticket = pre[1]
+        else:
+            if pre is not None:
+                # stale (params swapped out of band): unregister it so
+                # the transport does not hold every rank's payload for
+                # an exchange nobody will consume
+                self._retire_ticket(pre[1])
+            ticket = self._overlap_submit(encode(self._params))
+        import time as _time
+
+        if ticket.ready and ticket.done_at is not None:
+            head_us = int((_time.perf_counter() - ticket.done_at) * 1e6)
+            COUNTERS.add("qwz.prefetch_hits", max(0, head_us), calls=1)
+        mat = ticket.wait()
+        self._retire_ticket(ticket)
+        mdev = jax.device_put(mat, self._overlap_matrix_sharding)
+        cparams = decode(self._params, mdev)
+        self._qwz_cparams_cache = (self._params, cparams)
+        return cparams
+
+    def close_overlap(self):
+        """Tear the overlap exchange down (sockets + worker threads).
+        Idempotent; finalize_monitoring calls it."""
+        ex = getattr(self, "_overlap_exchange", None)
+        if ex is not None:
+            ex.close()
 
     def _use_onebit_comm(self) -> bool:
         """True when the optimizer's own (compressed) DP reduction runs in
@@ -1285,6 +1229,8 @@ class DeepSpeedEngine:
         sp = rm.span("forward") if rm is not None else None
         if self._infinity is not None:
             loss = self._infinity_forward(batch)
+        elif "grads" in self._step_fns:
+            loss = self._overlap_forward(batch, rng)
         elif "full" in self._step_fns:
             loss = self._fused_forward(batch, rng)
         else:
@@ -1308,18 +1254,60 @@ class DeepSpeedEngine:
         profiling = self._maybe_profile_flops(batch, rng, theta)
         # split path: flops/step ~= micro flops x gas (the apply program
         # is optimizer-bound, negligible FLOPs next to fwd+bwd)
+        p0 = self._step_cparams() if self._qwz_overlap is not None \
+            else self._params
         self._maybe_monitor_flops(
-            self._step_fns["micro"], self._params, self._grad_acc, batch,
+            self._step_fns["micro"].fn, p0, self._grad_acc, batch,
             rng, self._scaler_state["cur_scale"], theta,
             per_step_mult=float(self.gradient_accumulation_steps()))
         if self._wall_clock_breakdown:
             self.timers("forward").start()
+        p0 = self._step_cparams() if self._qwz_overlap is not None \
+            else self._params
         loss, self._grad_acc, extras = self._step_fns["micro"](
-            self._params, self._grad_acc, batch, rng,
+            p0, self._grad_acc, batch, rng,
             self._scaler_state["cur_scale"], theta)
-        self._account_grad_wire()
-        self._account_qwz()
         self._consume_extras(extras)
+        if self._wall_clock_breakdown:
+            # one fused fwd+bwd program: this IS forward+backward time
+            self.timers("forward").stop(sync=loss)
+        if profiling is not None:
+            profiling.stop_profile(params=self._params, sync=loss)
+            profiling.stats.update(self._flops_stats)
+            profiling.print_model_profile(
+                profile_step=self.global_steps,
+                top_modules=self._config.flops_profiler_config.top_modules,
+                detailed=self._config.flops_profiler_config.detailed)
+        self._cached = loss
+        self._last_loss = loss
+        return loss
+
+    def _overlap_forward(self, batch, rng):
+        """Overlapped-wire micro step: the grads program emits this
+        rank's encoded wire payload, which the host exchange moves
+        while the device runs whatever is dispatched next (the next
+        micro's grads program, the boundary combines); the reduction is
+        deferred to step()'s drain.  Losses and the final params are
+        bitwise the serial wire's — the combine program mirrors its
+        reduction math expression for expression."""
+        if self.is_gradient_accumulation_boundary():
+            self.tput_timer.start()  # times one full global batch
+        batch = self._shard_batch(batch)
+        rng = rng if rng is not None else self._next_rng()
+        theta = jnp.asarray(
+            self.progressive_layer_drop.get_theta()
+            if self.progressive_layer_drop else 1.0, jnp.float32)
+        profiling = self._maybe_profile_flops(batch, rng, theta)
+        self._maybe_monitor_flops(
+            self._step_fns["grads"].fn, self._params, batch, rng,
+            self._scaler_state["cur_scale"], theta,
+            per_step_mult=float(self.gradient_accumulation_steps()))
+        if self._wall_clock_breakdown:
+            self.timers("forward").start()
+        loss, payload = self._step_fns["grads"](
+            self._params, batch, rng, self._scaler_state["cur_scale"],
+            theta)
+        self._overlap_pending.append(self._overlap_submit(payload))
         if self._wall_clock_breakdown:
             # one fused fwd+bwd program: this IS forward+backward time
             self.timers("forward").stop(sync=loss)
@@ -1375,17 +1363,16 @@ class DeepSpeedEngine:
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
         profiling = self._maybe_profile_flops(batch, rng, theta, lr=lr)
-        self._maybe_monitor_flops(
-            self._step_fns["full"], self._params, self._opt_state,
-            self._scaler_state, batch, rng, lr, theta)
+        args = (self._params, self._opt_state, self._scaler_state,
+                batch, rng, lr, theta)
+        if self._qwz_overlap is not None:
+            args = args + (self._step_cparams(),)
+        self._maybe_monitor_flops(self._step_fns["full"].fn, *args)
         if self._wall_clock_breakdown:
             self.timers("forward").start()
         (self._params, self._opt_state, new_scaler, loss,
-         overflow, grad_norm, extras) = self._step_fns["full"](
-            self._params, self._opt_state, self._scaler_state, batch, rng,
-            lr, theta)
-        self._account_grad_wire()
-        self._account_qwz()
+         overflow, grad_norm, extras) = self._step_fns["full"](*args)
+        self._qwz_kick_prefetch()
         self._consume_extras(extras)
         if self._wall_clock_breakdown:
             # the fused program IS forward+backward+step
@@ -1413,13 +1400,24 @@ class DeepSpeedEngine:
         from ..profiling.flops_profiler.profiler import (FlopsProfiler,
                                                          analyze_fn)
         self._flops_profiled = True
-        if "full" in self._step_fns:
+        if "grads" in self._step_fns:
             self._flops_stats = analyze_fn(
-                self._step_fns["full"], self._params, self._opt_state,
-                self._scaler_state, batch, rng, lr, theta)
+                self._step_fns["grads"].fn, self._params, batch, rng,
+                self._scaler_state["cur_scale"], theta)
+        elif "full" in self._step_fns:
+            args = (self._params, self._opt_state, self._scaler_state,
+                    batch, rng, lr, theta)
+            if self._qwz_overlap is not None:
+                args = args + (self._step_cparams(),)
+            self._flops_stats = analyze_fn(self._step_fns["full"].fn,
+                                           *args)
         else:
+            if self._grad_acc is None:
+                self._grad_acc = self._zero_grad_acc()
+            p0 = self._step_cparams() if self._qwz_overlap is not None \
+                else self._params
             self._flops_stats = analyze_fn(
-                self._step_fns["micro"], self._params, self._grad_acc, batch,
+                self._step_fns["micro"].fn, p0, self._grad_acc, batch,
                 rng, self._scaler_state["cur_scale"], theta)
         prof = FlopsProfiler()
         prof.start_profile()
@@ -1559,6 +1557,7 @@ class DeepSpeedEngine:
             self.timers("step").start()
         rsp = (self.run_monitor.span("step")
                if self.run_monitor is not None else None)
+        self._drain_overlap()
         self._resolve_pending_overflow()
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
@@ -1566,6 +1565,7 @@ class DeepSpeedEngine:
          overflow, grad_norm, extras) = self._step_fns["apply"](
             self._params, self._opt_state, self._scaler_state,
             self._grad_acc, lr)
+        self._qwz_kick_prefetch()
         self._consume_extras(extras)
         self.global_steps += 1
         # DEFERRED overflow handling: bool(overflow) here would sync every
@@ -1903,18 +1903,17 @@ class DeepSpeedEngine:
             if self.progressive_layer_drop else 1.0, jnp.float32)
         cur_lr = self._current_lr()
         lr = None if cur_lr is None else jnp.asarray(cur_lr, jnp.float32)
-        self._maybe_monitor_flops(
-            self._step_fns["full_scan"], self._params, self._opt_state,
-            self._scaler_state, stacked, rngs, lr, theta)
+        args = (self._params, self._opt_state, self._scaler_state,
+                stacked, rngs, lr, theta)
+        if self._qwz_overlap is not None:
+            # the gather rides the host exchange ONCE per global batch,
+            # prefetched behind the previous step's apply
+            args = args + (self._step_cparams(),)
+        self._maybe_monitor_flops(self._step_fns["full_scan"].fn, *args)
         sp = rm.span("forward") if rm is not None else None
         (self._params, self._opt_state, new_scaler, loss, overflow,
-         grad_norm, extras) = self._step_fns["full_scan"](
-            self._params, self._opt_state, self._scaler_state, stacked,
-            rngs, lr, theta)
-        self._account_grad_wire(events=gas)
-        # the scan program gathers the compute params ONCE outside the
-        # micro-step body — one qwZ event per global batch, not per micro
-        self._account_qwz()
+         grad_norm, extras) = self._step_fns["full_scan"](*args)
+        self._qwz_kick_prefetch()
         if feed is not None:
             # the scan program is in flight: collate + H2D of the NEXT
             # global batch overlap it (before any sync-closing span)
@@ -2072,7 +2071,12 @@ class DeepSpeedEngine:
         if bucket_size is not None and self.bucket_plan is not None and \
                 int(bucket_size) != self.bucket_plan.bucket_elems:
             self._config.comm_config.reduce_bucket_size = int(bucket_size)
+            # settle in-flight overlapped exchanges against the CURRENT
+            # plan's combine before it is replaced — a mid-accumulation
+            # retune must not drop already-dispatched micro gradients
+            self._drain_overlap()
             self.bucket_plan = self._build_bucket_plan()
+            self._build_overlap()  # payload layout follows the plan
             self._step_fns = self._build_step_fns()
             log_dist("allreduce_gradients: rebucketed -> "
                      + self.bucket_plan.describe(), ranks=[0])

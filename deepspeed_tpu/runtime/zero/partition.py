@@ -384,6 +384,8 @@ class QuantizedWeightGather:
                 gathered *= plan.mesh_info.axis_size(a)
             self.n_quantized_leaves += 1
 
+        self._treedef = treedef
+        self._axis_names = axis_names
         if not self.n_quantized_leaves:
             self._fn = None
             return
@@ -424,6 +426,125 @@ class QuantizedWeightGather:
     @property
     def active(self) -> bool:
         return self._fn is not None
+
+    def overlap_layout(self):
+        """[(leaf_idx, offset, nbytes, local_elems, dim, axes, shape)]
+        of each quantized leaf inside the fused per-rank exchange
+        buffer, + the buffer's total size — the host-exchanged (qwZ
+        prefetch) form of the gather."""
+        from ..comm.quant import payload_bytes
+
+        layout, off = [], 0
+        for idx, (dim, axes, shape) in enumerate(self._placements):
+            if dim is None:
+                continue
+            world = 1
+            for a in axes:
+                world *= self.plan.mesh_info.axis_size(a)
+            local = int(np.prod(shape, dtype=np.int64)) // world
+            nb = payload_bytes(local, self.wire, self.block)
+            layout.append((idx, off, nb, local, dim, axes, shape))
+            off += nb
+        return layout, off
+
+    def overlap_encode(self, qleaves):
+        """Local stage-3 shards (the quantized leaves, in layout order)
+        -> ONE fused uint8 exchange buffer for this rank (inside a
+        shard_map over the data axes, same in_specs as the in-program
+        gather).  Quantization math is byte-identical to
+        `quantized_all_gather`'s encode half."""
+        from ..comm.quant import pack_wire, quantize_blockwise
+
+        parts = []
+        for leaf in qleaves:
+            payload, scales = quantize_blockwise(leaf, self.block,
+                                                 self.wire)
+            parts.append(pack_wire(payload, scales))
+        return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+    def overlap_decode(self, cleaves, matrix):
+        """Gathered [world, total_nbytes] exchange matrix -> the full
+        compute-param leaves (replicated), mirroring the in-program
+        gather's dequantize/reassemble exactly.  Runs on global arrays
+        — no shard_map, no collectives."""
+        from ..comm.quant import dequantize_blockwise, unpack_wire
+
+        layout, _ = self.overlap_layout()
+        out = list(cleaves)
+        for idx, off, nb, local, dim, axes, shape in layout:
+            world = 1
+            for a in axes:
+                world *= self.plan.mesh_info.axis_size(a)
+            rows = jax.lax.slice(matrix, (0, off),
+                                 (matrix.shape[0], off + nb))
+            p, s = unpack_wire(rows, self.wire, self.block, local)
+            deq = dequantize_blockwise(p, s, self.wire, local)
+            local_shape = list(shape)
+            local_shape[dim] //= world
+            deq = deq.reshape((world,) + tuple(local_shape))
+            full = jnp.moveaxis(deq, 0, dim).reshape(shape)
+            out[idx] = full.astype(cleaves[idx].dtype)
+        return out
+
+    def encode_in_specs(self):
+        """in_specs of `overlap_encode`'s shard_map (quantized leaves
+        only, layout order) — the same data shardings the in-program
+        gather consumes."""
+        specs = []
+        for dim, axes, shape in self._placements:
+            if dim is None:
+                continue
+            entries = [None] * len(shape)
+            entries[dim] = axes if len(axes) > 1 else axes[0]
+            specs.append(PartitionSpec(*entries))
+        return tuple(specs)
+
+    def encode_out_spec(self):
+        """Out spec stacking each rank's exchange buffer rank-major."""
+        axis_names = []
+        for _dim, axes, _shape in self._placements:
+            for a in axes:
+                if a not in axis_names:
+                    axis_names.append(a)
+        # outer-major ordering matches the sequential-hop gather
+        order = [a for a in (DATA_OUTER_AXIS, DATA_INNER_AXIS, DATA_AXIS)
+                 if a in axis_names]
+        return PartitionSpec(tuple(order) if len(order) > 1 else order[0])
+
+    def build_overlap(self, cast_fn):
+        """(encode, decode) jitted programs for the host-exchanged
+        (prefetchable) form of the gather:
+
+          encode(params) -> this mesh's fused uint8 exchange buffer,
+                            stacked rank-major over the data axes
+          decode(params, matrix[world, nbytes]) -> full compute params
+
+        `cast_fn` is the engine's master->compute cast; encode
+        quantizes the CAST shards (exactly what the in-program gather
+        quantizes) and decode reassembles + casts the replicated
+        passthrough leaves, so decode(params, exchange(encode(params)))
+        is bitwise `prep_params` on the serial path."""
+        mesh = self.plan.mesh_info.mesh
+        layout, total = self.overlap_layout()
+        qidx = [entry[0] for entry in layout]
+        treedef = self._treedef
+
+        smapped = jax.shard_map(
+            lambda *qleaves: self.overlap_encode(qleaves),
+            mesh=mesh, in_specs=self.encode_in_specs(),
+            out_specs=self.encode_out_spec(),
+            axis_names=self._axis_names, check_vma=False)
+
+        def encode(params):
+            cleaves = jax.tree_util.tree_leaves(cast_fn(params))
+            return smapped(*[cleaves[i] for i in qidx])
+
+        def decode(params, matrix):
+            cleaves = jax.tree_util.tree_leaves(cast_fn(params))
+            out = self.overlap_decode(cleaves, matrix)
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return jax.jit(encode), jax.jit(decode)
 
     def gather(self, params):
         """Sharded (stage-3) compute params -> full gathered params,

@@ -87,10 +87,34 @@ def main():
     assert hplan.wire_bytes_inter_per_reduction * 4 <= \
         engine.bucket_plan.wire_bytes_per_reduction + 4 * 16 * \
         hplan.n_buckets, "inter bytes did not drop by the inner factor"
+    # overlapped lanes over the REAL socket exchange: the hierarchical
+    # pair (outer=nprocs=2: a 2-element outer reduce is commutative)
+    # and the flat int8 pair (gather wires share the serial sum
+    # expression) must be BITWISE the serial runs
+    hov_loss, hov_psum, hov_engine = run(
+        {"gradient_reduction": "bucketed", "reduce_bucket_size": 1024,
+         "hierarchy": "auto", "overlap": "on"})
+    assert "grads" in hov_engine._step_fns, \
+        "comm.overlap did not engage on the 2-process lane"
+    assert hov_loss == hier_loss and hov_psum == hier_psum, \
+        ("overlapped hier lane diverged from serial",
+         hov_loss, hier_loss, hov_psum, hier_psum)
+    hov_engine.close_overlap()
+    i8_loss, i8_psum, _ = run(
+        {"gradient_reduction": "bucketed", "reduce_bucket_size": 1024,
+         "wire_dtype": "int8"})
+    i8o_loss, i8o_psum, i8o_engine = run(
+        {"gradient_reduction": "bucketed", "reduce_bucket_size": 1024,
+         "wire_dtype": "int8", "overlap": "on"})
+    assert i8o_loss == i8_loss and i8o_psum == i8_psum, \
+        ("overlapped int8 lane diverged from serial",
+         i8o_loss, i8_loss, i8o_psum, i8_psum)
+    i8o_engine.close_overlap()
     print(f"GWOK proc={proc_id} "
           f"implicit={implicit_loss:.6f}/{implicit_psum:.6f} "
           f"bucketed={bucketed_loss:.6f}/{bucketed_psum:.6f} "
           f"hier={hier_loss:.6f}/{hier_psum:.6f} "
+          f"overlap_bitwise=1 "
           f"buckets={engine.bucket_plan.n_buckets}", flush=True)
 
 

@@ -343,3 +343,6 @@ def test_two_process_bucketed_parity():
     # the two-level wire (fp32/fp32) must land on the same training
     # trajectory as the flat wires over the real TCP boundary
     assert abs(il - hl) < 1e-4 and abs(ip - hp) / (abs(ip) + 1e-6) < 1e-4
+    # the worker asserted the overlapped lanes bitwise against serial
+    # (socket exchange over the real TCP boundary)
+    assert all("overlap_bitwise=1" in ln for ln in lines), lines

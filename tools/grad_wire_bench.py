@@ -76,6 +76,31 @@ VARIANTS = [
 ]
 
 
+def overlap_variants(outer: int, gas: int = 2):
+    """--overlap lanes: serial/overlapped pairs over the same wires
+    (comm.overlap rides the host exchange — runtime/comm/overlap.py).
+    gas>1 so micro N's exchange hides behind micro N+1's compute; the
+    serial twin runs the same composition for a like-for-like step.
+    Parity contract: the int8 lanes and the outer=2 hierarchical lanes
+    are BIT-identical serial-vs-overlap by construction (gather wires
+    share the sum expression; a 2-element reduce is commutative); the
+    flat bf16 pair matches within cross-process reduction-order
+    rounding (gloo's ring rotates chunk association — measured)."""
+    flat = {"gradient_reduction": "bucketed"}
+    hier = dict(flat, hierarchy={"outer": outer})
+    lanes = []
+    for name, base, wire in (
+            ("flat_bf16", flat, "bf16"), ("flat_int8", flat, "int8"),
+            ("hier_bf16", hier, "bf16"), ("hier_int8", hier, "int8")):
+        key = "wire_dtype" if base is flat else "wire_dtype_outer"
+        comm = dict(base, **{key: wire})
+        lanes.append((f"{name}_serial", 0, dict(comm, overlap="none"),
+                      {"gas": gas}))
+        lanes.append((f"{name}_overlap", 0, dict(comm, overlap="on"),
+                      {"gas": gas}))
+    return lanes
+
+
 def hier_variants(outer: int):
     """--hierarchy lanes: two-level reduction with data_outer groups."""
     base = {"gradient_reduction": "bucketed", "hierarchy": {"outer": outer}}
@@ -120,15 +145,22 @@ def measure_variants(variants, steps: int, size: str, seq: int,
     batch = (tok[:, :-1], tok[:, 1:])
 
     results = {}
-    for name, stage, comm in variants:
+    for variant in variants:
+        name, stage, comm = variant[:3]
+        opts = variant[3] if len(variant) > 3 else {}
+        gas = int(opts.get("gas", 1))
         cfg = {
-            "train_batch_size": dp,
+            "train_batch_size": dp * gas,
             "zero_optimization": {"stage": stage},
             "mesh": {"data": dp},
             "steps_per_print": 0,
             "optimizer": {"type": "Adam",
                           "params": {"lr": 1e-4, "weight_decay": 0.0}},
         }
+        if gas > 1:
+            # the same (dp, seq) token block feeds every micro step:
+            # micro batch stays 1 row/rank, the step runs gas micros
+            cfg["train_micro_batch_size_per_gpu"] = 1
         if comm is not None:
             cfg["comm"] = comm
         engine, *_ = deepspeed_tpu.initialize(
@@ -137,21 +169,26 @@ def measure_variants(variants, steps: int, size: str, seq: int,
         if comm is not None:
             assert engine.bucket_plan is not None, \
                 f"{name}: bucketed wire did not engage"
+        if comm is not None and comm.get("overlap") in ("on", "auto"):
+            assert "grads" in engine._step_fns, \
+                f"{name}: overlapped wire did not engage"
         for _ in range(warmup):  # compile + warm
-            engine.forward(batch)
-            engine.backward()
+            for _m in range(gas):
+                engine.forward(batch)
+                engine.backward()
             engine.step()
         snap = COUNTERS.snapshot()
         t = []
         for _ in range(steps):
             t0 = time.perf_counter()
-            loss = engine.forward(batch)
-            engine.backward()
+            for _m in range(gas):
+                loss = engine.forward(batch)
+                engine.backward()
             engine.step()
             loss.block_until_ready()
             t.append(time.perf_counter() - t0)
         entry = {"step_ms": round(float(np.median(t)) * 1e3, 2),
-                 "loss": round(float(loss), 4)}
+                 "loss": float(loss), "gas": gas}
         if engine.bucket_plan is not None:
             plan = engine.bucket_plan
             deltas = COUNTERS.delta_since(snap)
@@ -169,6 +206,13 @@ def measure_variants(variants, steps: int, size: str, seq: int,
             })
             if plan.quantized:
                 entry["quant_block"] = plan.quant_block
+            deltas_overlap = deltas.get("grad_wire.exposed_ms", {})
+            if deltas_overlap:
+                # µs-in-bytes convention (ckpt.stall_ms): the host wait
+                # NOT hidden behind device compute, per drain
+                entry["exposed_ms_per_step"] = round(
+                    deltas_overlap.get("bytes", 0) / 1000.0
+                    / max(1, deltas_overlap.get("calls", 1)), 3)
             if plan.hierarchical:
                 inner, outer = plan.levels
                 entry.update({
@@ -187,7 +231,32 @@ def measure_variants(variants, steps: int, size: str, seq: int,
                     "counted_inter_logical_bytes": int(deltas.get(
                         "grad_wire.inter_logical", {}).get("bytes", 0)),
                 })
+        engine.close_overlap()
         results[name] = entry
+
+    # overlap pairs: exposed-wire fraction + the parity contract.  Of
+    # the serial lane's wire cost, how much is still on the critical
+    # path with overlap on?  hidden = t_serial - t_overlap; exposed is
+    # the measured blocked-on-the-wire host time.
+    for name in list(results):
+        if not name.endswith("_overlap"):
+            continue
+        serial = results.get(name[:-8] + "_serial")
+        lane = results[name]
+        if serial is None:
+            continue
+        exposed = lane.get("exposed_ms_per_step", 0.0)
+        hidden = max(0.0, serial["step_ms"] - lane["step_ms"])
+        lane["wire_hidden_ms_per_step"] = round(hidden, 2)
+        lane["exposed_wire_frac"] = round(
+            exposed / max(exposed + hidden, 1e-9), 4)
+        lane["loss_bitwise_vs_serial"] = bool(
+            np.float32(lane["loss"]) == np.float32(serial["loss"]))
+        if "int8" in name or name.startswith("hier"):
+            assert lane["loss_bitwise_vs_serial"], \
+                (name, lane["loss"], serial["loss"])
+    for entry in results.values():
+        entry["loss"] = round(entry["loss"], 4)
     return results, n_params
 
 
@@ -198,6 +267,9 @@ def bench(args, nproc: int, proc_id: int):
         # single-process mesh has no real boundary — split it 2-ways so
         # the lowering still runs end-to-end (overhead floor)
         variants += hier_variants(nproc if nproc > 1 else 2)
+    if args.overlap:
+        variants += overlap_variants(nproc if nproc > 1 else 2,
+                                     gas=args.overlap_gas)
     results, n_params = measure_variants(variants, args.steps, args.size,
                                          args.seq)
 
@@ -209,11 +281,18 @@ def bench(args, nproc: int, proc_id: int):
         for name in results:
             results[name]["vs_unfused"] = round(
                 base / max(results[name]["step_ms"], 1e-9), 2)
-        suffix = "_hier" if args.hierarchy else ""
+        suffix = ("_overlap" if args.overlap
+                  else "_hier" if args.hierarchy else "")
         # the headline value must track the metric the manifest row is
-        # NAMED for: the hierarchical lane on --hierarchy runs, the flat
-        # bucketed lane otherwise
-        headline = results["hier" if args.hierarchy else "bucketed"]
+        # NAMED for: the exposed-wire fraction on --overlap runs, the
+        # hierarchical lane on --hierarchy runs, else the flat bucketed
+        if args.overlap:
+            headline = results["hier_int8_overlap"]["exposed_wire_frac"]
+            unit = "exposed_wire_frac_hier_int8"
+        else:
+            headline = results[
+                "hier" if args.hierarchy else "bucketed"]["vs_unfused"]
+            unit = "x_vs_unfused_dense"
         print(json.dumps({
             "metric": ("grad_wire_2proc_tcp" if nproc > 1
                        else "grad_wire_cpu_mesh") + suffix,
@@ -221,8 +300,8 @@ def bench(args, nproc: int, proc_id: int):
             "n_params": int(n_params),
             "world": {"processes": nproc, "devices": dp},
             "steps": args.steps,
-            "value": headline["vs_unfused"],
-            "unit": "x_vs_unfused_dense",
+            "value": headline,
+            "unit": unit,
             **results,
         }), flush=True)
 
@@ -284,6 +363,41 @@ def run_dry(artifact_root: str, steps: int = 2, size: str = "nano",
     return result
 
 
+def run_dry_overlap(artifact_root: str, steps: int = 2, size: str = "nano",
+                    seq: int = 16, outer: int = 2, gas: int = 2):
+    """Tier-1 CPU dry-run of the OVERLAP lanes (the run_dry pattern):
+    runs the serial/overlapped pairs in-process on the suite's virtual
+    mesh — grads/exchange/combine pipeline, exposed-wire counter,
+    bit-identical losses, artifact recording — so comm.overlap can
+    never silently rot.  On the single-process mesh EVERY pair is
+    bitwise (the in-process psum is the ordered fold the combine
+    mirrors); the assert below pins that."""
+    variants = [v for v in overlap_variants(outer, gas=gas)
+                if v[0].startswith(("flat_bf16", "hier_int8"))]
+    results, n_params = measure_variants(variants, steps, size, seq,
+                                         warmup=1)
+    for name, entry in results.items():
+        if name.endswith("_overlap"):
+            assert entry["loss_bitwise_vs_serial"], (name, entry)
+            assert "exposed_ms_per_step" in entry, name
+    import jax
+
+    from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+    result = {
+        "metric": "grad_wire_cpu_mesh_overlap_dryrun",
+        "platform": "cpu",
+        "n_params": int(n_params),
+        "world": {"processes": 1, "devices": jax.device_count()},
+        "steps": steps,
+        "value": results["hier_int8_overlap"]["exposed_wire_frac"],
+        "unit": "exposed_wire_frac_hier_int8",
+        **results,
+    }
+    result["artifact"] = record_bench_result(result, root=artifact_root)
+    return result
+
+
 def _record(out: str):
     """Durable artifact under bench_artifacts/runs/ (PR-2 rule)."""
     try:
@@ -307,6 +421,13 @@ def main():
     ap.add_argument("--hierarchy", action="store_true",
                     help="add the two-level (data_outer x data_inner) "
                          "lanes; processes map to outer groups")
+    ap.add_argument("--overlap", action="store_true",
+                    help="add the comm.overlap serial/overlapped lane "
+                         "pairs (flat/hier x bf16/int8) measuring the "
+                         "exposed-wire fraction")
+    ap.add_argument("--overlap-gas", dest="overlap_gas", type=int,
+                    default=2, help="micro steps per overlap-lane step "
+                                    "(exchange N hides behind micro N+1)")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
     ap.add_argument("--coord", default="")
@@ -332,8 +453,10 @@ def main():
             [sys.executable, os.path.abspath(__file__), "--worker",
              "--proc-id", str(pid), "--coord", coord,
              "--nproc", str(args.nproc), "--steps", str(args.steps),
-             "--size", args.size, "--seq", str(args.seq)]
-            + (["--hierarchy"] if args.hierarchy else []),
+             "--size", args.size, "--seq", str(args.seq),
+             "--overlap-gas", str(args.overlap_gas)]
+            + (["--hierarchy"] if args.hierarchy else [])
+            + (["--overlap"] if args.overlap else []),
             stdout=subprocess.PIPE if pid == 0 else subprocess.DEVNULL,
             stderr=subprocess.STDOUT if pid == 0 else subprocess.DEVNULL,
             env={**os.environ, "JAX_PLATFORMS": "cpu"}))

@@ -44,6 +44,11 @@ def selftest() -> int:
             # per-level section)
             COUNTERS.add("grad_wire.intra", 8192, calls=2)
             COUNTERS.add("grad_wire.inter", 1024, calls=1)
+            # comm/compute overlap: exposed wire µs (the ckpt.stall_ms
+            # µs-in-bytes convention) + qwZ prefetch hits — rendered in
+            # the gradient-wire section, excluded from the byte table
+            COUNTERS.add("grad_wire.exposed_ms", 850, calls=1)
+            COUNTERS.add("qwz.prefetch_hits", 4200, calls=1)
             # input pipeline: host wait (µs in the bytes slot), H2D
             # payload, prefetch queue occupancy — rendered as their own
             # "Input pipeline" section, not comm rows
@@ -99,6 +104,9 @@ def selftest() -> int:
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
+        assert "`grad_wire.exposed_ms`" not in md and \
+            "`qwz.prefetch_hits`" not in md, \
+            "µs-convention wire counters must not leak into the comm table"
         assert "`fault.injected`" not in md and \
             "`watchdog.trips`" not in md, \
             "fault.*/watchdog.* rows must not leak into the comm table"
