@@ -203,7 +203,7 @@ def render_markdown(run: Dict[str, Any]) -> str:
     _WIRE_TIME_COUNTERS = ("grad_wire.exposed_ms", "qwz.prefetch_hits")
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
-                                          "watchdog."))
+                                          "watchdog.", "exchange."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -297,6 +297,22 @@ def render_markdown(run: Dict[str, Any]) -> str:
     if skip:
         res_rows.append(f"| uncommitted checkpoint tags skipped | "
                         f"{skip['calls']:,} |")
+    # overlap-exchange self-healing (runtime/comm/overlap.py): healed
+    # connection drops, replayed frames, and coordinated demotions to
+    # the serial wire — `exchange.resends` bytes are replayed payload
+    recon = any_comm.get("exchange.reconnects")
+    if recon:
+        res_rows.append(f"| exchange connections healed (reconnects) | "
+                        f"{recon['calls']:,} |")
+    rsnd = any_comm.get("exchange.resends")
+    if rsnd:
+        res_rows.append(f"| exchange frames resent after reconnect | "
+                        f"{rsnd['calls']:,} ({rsnd['bytes']:,} B "
+                        f"replayed) |")
+    dem = any_comm.get("exchange.demotions")
+    if dem:
+        res_rows.append(f"| overlap wire demotions to the serial path | "
+                        f"{dem['calls']:,} |")
     wd = run.get("watchdog_trip")
     if wd:
         res_rows.append(f"| last watchdog trip | rank "

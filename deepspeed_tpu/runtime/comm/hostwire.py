@@ -162,6 +162,36 @@ def _kv_set_write_once(client, key: str, value: str, site: str) -> None:
     retry_transient(op, site=f"{site} {key}")
 
 
+def _kv_put_bytes(client, key: str, payload: bytes,
+                  chunk_bytes: int = CHUNK_BYTES) -> None:
+    """Store an arbitrary-size byte payload under `key`, chunked into
+    part keys so a single value never exceeds the KV relay's message
+    envelope (see the scaling-envelope constants above).  The layout
+    (`key/n` part count + `key/{i}` parts) matches HostWire's allgather
+    framing; `_kv_get_bytes` reassembles.  Write-once semantics per
+    part, like every other key on this wire — used by the overlap
+    exchange's KV fallback transport (runtime/comm/overlap.py)."""
+    cb = int(chunk_bytes)
+    nparts = max(1, -(-len(payload) // cb))
+    _kv_set(client, f"{key}/n", str(nparts).encode())
+    for i in range(nparts):
+        _kv_set(client, f"{key}/{i}", payload[i * cb:(i + 1) * cb])
+
+
+def _kv_get_bytes(client, key: str, timeout_ms: int) -> bytes:
+    """Reassemble a `_kv_put_bytes` payload.  One deadline across the
+    part gets (the _kv_get discipline): a dead writer surfaces in
+    ~timeout_ms regardless of payload size."""
+    deadline = time.monotonic() + timeout_ms / 1000.0
+
+    def remaining_ms():
+        return max(1, int((deadline - time.monotonic()) * 1000))
+
+    nparts = int(_kv_get(client, f"{key}/n", remaining_ms()))
+    return b"".join(_kv_get(client, f"{key}/{i}", remaining_ms())
+                    for i in range(nparts))
+
+
 def _kv_get(client, key: str, timeout_ms: int) -> bytes:
     import base64
 

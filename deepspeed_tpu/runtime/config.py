@@ -214,6 +214,34 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
             self.wire_dtype_inner = "fp32"
         self.overlap = parse_comm_overlap(
             get_scalar_param(d, c.COMM_OVERLAP, c.COMM_OVERLAP_DEFAULT))
+
+        def overlap_int(key, default, minimum=1):
+            v = get_scalar_param(d, key, default)
+            try:
+                iv = int(v)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"comm.{key} must be an integer >= {minimum}, "
+                    f"got {v!r}")
+            if iv < minimum:
+                raise ValueError(
+                    f"comm.{key} must be >= {minimum}, got {iv}")
+            return iv
+
+        # the ticket deadline must fire BEFORE the hang watchdog does —
+        # a named exchange timeout beats an anonymous stack snapshot
+        # (StepWatchdog deadline guidance, docs/tutorials/resilience.md)
+        self.overlap_timeout_ms = overlap_int(
+            c.COMM_OVERLAP_TIMEOUT_MS, c.COMM_OVERLAP_TIMEOUT_MS_DEFAULT)
+        self.overlap_reconnect_attempts = overlap_int(
+            c.COMM_OVERLAP_RECONNECT_ATTEMPTS,
+            c.COMM_OVERLAP_RECONNECT_ATTEMPTS_DEFAULT, minimum=0)
+        self.overlap_reconnect_window_ms = overlap_int(
+            c.COMM_OVERLAP_RECONNECT_WINDOW_MS,
+            c.COMM_OVERLAP_RECONNECT_WINDOW_MS_DEFAULT)
+        self.overlap_keepalive_ms = overlap_int(
+            c.COMM_OVERLAP_KEEPALIVE_MS,
+            c.COMM_OVERLAP_KEEPALIVE_MS_DEFAULT)
         self.reduce_bucket_size = int(get_scalar_param(
             d, c.COMM_REDUCE_BUCKET_SIZE, zero_config.reduce_bucket_size))
         block = get_scalar_param(d, c.COMM_QUANT_BLOCK_SIZE,
@@ -626,6 +654,16 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
                 f"checkpoint.{c.CHECKPOINT_COMMIT_TIMEOUT_MS} must be a "
                 f"positive millisecond count, got "
                 f"{self.checkpoint_commit_timeout_ms}")
+        # SIGTERM = save-if-possible (elasticity/supervisor.py): a set
+        # preempt_save_dir arms the engine's signal handler — emergency
+        # checkpoint at the next step boundary, then a clean exit
+        preempt = get_scalar_param(ckpt, c.CHECKPOINT_PREEMPT_SAVE_DIR,
+                                   c.CHECKPOINT_PREEMPT_SAVE_DIR_DEFAULT)
+        if preempt is not None and not isinstance(preempt, str):
+            raise ValueError(
+                f"checkpoint.{c.CHECKPOINT_PREEMPT_SAVE_DIR} must be a "
+                f"directory path string or null, got {preempt!r}")
+        self.checkpoint_preempt_save_dir = preempt
 
         self.sparse_attention = pd.get(c.SPARSE_ATTENTION, None)
         self.vocabulary_size = get_scalar_param(pd, c.VOCABULARY_SIZE,

@@ -119,6 +119,29 @@ COMM_QUANT_BLOCK_SIZE_DEFAULT = 256
 COMM_OVERLAP = "overlap"
 COMM_OVERLAP_DEFAULT = "none"
 COMM_OVERLAP_MODES = ("none", "auto", "on")
+# How long a step may block on one in-flight exchange before the wait
+# fails (ExchangeTicket deadline).  Size BELOW the StepWatchdog
+# deadline (faults.watchdog.deadline_s, default 600 s): the ticket
+# timeout is the named, actionable failure — the watchdog's stack
+# snapshot is the backstop for hangs nobody sized a deadline for.
+COMM_OVERLAP_TIMEOUT_MS = "overlap_timeout_ms"
+COMM_OVERLAP_TIMEOUT_MS_DEFAULT = 300_000
+# Self-healing budget for a dropped exchange connection: dial attempts
+# with bounded exponential backoff (0 = never reconnect, go straight
+# to the KV fallback + coordinated demotion), and the TOTAL time
+# budget on both sides — the dialer's whole redial loop and the
+# accepting side's wait for the peer's re-dial are each bounded by the
+# window, so keep it below overlap_timeout_ms: a blackholed peer must
+# reach the KV fallback before an in-flight ticket deadline fires.
+COMM_OVERLAP_RECONNECT_ATTEMPTS = "overlap_reconnect_attempts"
+COMM_OVERLAP_RECONNECT_ATTEMPTS_DEFAULT = 8
+COMM_OVERLAP_RECONNECT_WINDOW_MS = "overlap_reconnect_window_ms"
+COMM_OVERLAP_RECONNECT_WINDOW_MS_DEFAULT = 60_000
+# Sender-worker keepalive cadence: a dead connection surfaces within
+# ~one interval even between submits (idle wires otherwise only learn
+# about a dead peer at the next data frame).
+COMM_OVERLAP_KEEPALIVE_MS = "overlap_keepalive_ms"
+COMM_OVERLAP_KEEPALIVE_MS_DEFAULT = 5_000
 FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
 
@@ -230,6 +253,13 @@ CHECKPOINT_ASYNC_SAVE = "async_save"
 CHECKPOINT_ASYNC_SAVE_DEFAULT = False
 CHECKPOINT_COMMIT_TIMEOUT_MS = "commit_timeout_ms"
 CHECKPOINT_COMMIT_TIMEOUT_MS_DEFAULT = 300_000
+# Preemption safety: when set, the engine installs a SIGTERM handler
+# honoring the supervisor's "SIGTERM = save-if-possible" contract — an
+# emergency checkpoint is saved into this directory at the next step
+# boundary, committed through the two-phase barrier, and the process
+# exits cleanly so the relaunch resumes from the preemption point.
+CHECKPOINT_PREEMPT_SAVE_DIR = "preempt_save_dir"
+CHECKPOINT_PREEMPT_SAVE_DIR_DEFAULT = None
 
 #############################################
 # Sparse attention

@@ -274,6 +274,8 @@ class DeepSpeedEngine:
         self._step_log_ring = deque()   # deferred steps_per_print scalars
         self.run_monitor = self._init_run_monitor()
         self._watchdog = self._init_resilience()
+        self._register_exchange_watchdog()
+        self._init_preemption()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -370,6 +372,22 @@ class DeepSpeedEngine:
         self._step_log_ring = deque()
         self.run_monitor = self._init_run_monitor()
         self._watchdog = self._init_resilience()
+        self._init_demotion_state()
+        self._init_preemption()
+
+    def _init_demotion_state(self):
+        """Coordinated-demotion state: set when the exchange flags
+        itself broken/demote-requested; consumed at a step boundary
+        (_finish_demotion) once every rank agrees on the step.  Returns
+        the comm config (None when the config has no comm block — every
+        overlap knob then falls back to its constants.py default)."""
+        self._demote_reason = None
+        self._demotion_target = None
+        cc = getattr(self._config, "comm_config", None)
+        self._overlap_timeout_s = (
+            cc.overlap_timeout_ms if cc is not None
+            else const.COMM_OVERLAP_TIMEOUT_MS_DEFAULT) / 1000.0
+        return cc
 
     def _build_mesh(self, config, mpu) -> MeshInfo:
         if isinstance(config, str):
@@ -619,6 +637,101 @@ class DeepSpeedEngine:
             escalate_dir=run_dir or snap_dir,
             poll_s=fc.watchdog_poll_s, rank=comm.get_rank())
 
+    def _init_preemption(self):
+        """Honor the supervisor's "SIGTERM = save-if-possible" contract
+        (elasticity/supervisor.py sends SIGTERM first, SIGKILL after
+        --grace): with `checkpoint.preempt_save_dir` configured, a
+        SIGTERM sets a flag the step boundary consumes — emergency
+        checkpoint into that directory, committed through the two-phase
+        barrier, then a clean exit so the relaunch resumes from the
+        preemption point instead of the last periodic save."""
+        self._preempt_requested = False
+        self._prev_sigterm = None
+        self._preempt_save_dir = getattr(
+            self._config, "checkpoint_preempt_save_dir", None)
+        if not self._preempt_save_dir:
+            return
+        import signal
+
+        def handler(signum, frame):
+            # async-signal context: flag + log only — the save itself
+            # runs on the training thread at the next step boundary,
+            # where the engine state is committed and consistent
+            self._preempt_requested = True
+            logger.warning(
+                "SIGTERM received: emergency checkpoint will be saved "
+                f"to {self._preempt_save_dir} at the next step boundary, "
+                "then this process exits cleanly")
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, handler)
+            log_dist(
+                "preemption safety armed: SIGTERM checkpoints to "
+                f"{self._preempt_save_dir} at the next step boundary",
+                ranks=[0])
+        except ValueError:
+            # signal handlers install only on the main thread
+            self._prev_sigterm = None
+            logger.warning(
+                "checkpoint.preempt_save_dir is set but this engine was "
+                "constructed off the main thread, where signal handlers "
+                "cannot install — SIGTERM preemption checkpointing is "
+                "DISABLED; call engine.request_preemption_checkpoint() "
+                "from your own handler instead")
+
+    def _uninstall_preemption_handler(self):
+        if getattr(self, "_prev_sigterm", None) is None:
+            return
+        import signal
+
+        try:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+        except ValueError:
+            pass
+        self._prev_sigterm = None
+
+    def request_preemption_checkpoint(self):
+        """Programmatic twin of the SIGTERM handler: the next step
+        boundary saves the emergency checkpoint and exits cleanly.
+        For schedulers that deliver preemption out of band (k8s grace
+        hooks, custom signal multiplexers)."""
+        self._preempt_requested = True
+
+    @property
+    def preemption_requested(self) -> bool:
+        return bool(getattr(self, "_preempt_requested", False))
+
+    def _maybe_preempt_checkpoint(self):
+        """Step-boundary tail of the SIGTERM contract: save, commit,
+        exit.  Runs on the training thread with the engine at a clean
+        post-step state — the saved tag resumes bitwise."""
+        if not getattr(self, "_preempt_requested", False):
+            return
+        self._preempt_requested = False
+        save_dir = getattr(self, "_preempt_save_dir", None)
+        if not save_dir:
+            logger.warning(
+                "preemption checkpoint requested but no "
+                "checkpoint.preempt_save_dir is configured — continuing "
+                "WITHOUT saving (the relaunch resumes from the last "
+                "periodic checkpoint)")
+            return
+        tag = f"preempt_step{self.global_steps}"
+        logger.warning(
+            f"preemption: saving emergency checkpoint {tag!r} to "
+            f"{save_dir} (step {self.global_steps})")
+        self.save_checkpoint(save_dir, tag=tag)
+        # an async save must COMMIT before the process may exit — the
+        # flush blocks on the background writers and the two-phase
+        # commit barrier, so an interrupted flush can never leave a
+        # half-written resume point (uncommitted tags are skipped)
+        ckpt_io.flush_pending()
+        logger.warning(
+            f"preemption: checkpoint {tag!r} committed; exiting cleanly "
+            "for the supervisor/scheduler to relaunch")
+        self.finalize_monitoring()
+        raise SystemExit(0)
+
     def _maybe_monitor_flops(self, fn, *args, per_step_mult=1.0):
         """Resolve flops-per-step ONCE via the flops profiler's cost
         analysis (AOT lowering against the jit cache); the monitor then
@@ -693,6 +806,7 @@ class DeepSpeedEngine:
         self._drain_step_log(force=True)
         self.close_data_pipeline()
         self.close_overlap()
+        self._uninstall_preemption_handler()
         ckpt_io.flush_pending()
         if getattr(self, "_watchdog", None) is not None:
             self._watchdog.stop()
@@ -890,13 +1004,30 @@ class DeepSpeedEngine:
         self._overlap_pending = []
         self._qwz_prefetch = None
         self._qwz_cparams_cache = None
+        cc = self._init_demotion_state()
         if self._overlap_mode is None:
             return
         from .comm.overlap import make_exchange
 
         dp = self.mesh_info.axis_size(DATA_AXIS)
         if exchange is None:
-            self._overlap_exchange = make_exchange(dp)
+            # same None fallback as _init_demotion_state: a config
+            # without a comm block still builds a working exchange
+            keepalive_ms = (
+                cc.overlap_keepalive_ms if cc is not None
+                else const.COMM_OVERLAP_KEEPALIVE_MS_DEFAULT)
+            attempts = (
+                cc.overlap_reconnect_attempts if cc is not None
+                else const.COMM_OVERLAP_RECONNECT_ATTEMPTS_DEFAULT)
+            window_ms = (
+                cc.overlap_reconnect_window_ms if cc is not None
+                else const.COMM_OVERLAP_RECONNECT_WINDOW_MS_DEFAULT)
+            self._overlap_exchange = make_exchange(
+                dp,
+                keepalive_s=keepalive_ms / 1000.0,
+                reconnect_attempts=attempts,
+                reconnect_window_s=window_ms / 1000.0)
+            self._register_exchange_watchdog()
         self._overlap_matrix_sharding = NamedSharding(
             self.mesh_info.mesh, PartitionSpec())
         if self._overlap_mode == "wire":
@@ -952,6 +1083,7 @@ class DeepSpeedEngine:
         gradients into the accumulator in micro order — bit-identical
         to the serial wire's per-micro reduction order."""
         pending = self._overlap_pending
+        self._check_overlap_health()
         if not pending:
             return
         if "combine" not in self._step_fns:
@@ -968,7 +1100,7 @@ class DeepSpeedEngine:
         while pending:
             ticket = pending[0]
             before = ticket.wait_us
-            mat = ticket.wait()
+            mat = ticket.wait(self._overlap_timeout_s)
             exposed_us += ticket.wait_us - before
             mdev = jax.device_put(mat, self._overlap_matrix_sharding)
             # combine dispatches are async: the NEXT ticket's wire wait
@@ -982,11 +1114,122 @@ class DeepSpeedEngine:
             pending.pop(0)
             self._retire_ticket(ticket)
         COUNTERS.add("grad_wire.exposed_ms", int(exposed_us), calls=1)
+        self._check_overlap_health()
 
     def _retire_ticket(self, ticket):
         retire = getattr(self._overlap_exchange, "retire", None)
         if retire is not None:
             retire(ticket)
+
+    def _check_overlap_health(self):
+        """Record a demotion request surfaced by the exchange (reconnect
+        budget exhausted, a peer's DEMOTE broadcast, or an injected
+        send-side fault with nothing lost).  The request is CONSUMED at
+        the next step boundary by _finish_demotion — mid-accumulation
+        the exchange keeps serving (its KV fallback transport stays
+        bitwise), so nothing here can change training math."""
+        ex = self._overlap_exchange
+        if ex is None or self._demote_reason is not None:
+            return
+        # while the exchange is unhealthy, probe the KV demote-pending
+        # flag too — a peer whose conn to us died may already be in KV
+        # mode, and its DEMOTE frame never reached us
+        poll = getattr(ex, "poll_peer_demotion", None)
+        if poll is not None:
+            poll()
+        if getattr(ex, "demote_requested", False):
+            broken = getattr(ex, "broken", None)
+            self._demote_reason = (
+                f"{type(broken).__name__}: {broken}" if broken is not None
+                else "a peer requested demotion")
+            logger.warning(
+                "comm.overlap: the host exchange requested coordinated "
+                f"demotion ({self._demote_reason}); the serial in-program "
+                "wire takes over at the next agreed step boundary")
+
+    def _predispatch_demotion(self):
+        """Consume a pending coordinated demotion BEFORE dispatching the
+        next step's programs.  A peer that flagged demotion parks in the
+        demotion barrier at its own step boundary and never joins this
+        step's in-program collectives — a rank that dispatches first
+        blocks inside a psum until the barrier timeout (observed on the
+        2-proc TCP campaign: one rank waiting in agree_demotion_step,
+        the other stuck in its forward program).  The pre-forward point
+        of a fresh accumulation window IS a step boundary, so finishing
+        the demotion here is the same clean state step() uses;
+        mid-accumulation the boundary in step() still owns it."""
+        if self._demote_reason is None:
+            return
+        if self._overlap_pending or \
+                self.micro_steps % self.gradient_accumulation_steps() != 0:
+            return
+        self._finish_demotion()
+
+    def _finish_demotion(self):
+        """Coordinated demotion endgame, run at a step boundary (after
+        the apply): agree with every rank on the demotion step through
+        the exchange's KV barrier (max of the boundaries reached — a
+        rank behind the max keeps training over the KV fallback until
+        it gets there), then tear the exchange down and rebuild the
+        step programs through StepBuilder on the serial in-program
+        wire.  Losses stay bitwise: the overlapped and serial wires are
+        reduction-math-identical (pinned since PR 9), and every
+        in-flight exchange was drained before this runs."""
+        if self._demote_reason is None:
+            return
+        ex = self._overlap_exchange
+        if ex is None:
+            self._demote_reason = None
+            return
+        if self._demotion_target is None or \
+                self.global_steps >= self._demotion_target:
+            # re-enter the (non-parking) agreement every boundary until
+            # it settles: None = some rank has not voted yet, a higher
+            # value = keep training to the agreed step on the degraded
+            # transport, then the arrival barrier at the target returns
+            # the final step every rank demotes at together
+            timeout_ms = max(1, int(self._overlap_timeout_s * 1000))
+            agreed = ex.agree_demotion_step(
+                self.global_steps, timeout_ms=timeout_ms)
+            if agreed is None:
+                return
+            if agreed != self._demotion_target:
+                self._demotion_target = agreed
+                if agreed > self.global_steps:
+                    log_dist(
+                        "comm.overlap demotion: ranks agreed on step "
+                        f"{agreed}; this rank (at step "
+                        f"{self.global_steps}) continues on the KV "
+                        "fallback transport until then", ranks=[0])
+        if self.global_steps < self._demotion_target:
+            return
+        reason = self._demote_reason
+        COUNTERS.add("exchange.demotions")
+        logger.warning(
+            f"comm.overlap DEMOTED at step {self.global_steps}: {reason} "
+            "— the host exchange is torn down and the step programs are "
+            "rebuilt on the serial in-program wire (losses stay bitwise; "
+            "the overlap win is forfeited until the next engine build)")
+        self.close_overlap()
+        self._overlap_exchange = None
+        self._overlap_mode = None
+        self._qwz_overlap = None
+        self._qwz_prefetch = None
+        self._qwz_cparams_cache = None
+        self._overlap_pending = []
+        self._demote_reason = None
+        self._demotion_target = None
+        self._demoted_reason = reason  # step_builder's schedule log
+        self._step_fns = self._build_step_fns()
+
+    def _register_exchange_watchdog(self):
+        """Name the exchange's service threads in the StepWatchdog's
+        stall snapshot: a hung exchange then reads as 'overlap_exchange'
+        with its receiver/sender liveness, not an anonymous stall."""
+        wd = getattr(self, "_watchdog", None)
+        ex = getattr(self, "_overlap_exchange", None)
+        if wd is not None and ex is not None and hasattr(ex, "threads"):
+            wd.register_threads("overlap_exchange", ex.threads)
 
     def _qwz_kick_prefetch(self):
         """Dispatch the NEXT step's quantized parameter gather right
@@ -995,6 +1238,12 @@ class DeepSpeedEngine:
         then runs behind the step's host-side tail (bookkeeping, input
         pipeline) and the next forward's dispatch."""
         if self._qwz_overlap is None:
+            return
+        if self._demote_reason is not None:
+            # demotion pending: don't feed the dying exchange new work —
+            # the serial gather takes over after the rebuild (bitwise)
+            self._qwz_cparams_cache = None
+            self._qwz_prefetch = None
             return
         encode, _decode = self._qwz_overlap
         self._qwz_cparams_cache = None
@@ -1010,13 +1259,15 @@ class DeepSpeedEngine:
         on demand."""
         if self._qwz_overlap is None:
             return None
+        self._check_overlap_health()
         cache = self._qwz_cparams_cache
         if cache is not None and cache[0] is self._params:
             return cache[1]
         encode, decode = self._qwz_overlap
         pre = self._qwz_prefetch
         self._qwz_prefetch = None
-        if pre is not None and pre[0] is self._params:
+        prefetched = pre is not None and pre[0] is self._params
+        if prefetched:
             ticket = pre[1]
         else:
             if pre is not None:
@@ -1027,11 +1278,16 @@ class DeepSpeedEngine:
             ticket = self._overlap_submit(encode(self._params))
         import time as _time
 
-        if ticket.ready and ticket.done_at is not None:
+        # only a PREFETCHED ticket can score a hit: an on-demand
+        # submit can also be ready by now (the worker posts local
+        # blocks before the network send), but that is a race artifact,
+        # not a head start
+        if prefetched and ticket.ready and ticket.done_at is not None:
             head_us = int((_time.perf_counter() - ticket.done_at) * 1e6)
             COUNTERS.add("qwz.prefetch_hits", max(0, head_us), calls=1)
-        mat = ticket.wait()
+        mat = ticket.wait(self._overlap_timeout_s)
         self._retire_ticket(ticket)
+        self._check_overlap_health()
         mdev = jax.device_put(mat, self._overlap_matrix_sharding)
         cparams = decode(self._params, mdev)
         self._qwz_cparams_cache = (self._params, cparams)
@@ -1043,6 +1299,11 @@ class DeepSpeedEngine:
         ex = getattr(self, "_overlap_exchange", None)
         if ex is not None:
             ex.close()
+            # the watchdog's group closure would otherwise keep the
+            # closed exchange (and its payload buffers) alive forever
+            wd = getattr(self, "_watchdog", None)
+            if wd is not None:
+                wd.unregister_threads("overlap_exchange")
 
     def _use_onebit_comm(self) -> bool:
         """True when the optimizer's own (compressed) DP reduction runs in
@@ -1223,6 +1484,9 @@ class DeepSpeedEngine:
 
         gas==1 fast path: the whole step (fwd+bwd+optimizer+scaler) runs as
         one fused program here; step() then only does host bookkeeping."""
+        if self._overlap_exchange is not None:
+            self._check_overlap_health()
+            self._predispatch_demotion()
         rm = self.run_monitor
         if rm is not None and self.is_gradient_accumulation_boundary():
             rm.step_start(self.global_steps)
@@ -1292,6 +1556,7 @@ class DeepSpeedEngine:
         reduction math expression for expression."""
         if self.is_gradient_accumulation_boundary():
             self.tput_timer.start()  # times one full global batch
+        self._check_overlap_health()
         batch = self._shard_batch(batch)
         rng = rng if rng is not None else self._next_rng()
         theta = jnp.asarray(
@@ -1550,9 +1815,20 @@ class DeepSpeedEngine:
         if self._watchdog is not None:
             self._watchdog.beat(self.global_steps)
         if self._offload is not None:
-            return self._offload_step()
-        if getattr(self, "_pending_full", None) is not None:
-            return self._fused_step_bookkeeping()
+            out = self._offload_step()
+        elif getattr(self, "_pending_full", None) is not None:
+            out = self._fused_step_bookkeeping()
+        else:
+            out = self._boundary_step()
+        # boundary tail: the engine is at a clean post-step state here —
+        # the only point where a coordinated demotion may rebuild the
+        # step programs and where a SIGTERM'd run can checkpoint + exit
+        self._finish_demotion()
+        self._maybe_preempt_checkpoint()
+        return out
+
+    def _boundary_step(self):
+        """The split/overlap boundary body: drain, apply, bookkeeping."""
         if self._wall_clock_breakdown:
             self.timers("step").start()
         rsp = (self.run_monitor.span("step")
@@ -1863,6 +2139,9 @@ class DeepSpeedEngine:
         return jnp.mean(jnp.stack(losses))
 
     def _scan_train_batch(self, data_iter, feed=None):
+        if self._overlap_exchange is not None:
+            self._check_overlap_health()
+            self._predispatch_demotion()
         gas = self.gradient_accumulation_steps()
         if feed is not None:
             tag, payload = feed.next()

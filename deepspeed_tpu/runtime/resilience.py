@@ -590,6 +590,7 @@ class StepWatchdog:
         self._last_step: Optional[int] = None
         self._tripped = False
         self._trips = 0
+        self._thread_groups: Dict[str, Callable[[], list]] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="dstpu-watchdog", daemon=True)
@@ -607,6 +608,39 @@ class StepWatchdog:
     @property
     def trips(self) -> int:
         return self._trips
+
+    def register_threads(self, group: str, threads_fn) -> None:
+        """Register a named group of service threads (`threads_fn()` ->
+        live threading.Thread list) whose liveness the trip snapshot
+        reports explicitly — e.g. the overlap exchange's sender/
+        receiver threads, so a hung exchange reads as 'exchange' in the
+        snapshot instead of an anonymous 300 s stall.  Re-registering a
+        group replaces it; a dead provider is dropped silently (the
+        snapshot must never crash the watchdog)."""
+        with self._lock:
+            self._thread_groups[group] = threads_fn
+
+    def unregister_threads(self, group: str) -> None:
+        """Drop a registered thread group — the provider closure holds
+        its owner alive, so tearing a service down (e.g. a demoted
+        overlap exchange) must unregister or the watchdog pins the
+        dead object (and its buffers) for the rest of the process."""
+        with self._lock:
+            self._thread_groups.pop(group, None)
+
+    def _thread_group_report(self) -> Dict[str, Any]:
+        with self._lock:
+            groups = dict(self._thread_groups)
+        report = {}
+        for name, fn in groups.items():
+            try:
+                report[name] = [
+                    {"name": t.name, "alive": t.is_alive(),
+                     "daemon": t.daemon, "ident": t.ident}
+                    for t in fn()]
+            except Exception as e:
+                report[name] = [{"error": f"{type(e).__name__}: {e}"}]
+        return report
 
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
@@ -644,6 +678,7 @@ class StepWatchdog:
             "unix_time": time.time(),
             "counters": COUNTERS.totals(),
             "stacks": _all_stacks(),
+            "thread_groups": self._thread_group_report(),
         }
         snap_path = os.path.join(
             self.snapshot_dir,

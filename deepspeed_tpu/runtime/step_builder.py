@@ -485,7 +485,7 @@ class StepBuilder:
             fns["grads"] = self._counted(grads_fn, plan=wire_plan,
                                          wire_events=1)
             fns["combine"] = self._counted(combine_fn)
-            log_dist(schedule.describe(), ranks=[0])
+            log_dist(self._describe(schedule), ranks=[0])
             return fns
 
         donate_micro = jax.jit(micro_step, donate_argnums=(1,))
@@ -506,5 +506,17 @@ class StepBuilder:
                 jax.jit(scan_batch_step, donate_argnums=(0, 1)),
                 plan=wire_plan, wire_events=gas, qwz=qwz_int,
                 qwz_events=1)
-        log_dist(schedule.describe(), ranks=[0])
+        log_dist(self._describe(schedule), ranks=[0])
         return fns
+
+    def _describe(self, schedule: StepSchedule) -> str:
+        """Schedule log line, annotated when this build is the SERIAL
+        rebuild after a coordinated runtime demotion of the overlap
+        wire — a demoted run's logs must say why its schedule changed
+        mid-run, not just that it did."""
+        desc = schedule.describe()
+        demoted = getattr(self.engine, "_demoted_reason", None)
+        if demoted:
+            desc += (" [rebuilt on the serial wire by runtime demotion: "
+                     f"{demoted}]")
+        return desc

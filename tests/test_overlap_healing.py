@@ -1,0 +1,499 @@
+"""Self-healing overlap wire + preemption-safe SIGTERM checkpointing.
+
+Covers (runtime/comm/overlap.py + engine/resilience/config):
+* SocketExchange reconnect-with-backoff and the seq-tagged resend
+  buffer over REAL sockets (two instances in-process over a fake
+  coordination KV, like HostWire's fast-tier tests): a dropped
+  connection heals, unacked frames replay, payloads stay bitwise,
+  `exchange.reconnects`/`exchange.resends` count;
+* CRC-caught frame corruption (the `exchange.payload` chaos site)
+  becoming a connection fault the resend path heals;
+* the KV fallback transport + `agree_demotion_step` barrier when the
+  reconnect budget is exhausted;
+* engine-level coordinated demotion: step programs rebuild through
+  StepBuilder on the serial wire MID-RUN with bitwise losses/params,
+  `exchange.demotions` pinned, and the rebuilt schedule log naming the
+  demotion reason;
+* a single transient send fault is absorbed by retry_transient and
+  must NOT demote;
+* SIGTERM = save-if-possible: the engine's handler commits an
+  emergency checkpoint at the next step boundary, exits cleanly, and
+  the tag resumes with exact loss/param parity (plus the programmatic
+  `request_preemption_checkpoint` twin and the no-dir warning path);
+* the `comm.overlap_timeout_ms` / reconnect-budget config knobs
+  (validated at config time, consumed by the engine's ticket waits);
+* StepWatchdog thread-group registration: a stall snapshot names the
+  exchange's sender/receiver threads instead of an anonymous hang;
+* the chaos_bench --overlap CPU dry-run (tier-1 anti-rot) and the slow
+  2-proc TCP campaign (reconnect + demotion + preemption lanes).
+"""
+
+import importlib
+import logging
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.monitor.counters import COUNTERS
+from deepspeed_tpu.runtime import resilience
+from deepspeed_tpu.runtime.comm.overlap import SocketExchange
+
+from tests.simple_model import SimpleModel, random_batches
+from tests.test_hostwire import FakeCoordClient
+
+BASE_COMM = {"gradient_reduction": "bucketed", "reduce_bucket_size": 128}
+
+
+class _LogCapture(logging.Handler):
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+@pytest.fixture
+def ds_log():
+    lg = logging.getLogger("deepspeed_tpu")
+    h = _LogCapture()
+    lg.addHandler(h)
+    try:
+        yield h
+    finally:
+        lg.removeHandler(h)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_fault_plan():
+    yield
+    resilience.install_fault_plan(None)
+    resilience.install_retry_policy(None)
+
+
+# ---------------------------------------------------------------------------
+# socket transport: reconnect / resend / KV fallback over real sockets
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def make_pair():
+    """Build two SocketExchange instances in-process (pids 0/1 over one
+    FakeCoordClient) — the REAL socket mesh, rendezvous and all, with
+    no jax.distributed processes."""
+    made = []
+
+    def make(**kw):
+        client = FakeCoordClient(2)
+        exes = [None, None]
+        errors = []
+        kw.setdefault("keepalive_s", 0.2)
+
+        def build(pid):
+            try:
+                exes[pid] = SocketExchange(
+                    2, tag="heal", host="127.0.0.1",
+                    _endpoint=(client, pid, 2), **kw)
+            except BaseException as e:  # noqa: BLE001 — surface below
+                errors.append((pid, e))
+
+        ts = [threading.Thread(target=build, args=(p,)) for p in (0, 1)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errors, errors
+        made.extend(exes)
+        return exes
+
+    yield make
+    for ex in made:
+        if ex is not None:
+            ex.close()
+
+
+def _exchange_round(exes, tag):
+    """One full exchange on both instances; asserts the rank-ordered
+    matrix is bitwise the submitted payloads on BOTH sides."""
+    tickets = []
+    for pid in (0, 1):
+        data = np.full(8, 10 * tag + pid, dtype=np.uint8)
+        tickets.append(exes[pid].submit([(pid, lambda d=data: d)]))
+    want = np.stack([np.full(8, 10 * tag + r, dtype=np.uint8)
+                     for r in (0, 1)])
+    for pid in (0, 1):
+        mat = tickets[pid].wait(30.0)
+        assert (mat == want).all(), (pid, tag, mat)
+        exes[pid].retire(tickets[pid])
+
+
+def _wait_quiescent(exes, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while any(ex._unacked for ex in exes) and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+
+
+def test_socket_reconnect_replays_unacked_frames(make_pair):
+    exes = make_pair()
+    snap = COUNTERS.snapshot()
+    _exchange_round(exes, 0)
+    _wait_quiescent(exes)
+    # connection reset: tear the live conn down from pid 1's side —
+    # pid 1 re-dials with backoff, pid 0 re-accepts, both replay
+    exes[1]._conns[0].sock.close()
+    _exchange_round(exes, 1)
+    _exchange_round(exes, 2)
+    _wait_quiescent(exes)
+    d = COUNTERS.delta_since(snap)
+    # one healed drop = one reconnect per side
+    assert d["exchange.reconnects"]["calls"] == 2, d
+    assert d["exchange.resends"]["calls"] >= 1, d
+    assert d["exchange.resends"]["bytes"] >= 8, d
+    assert not exes[0].demote_requested and not exes[1].demote_requested
+
+
+def test_socket_corrupt_frame_caught_by_crc_and_healed(make_pair):
+    exes = make_pair()
+    _exchange_round(exes, 0)
+    _wait_quiescent(exes)
+    snap = COUNTERS.snapshot()
+    # one truncated payload: the CRC turns it into a connection fault,
+    # the reconnect+resend path re-delivers the INTACT frame
+    resilience.install_fault_plan(resilience.FaultPlan([
+        resilience.FaultRule(site="exchange.payload", kind="corrupt",
+                             truncate_to=3, times=1)]))
+    _exchange_round(exes, 1)
+    _wait_quiescent(exes)
+    d = COUNTERS.delta_since(snap)
+    assert d["fault.injected"]["calls"] == 1, d
+    assert d["exchange.reconnects"]["calls"] == 2, d
+    assert d["exchange.resends"]["calls"] >= 1, d
+
+
+def test_socket_kv_fallback_and_demotion_barrier(make_pair):
+    exes = make_pair(reconnect_attempts=0, reconnect_window_s=1.0)
+    _exchange_round(exes, 0)
+    _wait_quiescent(exes)
+    snap = COUNTERS.snapshot()
+    for ex in exes:
+        for c in list(ex._conns.values()):
+            c.sock.close()
+    # with a zeroed reconnect budget the exchange must still SERVE the
+    # payloads — through the coordination-KV fallback — while flagging
+    # coordinated demotion
+    _exchange_round(exes, 1)
+    deadline = time.monotonic() + 15
+    while not (exes[0].demote_requested and exes[1].demote_requested):
+        assert time.monotonic() < deadline, "demotion never flagged"
+        time.sleep(0.02)
+    assert exes[0]._kv_mode and exes[1]._kv_mode
+    # the non-parking demotion agreement: votes 5 and 6 -> target
+    # max+1 = 7; each rank "trains" to the target, then the arrival
+    # barrier settles on the same final step for both
+    agreed = [None, None]
+
+    def agree(pid):
+        b = 5 + pid
+        while True:
+            t = exes[pid].agree_demotion_step(b, timeout_ms=15_000)
+            if t is None:
+                time.sleep(0.01)  # peer has not voted yet
+                continue
+            if b >= t:
+                agreed[pid] = t
+                return
+            b = t  # keep "training" to the agreed step
+
+    ts = [threading.Thread(target=agree, args=(p,)) for p in (0, 1)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=20)
+    assert agreed == [7, 7], agreed
+    assert not COUNTERS.delta_since(snap).get("exchange.demotions"), \
+        "the exchange itself must not count demotions — the engine " \
+        "does, once, when it tears down and rebuilds"
+
+
+def test_socket_redial_bounded_by_window_not_attempts(make_pair):
+    """A blackholed/closed peer must exhaust the redial budget within
+    ~reconnect_window_s — NOT attempts x connect-timeout, which can
+    exceed the ticket deadline — and land in the KV fallback."""
+    exes = make_pair(reconnect_attempts=50, reconnect_window_s=1.5)
+    _exchange_round(exes, 0)
+    _wait_quiescent(exes)
+    # take pid 0 away for good: its listener closes and never rebinds,
+    # so pid 1's redials fail until the window expires
+    exes[0].close()
+    start = time.monotonic()
+    deadline = start + 20
+    while not exes[1].demote_requested:
+        assert time.monotonic() < deadline, \
+            "redial loop was not bounded by the reconnect window"
+        time.sleep(0.05)
+    # 50 attempts of backoff alone would take minutes; the window
+    # bounds the whole loop (generous slack for a loaded CI box)
+    assert time.monotonic() - start < 15
+    assert exes[1]._kv_mode
+
+
+def test_socket_init_failure_leaks_nothing(monkeypatch):
+    """A half-built mesh (peer never dials in) must tear down its
+    accept loop, bound listener, and any installed conns on the raise
+    path — a supervisor retrying initialize in-process must not
+    accumulate leaked service threads."""
+    from deepspeed_tpu.runtime.comm import overlap as ovl
+
+    monkeypatch.setattr(ovl, "_ACCEPT_TIMEOUT_S", 0.5)
+    client = FakeCoordClient(2)
+    # delta, not the global set: earlier tests may have abandoned
+    # wedged receivers (close() logs and leaves them by design)
+    before = {t.ident for t in threading.enumerate()}
+    with pytest.raises(TimeoutError, match="never dialed in"):
+        SocketExchange(2, tag="leak", host="127.0.0.1",
+                       _endpoint=(client, 0, 2))
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        alive = [t.name for t in threading.enumerate()
+                 if t.ident not in before
+                 and t.name.startswith("dstpu-overlap")]
+        if not alive:
+            break
+        time.sleep(0.05)
+    assert not alive, f"leaked exchange threads: {alive}"
+
+
+# ---------------------------------------------------------------------------
+# engine: coordinated demotion + transient absorption
+# ---------------------------------------------------------------------------
+
+
+def _make(comm=None, gas=1, **cfg_extra):
+    cfg = {
+        "train_batch_size": 32 * gas,
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+        "mesh": {"data": 8},
+        "steps_per_print": 0,
+    }
+    if comm is not None:
+        cfg["comm"] = comm
+    cfg.update(cfg_extra)
+    engine, *_ = ds.initialize(model=SimpleModel(hidden_dim=16),
+                               config_params=cfg)
+    return engine
+
+
+def _train(engine, gas=1, steps=6, seed=3, scan=False):
+    it = random_batches(steps * gas, batch_size=32, seed=seed)
+    losses = []
+    if scan:
+        for _ in range(steps):
+            losses.append(float(engine.train_batch(it)))
+    else:
+        for _ in range(steps * gas):
+            loss = engine.forward(next(it))
+            engine.backward()
+            engine.step()
+            losses.append(float(loss))
+    params = [np.asarray(x) for x in
+              jax.tree_util.tree_leaves(engine.params)]
+    engine.finalize_monitoring()
+    return losses, params
+
+
+def _assert_bitwise(a, b, ctx=""):
+    assert a[0] == b[0], (ctx, a[0], b[0])
+    for x, y in zip(a[1], b[1]):
+        assert (x == y).all(), (ctx, float(np.abs(x - y).max()))
+
+
+# one variant only (scan/gas=2 — the composition the chaos dry-run's
+# demotion lane does NOT cover; it runs the fused/split path): tier-1
+# wall-clock is budgeted, and the dry-run already pins the fused lane
+@pytest.mark.parametrize("scan,gas", [(True, 2)])
+def test_engine_demotion_rebuilds_serial_bitwise(ds_log, scan, gas):
+    steps = 6
+    serial = _train(_make(comm=dict(BASE_COMM, overlap="none"), gas=gas),
+                    gas=gas, steps=steps, scan=scan)
+    snap = COUNTERS.snapshot()
+    eng = _make(comm=dict(BASE_COMM, overlap="auto"), gas=gas,
+                faults={"rules": [{"site": "exchange.send",
+                                   "kind": "raise",
+                                   "steps": list(range(3, steps + 1))}]})
+    assert "grads" in eng._step_fns
+    demoted = _train(eng, gas=gas, steps=steps, scan=scan)
+    d = COUNTERS.delta_since(snap)
+    _assert_bitwise(serial, demoted, ctx=("demotion", scan))
+    assert d.get("exchange.demotions", {}).get("calls") == 1, d
+    # demotion tore the exchange down: the engine runs serial now
+    assert eng._overlap_mode is None and "grads" not in eng._step_fns
+    # the rebuilt schedule log must SAY why the schedule changed mid-run
+    msgs = [r.getMessage() for r in ds_log.records]
+    assert any("rebuilt on the serial wire by runtime demotion" in m
+               for m in msgs), msgs
+    assert any("DEMOTED" in r.getMessage()
+               and r.levelno >= logging.WARNING
+               for r in ds_log.records), msgs
+
+
+# ---------------------------------------------------------------------------
+# SIGTERM preemption checkpointing
+# ---------------------------------------------------------------------------
+# The real-signal save+commit+resume path (and the transient-fault
+# absorption lane) live in the chaos dry-run below — run_dry_overlap's
+# preempt/transient lanes assert them with exact parity, so only the
+# engine surfaces the dry-run can't reach are pinned here.
+
+
+def test_request_preemption_checkpoint_programmatic(tmp_path):
+    eng = _make(comm=dict(BASE_COMM, overlap="auto"),
+                checkpoint={"preempt_save_dir": str(tmp_path)})
+    it = random_batches(3, batch_size=32, seed=3)
+    eng.forward(next(it))
+    eng.backward()
+    eng.step()
+    eng.request_preemption_checkpoint()
+    assert eng.preemption_requested
+    eng.forward(next(it))
+    eng.backward()
+    with pytest.raises(SystemExit) as e:
+        eng.step()
+    assert e.value.code == 0
+    from deepspeed_tpu.runtime.checkpointing import read_latest_tag
+
+    assert read_latest_tag(str(tmp_path)) == "preempt_step2"
+    # the clean-exit path restored the previous SIGTERM disposition
+    assert eng._prev_sigterm is None
+
+
+def test_preemption_without_dir_warns_and_continues(ds_log):
+    eng = _make(comm=dict(BASE_COMM, overlap="auto"))
+    it = random_batches(2, batch_size=32, seed=3)
+    eng.request_preemption_checkpoint()
+    eng.forward(next(it))
+    eng.backward()
+    eng.step()  # must NOT exit — no preempt_save_dir is configured
+    assert any("WITHOUT saving" in r.getMessage()
+               and r.levelno >= logging.WARNING
+               for r in ds_log.records), \
+        [r.getMessage() for r in ds_log.records]
+    assert not eng.preemption_requested
+    eng.finalize_monitoring()
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("key,bad", [
+    ("overlap_timeout_ms", 0),
+    ("overlap_timeout_ms", "soon"),
+    ("overlap_reconnect_attempts", -1),
+    ("overlap_reconnect_window_ms", 0),
+    ("overlap_keepalive_ms", "fast"),
+])
+def test_overlap_knob_validation_names_key(key, bad):
+    with pytest.raises(ValueError) as e:
+        _make(comm=dict(BASE_COMM, overlap="auto", **{key: bad}))
+    assert key in str(e.value), str(e.value)
+
+
+def test_overlap_timeout_flows_to_ticket_wait():
+    eng = _make(comm=dict(BASE_COMM, overlap="auto",
+                          overlap_timeout_ms=120_000))
+    assert eng._overlap_timeout_s == 120.0
+    eng.finalize_monitoring()
+
+
+def test_preempt_save_dir_must_be_string():
+    with pytest.raises(ValueError, match="preempt_save_dir"):
+        _make(checkpoint={"preempt_save_dir": 7})
+
+
+# ---------------------------------------------------------------------------
+# watchdog sees the exchange threads
+# ---------------------------------------------------------------------------
+
+
+def test_watchdog_snapshot_names_exchange_threads():
+    eng = _make(comm=dict(BASE_COMM, overlap="auto"),
+                faults={"watchdog": {"enabled": True,
+                                     "deadline_s": 600.0}})
+    assert "overlap_exchange" in eng._watchdog._thread_groups
+    it = random_batches(1, batch_size=32, seed=3)
+    eng.forward(next(it))
+    eng.backward()
+    eng.step()
+    report = eng._watchdog._thread_group_report()
+    names = [t["name"] for t in report["overlap_exchange"]]
+    assert any(n.startswith("dstpu-overlap") for n in names), report
+    eng.finalize_monitoring()
+
+
+# ---------------------------------------------------------------------------
+# chaos_bench --overlap: tier-1 dry-run + slow 2-proc TCP campaign
+# ---------------------------------------------------------------------------
+
+
+def _import_tool(name):
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_chaos_overlap_dry_run(tmp_path):
+    """Tier-1 cover for the --overlap CPU campaign: serial/overlap/
+    transient/demotion/preemption lanes assert bitwise parity and
+    pinned counters internally; here we pin the recorded artifact."""
+    bench = _import_tool("chaos_bench")
+    result = bench.run_dry_overlap(artifact_root=str(tmp_path / "runs"),
+                                   steps=6, record=True,
+                                   root=str(tmp_path / "scratch"))
+    assert result["loss_parity"] == "exact"
+    assert result["demotions"] == 1
+    assert result["transient_absorbed"] == 1
+    assert result["supervisor_restarts"] == 0
+    assert result["preempt_tag"] == \
+        f"preempt_step{bench.OVERLAP_PREEMPT_AT + 1}"
+    assert os.path.isfile(tmp_path / "runs" /
+                          os.path.basename(result["artifact"]))
+    with open(tmp_path / "runs" / "manifest.jsonl") as f:
+        assert "chaos_overlap_cpu_dryrun" in f.read()
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_chaos_overlap_2proc_tcp(tmp_path):
+    """Acceptance: peer kill + connection reset + frame corruption on
+    the REAL 2-proc socket mesh — the reconnect lane finishes bitwise
+    with `exchange.reconnects` pinned exactly (one per rank per drop)
+    and zero demotions/restarts; the demotion lane completes on the
+    serial wire; the SIGTERM lane commits through the real coordination
+    service and a relaunched pair resumes to identical final params."""
+    bench = _import_tool("chaos_bench")
+    result = bench.run_tcp_overlap(nproc=2, steps=8, record=False,
+                                   scratch=str(tmp_path / "scratch"))
+    n = len(bench.overlap_reconnect_rules())
+    assert result["reconnects_per_rank"] == n == 3
+    assert n <= result["resends_total"] <= 2 * n
+    assert result["demotions_per_rank"] == 1
+    assert result["loss_parity"] == "exact"
+    assert result["resume_parity"] == "exact"
+    assert result["supervisor_restarts"] == 0

@@ -440,6 +440,38 @@ def test_step_boundary_and_watchdog_once_per_step(monkeypatch, comp,
 # engine teardown: no thread leaks
 # ---------------------------------------------------------------------------
 
+def test_failure_path_close_logs_wedged_thread_by_name(ds_log,
+                                                       monkeypatch):
+    """The failure-path close: a service thread wedged past the join
+    budget (here the sender worker, blocked inside a device
+    materialization that never completes) must be LOGGED by name —
+    `t.join(timeout)` discarding a straggler silently would leak its
+    socket/buffer until process exit with no trace."""
+    import time as _time
+
+    from deepspeed_tpu.runtime.comm import overlap as ovl
+
+    monkeypatch.setattr(ovl, "_CLOSE_JOIN_S", 0.2)
+    ex = LocalExchange(world=1)
+    gate = threading.Event()
+
+    def blocked_getter():
+        gate.wait(30)
+        return np.zeros(1, np.uint8)
+
+    ex.submit([(0, blocked_getter)])
+    _time.sleep(0.05)  # let the worker enter the wedged getter
+    try:
+        ex.close()
+        assert any("still alive" in r.getMessage()
+                   and "dstpu-overlap-send" in r.getMessage()
+                   and r.levelno >= logging.WARNING
+                   for r in ds_log.records), \
+            [r.getMessage() for r in ds_log.records]
+    finally:
+        gate.set()  # release the thread so the suite stays leak-free
+
+
 def test_overlap_teardown_leaves_no_threads():
     before = {th for th in threading.enumerate() if th.is_alive()}
     eng = _make(comm=dict(BASE_COMM, overlap="auto"))

@@ -32,20 +32,42 @@ Campaigns:
   worker death.  Loss parity is asserted on every rank; the recorded
   artifact carries per-rank fault/retry counters.
 
+* **--overlap** (CPU dry-run, also tier-1 via
+  tests/test_overlap_healing.py): campaigns against the self-healing
+  host exchange (runtime/comm/overlap.py) — a transient exchange.send
+  raise absorbed by the retry taxonomy, a sustained send fault driving
+  COORDINATED DEMOTION to the serial in-program wire (bitwise losses,
+  `exchange.demotions` pinned), and a SIGTERM mid-run producing a
+  committed emergency checkpoint that resumes with exact loss parity.
+
+* **--overlap --nproc 2** (TCP): the same claims over the REAL socket
+  mesh — a reconnect lane injecting a connection reset (send fault), a
+  peer-kill-shaped recv fault, and a CRC-caught frame corruption, all
+  healed by reconnect+resend (`exchange.reconnects` pinned exactly, one
+  per rank per injected drop; zero demotions, zero restarts, bitwise
+  losses); a demotion lane with the reconnect budget zeroed that
+  completes the run on the serial wire; and a two-phase preemption lane
+  where both ranks SIGTERM mid-run, commit the emergency checkpoint
+  through the real coordination-service barrier, exit cleanly, and a
+  relaunched pair resumes to bitwise-identical final params.
+
 Usage: python tools/chaos_bench.py [--nproc 2] [--steps 6]
-           [--no-record]
+           [--no-record] [--overlap]
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
 import json
 import os
 import shutil
+import signal
 import socket
 import subprocess
 import sys
 import tempfile
+import time
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.join(HERE, ".."))
@@ -412,12 +434,437 @@ def run_tcp(nproc=2, steps=6, record=True, scratch=None, timeout=900):
     return result
 
 
+# ---------------------------------------------------------------------------
+# overlap-wire campaigns: self-healing exchange, demotion, preemption
+# ---------------------------------------------------------------------------
+
+OVERLAP_PREEMPT_AT = 4  # 0-based step that self-delivers SIGTERM
+
+
+def _wait_wire_quiescent(engine, timeout=20.0):
+    """Block until the exchange's resend buffer drains (every frame the
+    sender retained has been ACKed by every peer).  Campaign faults
+    then hit a QUIET wire, so `exchange.resends` pins tightly to the
+    injection schedule instead of racing whatever ACKs were in flight.
+    No-op for the in-process transport and once the KV fallback owns
+    the wire (no ACKs ride the KV transport — waiting would only burn
+    the timeout)."""
+    ex = getattr(engine, "_overlap_exchange", None)
+    unacked = getattr(ex, "_unacked", None)
+    if ex is None or unacked is None:
+        return
+    deadline = time.monotonic() + timeout
+    while unacked and not getattr(ex, "_kv_mode", False) and \
+            time.monotonic() < deadline:
+        time.sleep(0.005)
+
+
+def overlap_lane(steps, comm=None, faults=None, preempt_dir=None,
+                 sigterm_step=None, resume=None, seed=0):
+    """One overlap-campaign lane: manual forward/backward/step loop
+    (the split composition — step boundaries, where demotion and
+    preemption land, are explicit), deterministic synthetic batches.
+
+    `sigterm_step` self-delivers SIGTERM right before that step's
+    boundary — the honest preemption shape (the signal lands mid-step;
+    the handler defers to the boundary), made deterministic.  The lane
+    then raises SystemExit(0) out of engine.step() after the emergency
+    checkpoint commits.  `resume=(dir, tag, skip)` restores the tag and
+    skips the consumed batches first.
+
+    Returns (losses, params, counter_delta, engaged)."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor.counters import COUNTERS
+
+    cfg = {
+        "train_batch_size": BATCH,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "comm": dict({"gradient_reduction": "bucketed",
+                      "reduce_bucket_size": 2048, "overlap": "auto"},
+                     **(comm or {})),
+    }
+    if faults:
+        cfg["faults"] = {"rules": faults}
+    if preempt_dir:
+        cfg["checkpoint"] = {"preempt_save_dir": preempt_dir}
+    data = _SyntheticRegression(steps * BATCH, seed=seed)
+    engine, *_ = ds.initialize(model=_mlp(), config_params=cfg,
+                               dist_init_required=False)
+    engaged = "grads" in engine._step_fns
+    skip = 0
+    if resume is not None:
+        rdir, rtag, skip = resume
+        engine.load_checkpoint(rdir, tag=rtag)
+    snap = COUNTERS.snapshot()
+    losses = []
+    for i in range(skip, steps):
+        batch = (data.x[i * BATCH:(i + 1) * BATCH],
+                 data.y[i * BATCH:(i + 1) * BATCH])
+        loss = engine.forward(batch)
+        engine.backward()
+        if sigterm_step is not None and i == sigterm_step:
+            os.kill(os.getpid(), signal.SIGTERM)
+        engine.step()
+        losses.append(float(loss))
+        _wait_wire_quiescent(engine)
+    delta = COUNTERS.delta_since(snap)
+    params = [np.asarray(x) for x in
+              jax.tree_util.tree_leaves(engine.params)]
+    engine.finalize_monitoring()
+    return losses, params, delta, engaged
+
+
+def _params_digest(params) -> str:
+    h = hashlib.sha256()
+    for p in params:
+        h.update(p.tobytes())
+    return h.hexdigest()
+
+
+def _assert_params_equal(a, b, ctx):
+    import numpy as np
+
+    for x, y in zip(a, b):
+        assert (x == y).all(), \
+            f"{ctx}: params diverged (max |d|={np.abs(x - y).max()})"
+
+
+def run_dry_overlap(artifact_root=None, steps=6, record=True, root=None):
+    """Tier-1 CPU overlap campaign (in-process LocalExchange transport,
+    same driver machinery as the socket mesh).  Lanes:
+
+      serial     overlap off — the loss/params oracle
+      overlap    fault-free overlap — bitwise vs serial
+      transient  one exchange.send raise, absorbed by retry_transient
+                 (no demotion, bitwise, fault counters pinned)
+      demote     sustained send faults exhaust the retry budget ->
+                 coordinated demotion: the step programs rebuild on the
+                 serial wire MID-RUN and the run completes bitwise
+                 (`exchange.demotions` == 1)
+      preempt    SIGTERM mid-run -> committed emergency checkpoint ->
+                 clean exit -> a fresh engine resumes from the tag and
+                 finishes with exact loss/param parity
+    """
+    made_root = root is None
+    root = root or tempfile.mkdtemp(prefix="chaos_overlap_")
+    try:
+        serial_losses, serial_params, _, _ = overlap_lane(
+            steps, comm={"overlap": "none"})
+        ovl_losses, ovl_params, ovl_delta, engaged = overlap_lane(steps)
+        assert engaged, "overlap did not engage on the bucketed wire"
+        assert ovl_losses == serial_losses, \
+            f"overlap diverged: {serial_losses} vs {ovl_losses}"
+        _assert_params_equal(serial_params, ovl_params, "overlap lane")
+        assert not ovl_delta.get("exchange.demotions"), ovl_delta
+
+        tr_losses, tr_params, tr_delta, _ = overlap_lane(
+            steps, faults=[{"site": "exchange.send", "kind": "raise",
+                            "calls": [1], "times": 1}])
+        assert tr_losses == serial_losses, "transient fault leaked"
+        _assert_params_equal(serial_params, tr_params, "transient lane")
+        assert tr_delta.get("fault.injected", {}).get("calls") == 1
+        assert tr_delta.get("fault.retried", {}).get("calls") == 1
+        assert not tr_delta.get("exchange.demotions"), \
+            "a single transient send fault must NOT demote"
+
+        demote_steps = list(range(2, steps))
+        dm_losses, dm_params, dm_delta, _ = overlap_lane(
+            steps, faults=[{"site": "exchange.send", "kind": "raise",
+                            "steps": demote_steps}])
+        assert dm_losses == serial_losses, \
+            f"demotion lane diverged: {serial_losses} vs {dm_losses}"
+        _assert_params_equal(serial_params, dm_params, "demotion lane")
+        demotions = dm_delta.get("exchange.demotions", {}).get("calls", 0)
+        assert demotions == 1, dm_delta
+
+        # preemption: SIGTERM mid-run -> committed tag -> clean exit
+        from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+        preempt_dir = os.path.join(root, "preempt_ck")
+        exited = False
+        try:
+            overlap_lane(steps, preempt_dir=preempt_dir,
+                         sigterm_step=OVERLAP_PREEMPT_AT)
+        except SystemExit as e:
+            exited = e.code == 0
+        assert exited, "SIGTERM did not exit cleanly after the save"
+        tag = ckpt_io.read_latest_tag(preempt_dir)
+        assert tag == f"preempt_step{OVERLAP_PREEMPT_AT + 1}", tag
+        rs_losses, rs_params, _, _ = overlap_lane(
+            steps, resume=(preempt_dir, tag, OVERLAP_PREEMPT_AT + 1))
+        assert rs_losses == serial_losses[OVERLAP_PREEMPT_AT + 1:], \
+            (rs_losses, serial_losses)
+        _assert_params_equal(serial_params, rs_params, "preempt resume")
+
+        result = {
+            "metric": "chaos_overlap_cpu_dryrun",
+            "platform": "cpu",
+            "steps": steps,
+            "transient_absorbed": 1,
+            "demotions": demotions,
+            "preempt_tag": tag,
+            "loss_parity": "exact",
+            "supervisor_restarts": 0,
+            "value": demotions + 1,
+            "unit": "exchange_faults_absorbed_or_demoted",
+            "losses": [round(x, 6) for x in serial_losses],
+        }
+        if record:
+            from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+            result["artifact"] = record_bench_result(
+                result, root=artifact_root, name=result["metric"])
+        return result
+    finally:
+        from deepspeed_tpu.runtime import resilience
+
+        resilience.install_fault_plan(None)
+        resilience.install_retry_policy(None)
+        if made_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+# the 2-proc reconnect schedule: three distinct wire faults, each
+# healed by reconnect+resend.  Windows are two steps wide (times=1, so
+# each rule still injects EXACTLY once) and non-overlapping, with the
+# inter-step quiescence wait ensuring each fault hits a drained wire.
+def overlap_reconnect_rules():
+    return [
+        # connection reset: the send-side fault tears the conn down
+        # before the frame hits the wire (frame stays unacked -> resent)
+        {"site": "exchange.send", "kind": "raise", "steps": [1, 2],
+         "times": 1, "rank": 0},
+        # peer kill as the receiver sees it: the recv loop dies
+        # mid-frame and the connection is torn down
+        {"site": "exchange.recv", "kind": "raise", "steps": [3, 4],
+         "times": 1, "rank": 1},
+        # frame corruption: the payload is truncated in flight; the CRC
+        # turns it into a connection fault the resend path heals
+        {"site": "exchange.payload", "kind": "corrupt", "truncate_to": 3,
+         "steps": [5, 6], "times": 1, "rank": 0},
+    ]
+
+
+def overlap_demotion_rules():
+    return [
+        # one torn connection with the reconnect budget zeroed: the
+        # exchange falls back to the KV transport and the ranks demote
+        {"site": "exchange.recv", "kind": "raise", "steps": [2, 3],
+         "times": 1, "rank": 1},
+    ]
+
+
+def _overlap_worker(args):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coord,
+                               num_processes=args.nproc,
+                               process_id=args.proc_id)
+    import deepspeed_tpu  # noqa: F401  (gloo-collectives flag first)
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    steps, rank = args.steps, args.proc_id
+    preempt_dir = os.path.join(args.scratch, "preempt_ck")
+
+    if args.phase == "resume":
+        # phase 2 of the preemption lane: a relaunched pair resumes
+        # from the SIGTERM checkpoint and finishes the run
+        tag = f"preempt_step{OVERLAP_PREEMPT_AT + 1}"
+        losses, params, _, _ = overlap_lane(
+            steps, resume=(preempt_dir, tag, OVERLAP_PREEMPT_AT + 1))
+        print("OVL_RANK " + json.dumps({
+            "rank": rank, "phase": "resume",
+            "losses": [round(x, 8) for x in losses],
+            "params_digest": _params_digest(params),
+        }), flush=True)
+        return
+
+    base_losses, base_params, base_delta, engaged = overlap_lane(steps)
+    assert engaged, "overlap did not engage over the socket mesh"
+    assert not base_delta.get("exchange.reconnects"), base_delta
+
+    rc_losses, rc_params, rc_delta, _ = overlap_lane(
+        steps, faults=overlap_reconnect_rules())
+    assert rc_losses == base_losses, (
+        f"rank {rank}: reconnect lane diverged "
+        f"({base_losses} vs {rc_losses})")
+    _assert_params_equal(base_params, rc_params,
+                         f"rank {rank} reconnect lane")
+    reconnects = rc_delta.get("exchange.reconnects", {}).get("calls", 0)
+    resends = rc_delta.get("exchange.resends", {}).get("calls", 0)
+    # every injected drop heals through exactly ONE reconnect per rank
+    # (the dialer re-dials, the acceptor re-accepts — both count their
+    # side once); nothing may escalate to demotion
+    n_drops = len(overlap_reconnect_rules())
+    assert reconnects == n_drops, (reconnects, rc_delta)
+    assert not rc_delta.get("exchange.demotions"), rc_delta
+
+    dm_losses, dm_params, dm_delta, _ = overlap_lane(
+        steps,
+        comm={"overlap_reconnect_attempts": 0,
+              "overlap_reconnect_window_ms": 2000},
+        faults=overlap_demotion_rules())
+    assert dm_losses == base_losses, (
+        f"rank {rank}: demotion lane diverged "
+        f"({base_losses} vs {dm_losses})")
+    _assert_params_equal(base_params, dm_params,
+                         f"rank {rank} demotion lane")
+    assert dm_delta.get("exchange.demotions", {}).get("calls") == 1, \
+        dm_delta
+
+    # preemption phase 1: both ranks SIGTERM mid-run, save through the
+    # real coordination-service commit barrier, exit cleanly
+    exited = False
+    try:
+        overlap_lane(steps, preempt_dir=preempt_dir,
+                     sigterm_step=OVERLAP_PREEMPT_AT)
+    except SystemExit as e:
+        exited = e.code == 0
+    assert exited, f"rank {rank}: SIGTERM did not exit cleanly"
+    tag = ckpt_io.read_latest_tag(preempt_dir)
+    assert tag == f"preempt_step{OVERLAP_PREEMPT_AT + 1}", tag
+
+    print("OVL_RANK " + json.dumps({
+        "rank": rank, "phase": "chaos",
+        "losses": [round(x, 8) for x in base_losses],
+        "params_digest": _params_digest(base_params),
+        "reconnects": reconnects,
+        "resends": resends,
+        "resend_bytes": rc_delta.get("exchange.resends",
+                                     {}).get("bytes", 0),
+        "demotions": dm_delta.get("exchange.demotions",
+                                  {}).get("calls", 0),
+        "faults_injected": (
+            rc_delta.get("fault.injected", {}).get("calls", 0)
+            + dm_delta.get("fault.injected", {}).get("calls", 0)),
+        "preempt_tag": tag,
+    }), flush=True)
+
+
+def _launch_overlap_workers(nproc, steps, scratch, phase, timeout):
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__),
+             "--overlap-worker", "--phase", phase,
+             "--proc-id", str(i), "--nproc", str(nproc),
+             "--coord", coord, "--steps", str(steps),
+             "--scratch", scratch],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env)
+        for i in range(nproc)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out)
+            assert p.returncode == 0, out[-4000:]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    ranks = []
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("OVL_RANK "):
+                ranks.append(json.loads(line[len("OVL_RANK "):]))
+    assert len(ranks) == nproc, outs
+    ranks.sort(key=lambda r: r["rank"])
+    return ranks
+
+
+def run_tcp_overlap(nproc=2, steps=8, record=True, scratch=None,
+                    timeout=900):
+    """The 2-proc TCP overlap campaign over the REAL socket mesh.
+    Phase 1 (chaos): fault-free baseline, the reconnect lane (conn
+    reset + peer-kill recv fault + CRC-caught corruption, all healed,
+    counters pinned, zero demotions), the demotion lane (budget zeroed
+    -> completes on the serial wire), and the preemption lane's SIGTERM
+    half.  Phase 2 (resume): a relaunched pair resumes from the
+    committed emergency tag and must land bitwise-identical final
+    params.  Zero supervisor restarts throughout — each phase is one
+    launch and every process exits 0."""
+    made = scratch is None
+    scratch = scratch or tempfile.mkdtemp(prefix="chaos_overlap_tcp_")
+    try:
+        ranks = _launch_overlap_workers(nproc, steps, scratch, "chaos",
+                                        timeout)
+        assert all(r["losses"] == ranks[0]["losses"] for r in ranks), ranks
+        assert all(r["params_digest"] == ranks[0]["params_digest"]
+                   for r in ranks), ranks
+        n_drops = len(overlap_reconnect_rules())
+        for r in ranks:
+            assert r["reconnects"] == n_drops, ranks
+            assert r["demotions"] == 1, ranks
+        total_resends = sum(r["resends"] for r in ranks)
+        # each drop loses the dropping side's in-flight frame (always
+        # resent) and MAY lose the peer's concurrent frame (the duplex
+        # race: its ACK was or wasn't in flight at teardown) — with the
+        # quiescent-wire injection discipline that bounds resends to
+        # [drops, 2*drops]; dedup makes the duplicates harmless
+        assert n_drops <= total_resends <= 2 * n_drops, \
+            (total_resends, ranks)
+
+        resumed = _launch_overlap_workers(nproc, steps, scratch,
+                                          "resume", timeout)
+        assert all(r["losses"] == resumed[0]["losses"]
+                   for r in resumed), resumed
+        assert all(r["params_digest"] == ranks[0]["params_digest"]
+                   for r in resumed), (
+            "resume from the preemption checkpoint diverged from the "
+            "uninterrupted run", ranks, resumed)
+
+        result = {
+            "metric": f"chaos_overlap_{nproc}proc_tcp",
+            "platform": "cpu",
+            "world": {"processes": nproc},
+            "steps": steps,
+            "fault_kinds": ["exchange.send raise (conn reset)",
+                            "exchange.recv raise (peer kill)",
+                            "exchange.payload corrupt (CRC)"],
+            "reconnects_per_rank": ranks[0]["reconnects"],
+            "resends_total": total_resends,
+            "resend_bytes_total": sum(r["resend_bytes"] for r in ranks),
+            "demotions_per_rank": 1,
+            "preempt_tag": ranks[0]["preempt_tag"],
+            "loss_parity": "exact",
+            "resume_parity": "exact",
+            "supervisor_restarts": 0,
+            "value": n_drops,
+            "unit": "wire_faults_healed",
+            "ranks": ranks,
+        }
+        if record:
+            from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+            result["artifact"] = record_bench_result(
+                result, name=result["metric"])
+        return result
+    finally:
+        if made:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--nproc", type=int, default=1)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--no-record", action="store_true")
+    ap.add_argument("--overlap", action="store_true")
     ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--overlap-worker", dest="overlap_worker",
+                    action="store_true")
+    ap.add_argument("--phase", default="chaos",
+                    choices=("chaos", "resume"))
     ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
     ap.add_argument("--coord", default="")
     ap.add_argument("--scratch", default="")
@@ -425,7 +872,22 @@ def main() -> int:
     if args.worker:
         _worker(args)
         return 0
-    if args.nproc <= 1:
+    if args.overlap_worker:
+        _overlap_worker(args)
+        return 0
+    if args.overlap and args.nproc > 1:
+        result = run_tcp_overlap(nproc=args.nproc,
+                                 steps=max(8, args.steps),
+                                 record=not args.no_record)
+    elif args.overlap:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        result = run_dry_overlap(steps=max(6, args.steps),
+                                 record=not args.no_record)
+    elif args.nproc <= 1:
         os.environ.setdefault(
             "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
         import jax

@@ -62,6 +62,12 @@ def selftest() -> int:
             COUNTERS.add("fault.recovered_ms", 2500, calls=1)
             COUNTERS.add("watchdog.trips", calls=1)
             COUNTERS.add("input.worker_respawns", calls=1)
+            # overlap-exchange self-healing: healed drops, replayed
+            # frames (bytes = replayed payload), a demotion — all
+            # Resilience rows, never comm byte rows
+            COUNTERS.add("exchange.reconnects", calls=1)
+            COUNTERS.add("exchange.resends", 2048, calls=1)
+            COUNTERS.add("exchange.demotions", calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -99,6 +105,9 @@ def selftest() -> int:
                        "mean prefetch queue depth",
                        "Resilience", "faults injected", "transient retries",
                        "watchdog trips", "prefetch workers respawned",
+                       "exchange connections healed",
+                       "exchange frames resent", "6,144 B replayed",
+                       "demotions to the serial path",
                        "Restarts (supervisor ledger)", "watchdog trip on "
                        "rank 0"):
             assert needle in md, f"{needle!r} missing from report"
@@ -110,6 +119,9 @@ def selftest() -> int:
         assert "`fault.injected`" not in md and \
             "`watchdog.trips`" not in md, \
             "fault.*/watchdog.* rows must not leak into the comm table"
+        assert "`exchange.reconnects`" not in md and \
+            "`exchange.resends`" not in md, \
+            "exchange.* rows must not leak into the comm table"
     print("run_report selftest ok")
     return 0
 
