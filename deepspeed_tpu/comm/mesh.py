@@ -207,6 +207,36 @@ def derive_data_outer(dp_size: int) -> int:
     return procs
 
 
+def elastic_device_slice(n_needed: int,
+                         devices: Optional[Sequence] = None):
+    """The device set for an elastic (shrunken-world) mesh: the first
+    `n_needed` devices in `jax.devices()` order.
+
+    In a true multi-process elastic restart the supervisor relaunched
+    only the survivors, so the device count already matches and this is
+    the identity.  When MORE devices are visible than the surviving
+    world needs (a single-process virtual mesh simulating the shrink,
+    or a host that kept its local devices while a peer died), the mesh
+    is built over the leading contiguous slice — process-major order,
+    so the surviving mesh keeps whole processes and the fast-fabric
+    adjacency the hierarchy depends on."""
+    devices = list(devices) if devices is not None else list(jax.devices())
+    n_needed = int(n_needed)
+    if n_needed < 1:
+        raise ValueError(f"elastic world needs >= 1 device, got {n_needed}")
+    if len(devices) < n_needed:
+        raise ValueError(
+            f"elastic world needs {n_needed} device(s) but only "
+            f"{len(devices)} are visible — DSTPU_SURVIVING_WORLD cannot "
+            f"exceed the relaunched job's capacity")
+    if len(devices) > n_needed:
+        logger.warning(
+            f"elastic world: building the mesh over the first "
+            f"{n_needed} of {len(devices)} visible devices "
+            f"(surviving-world slice)")
+    return devices[:n_needed]
+
+
 def make_mesh(
     data: int = -1,
     model: int = 1,

@@ -8,8 +8,18 @@ from .elasticity import (  # noqa: F401
     ensure_immutable_elastic_config,
     get_compatible_gpus_v01,
 )
+from .elastic_env import (  # noqa: F401
+    DEAD_RANKS_ENV,
+    ELASTIC_REASON_ENV,
+    ELASTIC_RESTART_ENV,
+    INCARNATION_ENV,
+    SURVIVING_WORLD_ENV,
+    ElasticEnv,
+    read_elastic_env,
+)
 from .supervisor import (  # noqa: F401
     HeartbeatWatcher,
     RestartPolicy,
+    plan_world_transition,
     supervise,
 )

@@ -1,0 +1,137 @@
+"""The elastic-restart environment contract between the supervisor and
+the engine.
+
+The supervisor (elasticity/supervisor.py) relaunches a failed job with
+a small env-var handshake; the engine reads and VALIDATES it at init —
+a garbled value must fail loudly at boot, not silently train at the
+wrong world size:
+
+    DSTPU_ELASTIC_RESTART=1      this launch is a supervised relaunch
+    DSTPU_ELASTIC_REASON=...     human-readable trigger (stall, straggler,
+                                 watchdog trip, worker death)
+    DSTPU_DEAD_RANKS=1,3         ranks the trigger identified as dead
+    DSTPU_SURVIVING_WORLD=3      the dp world size this launch must run
+                                 at (--elastic-shrink policy: relaunch
+                                 on the survivors instead of spinning
+                                 for the lost host)
+    DSTPU_INCARNATION=2          relaunch counter; namespaces every
+                                 coordination-service KV key
+                                 (runtime/comm/hostwire.scoped_key) so a
+                                 survivor generation never consumes the
+                                 dead generation's write-once keys
+
+`read_elastic_env()` is the single reader: every consumer (engine mesh
+build, logging, counters) goes through the validated view.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..runtime.comm.hostwire import INCARNATION_ENV
+
+ELASTIC_RESTART_ENV = "DSTPU_ELASTIC_RESTART"
+ELASTIC_REASON_ENV = "DSTPU_ELASTIC_REASON"
+DEAD_RANKS_ENV = "DSTPU_DEAD_RANKS"
+SURVIVING_WORLD_ENV = "DSTPU_SURVIVING_WORLD"
+
+ELASTIC_ENV_VARS = (ELASTIC_RESTART_ENV, ELASTIC_REASON_ENV,
+                    DEAD_RANKS_ENV, SURVIVING_WORLD_ENV, INCARNATION_ENV)
+
+
+@dataclass
+class ElasticEnv:
+    """Validated view of the supervisor's relaunch environment."""
+
+    restart: bool = False
+    reason: Optional[str] = None
+    dead_ranks: List[int] = field(default_factory=list)
+    surviving_world: Optional[int] = None
+    incarnation: int = 0
+
+    @property
+    def active(self) -> bool:
+        """True when ANY elastic signal is present — the engine logs the
+        handoff even before the full shrink path engages."""
+        return bool(self.restart or self.dead_ranks
+                    or self.surviving_world is not None
+                    or self.incarnation > 0)
+
+    def describe(self) -> str:
+        bits = [f"incarnation {self.incarnation}"]
+        if self.surviving_world is not None:
+            bits.append(f"surviving_world {self.surviving_world}")
+        if self.dead_ranks:
+            bits.append(f"dead_ranks {self.dead_ranks}")
+        if self.reason:
+            bits.append(f"reason {self.reason!r}")
+        return "elastic restart: " + ", ".join(bits)
+
+
+def _parse_int(environ, var: str, minimum: int) -> Optional[int]:
+    raw = environ.get(var)
+    if raw is None or not str(raw).strip():
+        return None
+    try:
+        val = int(str(raw).strip())
+    except ValueError:
+        raise ValueError(
+            f"elastic env: {var}={raw!r} is not an integer — the "
+            f"supervisor exports numeric values; a garbled handoff "
+            f"must not silently pick a world size")
+    if val < minimum:
+        raise ValueError(
+            f"elastic env: {var}={val} must be >= {minimum}")
+    return val
+
+
+def read_elastic_env(environ=None) -> ElasticEnv:
+    """Read + validate the supervisor handoff.  Raises ValueError on
+    non-numeric or inconsistent values (duplicate/negative dead ranks, a
+    surviving world too small to have lost those ranks) — loud by
+    contract, even before the full elastic path engages."""
+    environ = os.environ if environ is None else environ
+    restart = str(environ.get(ELASTIC_RESTART_ENV, "")).strip() == "1"
+    reason = environ.get(ELASTIC_REASON_ENV) or None
+    surviving = _parse_int(environ, SURVIVING_WORLD_ENV, minimum=1)
+    incarnation = _parse_int(environ, INCARNATION_ENV, minimum=0) or 0
+
+    dead: List[int] = []
+    raw = environ.get(DEAD_RANKS_ENV)
+    if raw is not None and str(raw).strip():
+        for tok in str(raw).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            try:
+                r = int(tok)
+            except ValueError:
+                raise ValueError(
+                    f"elastic env: {DEAD_RANKS_ENV}={raw!r} must be a "
+                    f"comma-separated list of ranks (bad entry {tok!r})")
+            if r < 0:
+                raise ValueError(
+                    f"elastic env: {DEAD_RANKS_ENV} contains negative "
+                    f"rank {r}")
+            dead.append(r)
+        if len(set(dead)) != len(dead):
+            raise ValueError(
+                f"elastic env: {DEAD_RANKS_ENV}={raw!r} lists a rank "
+                f"twice — the supervisor's survivor math would be wrong")
+        dead = sorted(dead)
+
+    if surviving is not None and dead:
+        # the dead ranks must have existed in the pre-shrink world of
+        # surviving + len(dead) ranks
+        pre_shrink = surviving + len(dead)
+        too_big = [r for r in dead if r >= pre_shrink]
+        if too_big:
+            raise ValueError(
+                f"elastic env: inconsistent handoff — dead rank(s) "
+                f"{too_big} cannot exist in a pre-shrink world of "
+                f"{pre_shrink} ({SURVIVING_WORLD_ENV}={surviving} + "
+                f"{len(dead)} dead)")
+    return ElasticEnv(restart=restart, reason=reason, dead_ranks=dead,
+                      surviving_world=surviving, incarnation=incarnation)

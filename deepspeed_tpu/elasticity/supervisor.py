@@ -22,11 +22,23 @@ two ways:
   checkpoint layer's two-phase commit means an interrupted save can
   never corrupt the resume point), then SIGKILL after `--grace`
   seconds, and the relaunch carries `DSTPU_ELASTIC_RESTART=1`,
-  `DSTPU_ELASTIC_REASON`, and — when the heartbeats identify dead or
+  `DSTPU_ELASTIC_REASON`, and — when the trigger identifies dead or
   straggling ranks — `DSTPU_DEAD_RANKS` / `DSTPU_SURVIVING_WORLD`, so
   the launcher can re-form the job at the surviving world size and the
   framework's elastic checkpoints ("latest" committed tag) resume it
   there.
+
+With `--elastic-shrink` the env handoff becomes POLICY, not just
+advice (`plan_world_transition`): a trigger naming dead ranks (per-rank
+stream forensics on a stall, straggler strikes, or a launcher-written
+`elastic_report.json`) relaunches on the survivors at the shrunken
+world size — never below `--min-world` — and the engine reboots there
+through resharding-on-restore (runtime/engine.py consumes the env via
+elasticity/elastic_env.py); a later restart with no dead ranks grows
+back to the full width.  Every relaunch exports `DSTPU_INCARNATION`,
+which namespaces the entire coordination-service KV surface
+(runtime/comm/hostwire.scoped_key) so a survivor generation never
+consumes a dead generation's write-once keys.
 
 Beside the env handoff, every restart decision is appended to
 `restarts.jsonl` in the monitor dir (reason, dead ranks, backoff
@@ -66,8 +78,62 @@ from typing import Dict, List, Optional
 
 from ..runtime.resilience import WATCHDOG_TRIP_FILE, read_watchdog_trip
 from ..utils.logging import logger
+from .elastic_env import (DEAD_RANKS_ENV, ELASTIC_ENV_VARS,
+                          ELASTIC_REASON_ENV, ELASTIC_RESTART_ENV,
+                          INCARNATION_ENV, SURVIVING_WORLD_ENV)
 
 RESTART_LEDGER = "restarts.jsonl"
+
+# Dead-rank report a LAUNCHER leaves beside the monitor streams when it
+# can identify the victim itself (it spawned the workers, so a worker
+# exit names the rank precisely — no heartbeat forensics needed):
+# {"dead_ranks": [1], "reason": "..."}.  `supervise()` consumes (and
+# deletes) it after a child failure as an elastic trigger.
+ELASTIC_REPORT = "elastic_report.json"
+
+
+def plan_world_transition(current_world: Optional[int],
+                          full_world: Optional[int],
+                          dead_ranks: List[int], *,
+                          elastic_shrink: bool = False,
+                          min_world: int = 1):
+    """The shrink-to-survivors policy, as a pure decision function:
+    given the world the dying child ran at, the job's full width, and
+    the ranks the trigger identified as dead, return
+    ``(to_world, transition)`` for the relaunch, where `transition` is
+    ``"shrink"``, ``"regrow"``, or None (relaunch at the same width).
+
+    * dead ranks named and `elastic_shrink` on: relaunch the survivors
+      at ``current - len(dead)`` — unless that breaches the
+      ``min_world`` floor, in which case the job relaunches at its
+      CURRENT width and keeps spinning for the lost host (the
+      pre-elastic behavior, now a bounded fallback instead of the only
+      option).
+    * no dead ranks named (plain exit, whole-job stall, watchdog trip)
+      while running shrunken: the failure was not a missing host, so
+      capacity is presumed back — grow to the full width and let the
+      resharding-on-restore path re-partition upward.
+    * anything else: stay put.
+
+    Unit-testable and shared with the chaos campaigns, so the policy
+    the fleet runs is the policy the tests pin."""
+    if current_world is None:
+        current_world = full_world
+    if current_world is None:
+        return None, None
+    if dead_ranks and elastic_shrink:
+        target = current_world - len(set(dead_ranks))
+        if target >= max(1, int(min_world)):
+            return target, ("shrink" if target < current_world else None)
+        logger.warning(
+            f"supervisor: shrinking to {target} survivor(s) would "
+            f"breach --min-world {min_world}; relaunching at world "
+            f"{current_world} and waiting for capacity instead")
+        return current_world, None
+    if not dead_ranks and full_world is not None and \
+            current_world < full_world:
+        return full_world, "regrow"
+    return current_world, None
 
 
 def _ledger_append(path: Optional[str], entry: Dict) -> None:
@@ -162,7 +228,14 @@ class HeartbeatWatcher:
     * **stall** — no event file grew for `stall_timeout` seconds.  A
       hung collective / dead coordinator stops EVERY rank's stream, so
       this is the dead-rank detector that works even when the victim
-      cannot say goodbye.
+      cannot say goodbye.  On a stall the watcher additionally compares
+      PER-RANK stream mtimes: a rank whose stream went quiet more than
+      `dead_rank_margin` seconds before the newest stream is named in
+      `dead_ranks` (the victim dies first; the survivors wedge in the
+      next collective and keep their later mtimes) — the signal the
+      `--elastic-shrink` policy needs to relaunch on the survivors.
+      When every stream stopped together (coordinator death, whole-job
+      hang) no rank is singled out and the restart stays full-width.
     * **straggler** — a rank flagged by `straggler_factor` x median in
       `straggler_strikes` CONSECUTIVE heartbeat events (one slow step
       is noise; a persistently slow rank is a failing host).
@@ -177,10 +250,16 @@ class HeartbeatWatcher:
     `reset()` re-arms the liveness clock after a relaunch."""
 
     def __init__(self, run_dir: str, stall_timeout: float,
-                 straggler_strikes: int = 3, clock=time.time):
+                 straggler_strikes: int = 3, clock=time.time,
+                 dead_rank_margin: Optional[float] = None):
         self.run_dir = run_dir
         self.stall_timeout = float(stall_timeout)
         self.straggler_strikes = int(straggler_strikes)
+        # margin separating "died first" from "wedged with the rest";
+        # defaults to a quarter of the stall window, 0 disables
+        self.dead_rank_margin = (self.stall_timeout / 4.0
+                                 if dead_rank_margin is None
+                                 else float(dead_rank_margin))
         self._clock = clock
         self._strikes: Dict[int, int] = {}
         self._hb_offset = 0  # byte cursor into the rank-0 event stream
@@ -226,15 +305,48 @@ class HeartbeatWatcher:
         return sorted(glob.glob(os.path.join(self.run_dir,
                                              "events.rank*.jsonl")))
 
+    def _rank_mtimes(self) -> Dict[int, float]:
+        """Per-rank event-stream mtimes keyed by rank id."""
+        out: Dict[int, float] = {}
+        for path in self._event_files():
+            base = os.path.basename(path)
+            try:
+                rank = int(base[len("events.rank"):-len(".jsonl")])
+                out[rank] = os.path.getmtime(path)
+            except (ValueError, OSError):
+                continue
+        return out
+
     def _last_activity(self) -> Optional[float]:
         """Newest mtime across event streams (None: no files yet)."""
-        stamps = []
-        for path in self._event_files():
-            try:
-                stamps.append(os.path.getmtime(path))
-            except OSError:
-                continue
+        stamps = self._rank_mtimes().values()
         return max(stamps) if stamps else None
+
+    def _dead_ranks_on_stall(self) -> List[int]:
+        """On a stall: the ranks whose streams went quiet distinctly
+        EARLIER than the newest stream (a dead rank stops writing first;
+        its peers wedge in the next collective and carry later mtimes).
+        Only streams that wrote SINCE the last (re)arm participate:
+        the relaunched run appends to the same run dir, so a rank a
+        previous shrink already removed owns a frozen file that would
+        otherwise read as "dead" on every later stall — and a rank of
+        THIS generation that never wrote is simply not named (the
+        restart stays full-width, the safe fallback).  Empty when the
+        margin is off, fewer than two live streams exist, or every
+        live stream stopped together (whole-job stall — no victim to
+        shed)."""
+        if self.dead_rank_margin <= 0:
+            return []
+        stamps = {r: m for r, m in self._rank_mtimes().items()
+                  if m >= self._armed_at}
+        if len(stamps) < 2:
+            return []
+        newest = max(stamps.values())
+        dead = sorted(r for r, m in stamps.items()
+                      if newest - m > self.dead_rank_margin)
+        if not dead or len(dead) == len(stamps):
+            return []
+        return dead
 
     def _latest_heartbeats(self, tail_bytes: int = 1 << 16) -> List[dict]:
         """NEW heartbeat events from the rank-0 stream since the last
@@ -305,13 +417,18 @@ class HeartbeatWatcher:
             anchor = (self._armed_at if last is None
                       else max(last, self._armed_at))
             if now - anchor > self.stall_timeout:
+                dead = self._dead_ranks_on_stall()
+                world = self._world_size() if dead else None
                 return {
                     "reason": (f"no monitor events in "
                                f"{now - anchor:.0f}s (> stall-timeout "
                                f"{self.stall_timeout:.0f}s) under "
-                               f"{self.run_dir}"),
-                    "dead_ranks": [],
-                    "surviving_world": None,
+                               f"{self.run_dir}"
+                               + (f"; rank(s) {dead} went quiet "
+                                  f"first" if dead else "")),
+                    "dead_ranks": dead,
+                    "surviving_world": (world - len(dead)
+                                        if world is not None else None),
                 }
         # straggler strikes: consecutive heartbeat flags per rank
         for hb in self._latest_heartbeats():
@@ -336,6 +453,34 @@ class HeartbeatWatcher:
         return None
 
 
+def _consume_elastic_report(report_dir: Optional[str]) -> Optional[dict]:
+    """Read AND delete a launcher-written dead-rank report
+    (`elastic_report.json`).  Consumed once: a stale report must never
+    shrink a later, unrelated restart."""
+    if report_dir is None:
+        return None
+    path = os.path.join(report_dir, ELASTIC_REPORT)
+    try:
+        with open(path) as f:
+            report = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    dead = report.get("dead_ranks") or []
+    if not isinstance(dead, list) or \
+            not all(isinstance(r, int) for r in dead):
+        logger.warning(f"supervisor: malformed {ELASTIC_REPORT} "
+                       f"(dead_ranks={dead!r}) — ignored")
+        return None
+    return {"reason": str(report.get("reason")
+                          or f"launcher reported rank(s) {dead} dead"),
+            "dead_ranks": sorted(set(dead)),
+            "surviving_world": None}
+
+
 def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
               backoff_cap: float = 300.0, success_window: float = 300.0,
               jitter: float = 0.25, restart_window: float = 0.0,
@@ -344,17 +489,34 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
               grace: float = 15.0, poll_interval: float = 0.5,
               policy: Optional[RestartPolicy] = None,
               watcher: Optional[HeartbeatWatcher] = None,
-              ledger_path: Optional[str] = None):
+              ledger_path: Optional[str] = None,
+              elastic_shrink: bool = False, min_world: int = 1,
+              world: Optional[int] = None):
     """Run `command` (list) until it exits 0 or the restart budget is
     exhausted.  See the module docstring for the exit-driven and
     heartbeat-driven restart paths; `policy`/`watcher` may be passed
     pre-built (tests, custom clocks).
 
+    With `elastic_shrink=True` a trigger that names dead ranks (per-rank
+    stream forensics, straggler strikes, or a launcher
+    `elastic_report.json`) relaunches on the SURVIVORS: the child env
+    carries `DSTPU_SURVIVING_WORLD`/`DSTPU_DEAD_RANKS` and the launcher
+    re-forms the job at the shrunken width (never below `min_world`);
+    a later restart with no dead ranks grows back to the full width
+    (`world`, or the monitor manifest's world_size, or inferred from
+    the first shrink trigger).  Every relaunch exports
+    `DSTPU_INCARNATION` — the relaunch counter that namespaces the
+    whole coordination-service KV surface (hostwire.scoped_key), so a
+    survivor generation never consumes a dead generation's write-once
+    keys.
+
     Every restart decision (and the final give-up) is appended to
     `restarts.jsonl` in the monitor dir (override with `ledger_path`) —
-    reason, dead ranks, backoff chosen, watchdog diagnostics path if
-    any — so post-mortems read a machine-parsable ledger instead of
-    supervisor scrollback; `tools/run_report.py` renders it."""
+    reason, dead ranks, the world transition (`from_world` ->
+    `to_world`), backoff chosen, watchdog diagnostics path if any — so
+    post-mortems read a machine-parsable ledger instead of supervisor
+    scrollback; `tools/run_report.py` renders it (incl. the "Elastic
+    transitions" block)."""
     if policy is None:
         policy = RestartPolicy(max_restarts=max_restarts, backoff=backoff,
                                backoff_cap=backoff_cap, jitter=jitter,
@@ -365,15 +527,21 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
         # detection still runs off the heartbeat events
         watcher = HeartbeatWatcher(monitor_dir, stall_timeout,
                                    straggler_strikes=straggler_strikes)
-    if ledger_path is None:
-        ledger_dir = monitor_dir or (watcher.run_dir
-                                     if watcher is not None else None)
-        if ledger_dir is not None:
-            ledger_path = os.path.join(ledger_dir, RESTART_LEDGER)
+    ledger_dir = monitor_dir or (watcher.run_dir
+                                 if watcher is not None else None)
+    if ledger_path is None and ledger_dir is not None:
+        ledger_path = os.path.join(ledger_dir, RESTART_LEDGER)
     attempt = 0
     child = None
     stop_signal = None
-    elastic: Optional[dict] = None  # last heartbeat trigger, for env
+    elastic: Optional[dict] = None  # last elastic trigger, for env
+    # world bookkeeping for the shrink/grow policy: `full_world` is the
+    # job's nominal width (explicit arg > monitor manifest > inferred
+    # from the first trigger), `current_world` what the NEXT launch runs
+    full_world = world
+    if full_world is None and watcher is not None:
+        full_world = watcher._world_size()
+    current_world: Optional[int] = full_world
 
     def forward(signum, _frame):
         # an operator/scheduler signal means STOP, not "restart harder":
@@ -399,16 +567,26 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
             time.sleep(min(left, 0.5))
 
     def child_env():
+        # fresh handoff every launch: inherited elastic vars (nested
+        # supervisors, operator shells) must never leak into the child
         env = dict(os.environ)
+        for var in ELASTIC_ENV_VARS:
+            env.pop(var, None)
+        # relaunch counter -> KV-key namespace (attempt is 1-based;
+        # the first launch is incarnation 0, i.e. unprefixed keys —
+        # identical to an unsupervised run)
+        env[INCARNATION_ENV] = str(attempt - 1)
         if elastic is not None:
-            env["DSTPU_ELASTIC_RESTART"] = "1"
-            env["DSTPU_ELASTIC_REASON"] = elastic["reason"]
+            env[ELASTIC_RESTART_ENV] = "1"
+            env[ELASTIC_REASON_ENV] = elastic["reason"]
             if elastic.get("dead_ranks"):
-                env["DSTPU_DEAD_RANKS"] = ",".join(
+                env[DEAD_RANKS_ENV] = ",".join(
                     str(r) for r in elastic["dead_ranks"])
-            if elastic.get("surviving_world"):
-                env["DSTPU_SURVIVING_WORLD"] = str(
-                    elastic["surviving_world"])
+        # a shrunken width persists across relaunches until the policy
+        # grows back — not just on the launch right after the trigger
+        if current_world is not None and full_world is not None \
+                and current_world < full_world:
+            env[SURVIVING_WORLD_ENV] = str(current_world)
         return env
 
     def wait_with_watcher():
@@ -462,6 +640,44 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 logger.info(f"supervisor: stopping on signal "
                             f"{stop_signal} (child exit {rc})")
                 return 128 + int(stop_signal)
+            if trigger is None or not trigger.get("dead_ranks"):
+                # the launcher may know the victim precisely even when
+                # the heartbeat forensics don't (it spawned the workers)
+                # — merge INTO the trigger so its diagnostics (watchdog
+                # snapshot path) survive into the ledger
+                report = _consume_elastic_report(ledger_dir)
+                if report is not None:
+                    merged = dict(trigger or {})
+                    merged["dead_ranks"] = report["dead_ranks"]
+                    merged["surviving_world"] = (
+                        report.get("surviving_world")
+                        or merged.get("surviving_world"))
+                    merged["reason"] = (f"{trigger['reason']}; "
+                                        f"{report['reason']}"
+                                        if trigger else report["reason"])
+                    trigger = merged
+            dead = (trigger or {}).get("dead_ranks") or []
+            if full_world is None:
+                # last-resort inference: the trigger knows the world it
+                # observed (survivors + victims)
+                sw = (trigger or {}).get("surviving_world")
+                if sw is not None:
+                    full_world = int(sw) + len(dead)
+                    current_world = current_world or full_world
+                elif watcher is not None:
+                    full_world = watcher._world_size()
+                    current_world = current_world or full_world
+            from_world = current_world
+            to_world, transition = plan_world_transition(
+                current_world, full_world, dead,
+                elastic_shrink=elastic_shrink, min_world=min_world)
+            if transition is not None:
+                logger.warning(
+                    f"supervisor: elastic {transition} — relaunching at "
+                    f"world {to_world} (was {from_world}; "
+                    f"dead ranks {dead or '—'})")
+            current_world = to_world if to_world is not None \
+                else current_world
             elastic = trigger or None
             delay = policy.record_failure(ran_for)
             ledger_entry = {
@@ -471,8 +687,15 @@ def supervise(command, max_restarts: int = 10, backoff: float = 5.0,
                 "exit_code": rc,
                 "reason": (trigger["reason"] if trigger
                            else f"exit code {rc}"),
-                "dead_ranks": (trigger or {}).get("dead_ranks") or [],
-                "surviving_world": (trigger or {}).get("surviving_world"),
+                "dead_ranks": dead,
+                "surviving_world": (current_world
+                                    if transition == "shrink" else
+                                    (trigger or {}).get(
+                                        "surviving_world")),
+                "from_world": from_world,
+                "to_world": current_world,
+                "transition": transition,
+                "incarnation": attempt,  # the RELAUNCH's incarnation id
                 "diagnostics": (trigger or {}).get("diagnostics"),
                 "restarts_used": policy.failures_in_window,
             }
@@ -534,6 +757,20 @@ def main(argv=None):
     parser.add_argument("--grace", type=float, default=15.0,
                         help="seconds between SIGTERM and SIGKILL on a "
                         "heartbeat-triggered teardown")
+    parser.add_argument("--elastic-shrink", action="store_true",
+                        help="when a trigger names dead ranks, relaunch "
+                        "on the SURVIVORS at the shrunken world size "
+                        "(DSTPU_SURVIVING_WORLD) instead of spinning at "
+                        "full width for the lost host; a later restart "
+                        "with no dead ranks grows back to full width")
+    parser.add_argument("--min-world", type=int, default=1,
+                        help="floor for --elastic-shrink: never relaunch "
+                        "below this many ranks (breaching triggers a "
+                        "full-width relaunch that waits for capacity)")
+    parser.add_argument("--world", type=int, default=None,
+                        help="the job's full world size (default: the "
+                        "monitor manifest's world_size, else inferred "
+                        "from the first shrink trigger)")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="-- training command")
     args = parser.parse_args(argv)
@@ -549,7 +786,9 @@ def main(argv=None):
                      monitor_dir=args.monitor_dir,
                      stall_timeout=args.stall_timeout,
                      straggler_strikes=args.straggler_strikes,
-                     grace=args.grace)
+                     grace=args.grace,
+                     elastic_shrink=args.elastic_shrink,
+                     min_world=args.min_world, world=args.world)
 
 
 if __name__ == "__main__":

@@ -93,7 +93,12 @@ class RunMonitor:
         self._hostwire = None
         self.spans = SpanSet()
         self.flops_per_step: Optional[float] = None
-        self._counter_snap = None
+        # baseline counter snapshot at CONSTRUCTION: activity between
+        # engine init and the first step (a resumed checkpoint's load —
+        # incl. elastic.shrinks/regrows and ckpt.skipped_tags) attributes
+        # to the first step event instead of vanishing before the first
+        # step_start's lazy snapshot
+        self._counter_snap = COUNTERS.snapshot()
         self._step_t0 = None
         self._events_since_flush = 0
         self._n_events = 0

@@ -201,9 +201,12 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # ckpt.stall_ms convention), not wire bytes — they render in the
     # gradient-wire section below, not the comm byte table
     _WIRE_TIME_COUNTERS = ("grad_wire.exposed_ms", "qwz.prefetch_hits")
+    # elastic.* counts world-size transitions (shrinks/regrows), not
+    # wire bytes — Resilience rows, like fault.*
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
-                                          "watchdog.", "exchange."))
+                                          "watchdog.", "exchange.",
+                                          "elastic."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -313,6 +316,17 @@ def render_markdown(run: Dict[str, Any]) -> str:
     if dem:
         res_rows.append(f"| overlap wire demotions to the serial path | "
                         f"{dem['calls']:,} |")
+    # elastic world-size transitions consumed on restore
+    # (engine._log_checkpoint_reshard; the supervisor side renders in
+    # the "Elastic transitions" ledger block below)
+    shr = any_comm.get("elastic.shrinks")
+    if shr:
+        res_rows.append(f"| elastic shrinks (resumed at a smaller dp) | "
+                        f"{shr['calls']:,} |")
+    reg = any_comm.get("elastic.regrows")
+    if reg:
+        res_rows.append(f"| elastic regrows (resumed at a larger dp) | "
+                        f"{reg['calls']:,} |")
     wd = run.get("watchdog_trip")
     if wd:
         res_rows.append(f"| last watchdog trip | rank "
@@ -347,6 +361,34 @@ def render_markdown(run: Dict[str, Any]) -> str:
                 f"{_fmt(r.get('ran_for_s'), 1, 's')} | "
                 f"{r.get('exit_code', '—')} | {dead} | {backoff} | "
                 f"{diag} |")
+        lines.append("")
+
+    # elastic world-size transitions out of the same ledger
+    # (supervisor --elastic-shrink: relaunch on the survivors, grow
+    # back when capacity returns) — their own block beside Restarts so
+    # the shrink->grow story reads without grepping reasons
+    transitions = [r for r in restarts
+                   if r.get("transition") in ("shrink", "regrow")
+                   or (r.get("from_world") is not None
+                       and r.get("to_world") is not None
+                       and r["from_world"] != r["to_world"])]
+    if transitions:
+        lines.append("## Elastic transitions")
+        lines.append("")
+        lines.append("| # | transition | world | dead ranks | "
+                     "incarnation | reason | resharding |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for i, r in enumerate(transitions):
+            f_w, t_w = r.get("from_world"), r.get("to_world")
+            kind = r.get("transition") or (
+                "shrink" if (f_w or 0) > (t_w or 0) else "regrow")
+            dead = ",".join(str(d) for d in (r.get("dead_ranks") or [])) \
+                or "—"
+            lines.append(
+                f"| {i + 1} | {kind} | {f_w if f_w is not None else '?'} "
+                f"→ {t_w if t_w is not None else '?'} | {dead} | "
+                f"{r.get('incarnation', '—')} | {r.get('reason', '?')} | "
+                f"ZeRO state re-partitions dp {f_w}→{t_w} on restore |")
         lines.append("")
 
     # hierarchical gradient wire: the per-level (fast/slow fabric) byte

@@ -29,8 +29,9 @@ bandwidth contender — same positioning as the reference's MPI path.
 
 from __future__ import annotations
 
+import os
 import time
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -38,6 +39,55 @@ from ..resilience import (fault_point, is_transient_not_timeout,
                           retry_transient)
 
 DEFAULT_TIMEOUT_MS = 120_000
+
+# -- incarnation scoping (elastic shrink-to-survivors restarts) -------------
+# The coordination-service KV is write-once per key and a dead rank's
+# keys are never cleaned (nobody can know what it posted mid-flight).
+# An elastic restart that reuses the SAME coordination service (the
+# supervisor relaunches into the same job) would therefore collide with
+# — or worse, silently CONSUME — the dead generation's keys: commit-
+# barrier done/committed keys (a re-save of the same tag restarts its
+# per-process seq counter at 0 in the fresh process), rendezvous
+# addresses, gather payloads.  The supervisor exports DSTPU_INCARNATION
+# (bumped on every relaunch, elasticity/supervisor.py) and EVERY key on
+# this wire is namespaced by it, extending PR 8's generation-scoped
+# gathers to the whole KV surface.  Incarnation 0 (no supervisor, or
+# the first launch) keeps today's unprefixed keys.
+
+INCARNATION_ENV = "DSTPU_INCARNATION"
+_INCARNATION: Optional[int] = None
+
+
+def incarnation() -> int:
+    """The cached incarnation id this process runs as (env-derived;
+    engines validate + log it at init via elasticity.elastic_env)."""
+    global _INCARNATION
+    if _INCARNATION is None:
+        raw = os.environ.get(INCARNATION_ENV, "0").strip() or "0"
+        try:
+            _INCARNATION = max(0, int(raw))
+        except ValueError:
+            raise ValueError(
+                f"hostwire: {INCARNATION_ENV}={raw!r} is not an integer "
+                f"— the supervisor exports a numeric relaunch counter; "
+                f"a garbled value would silently de-scope every KV key")
+    return _INCARNATION
+
+
+def set_incarnation(n: Optional[int]) -> None:
+    """Pin (or with None re-read from env) the incarnation id — engine
+    init after validating the elastic env, and tests."""
+    global _INCARNATION
+    _INCARNATION = None if n is None else max(0, int(n))
+
+
+def scoped_key(key: str) -> str:
+    """Namespace a KV key by the current incarnation.  Applied at every
+    client call boundary in this module, so a survivor-generation run
+    can never consume (or collide with) a dead generation's write-once
+    keys."""
+    inc = incarnation()
+    return key if inc == 0 else f"dstpu-inc{inc}/{key}"
 
 # -- scaling envelope (documented contract) ---------------------------------
 # The KV store relays every value THROUGH the coordinator as one gRPC
@@ -148,11 +198,13 @@ def _kv_set_write_once(client, key: str, value: str, site: str) -> None:
     peers someone else's bytes, so that stays a loud failure."""
     attempt = [0]
 
+    skey = scoped_key(key)
+
     def op():
         attempt[0] += 1
         fault_point(site)
         try:
-            client.key_value_set(key, value)
+            client.key_value_set(skey, value)
         except Exception as e:
             if attempt[0] > 1 and \
                     "ALREADY_EXISTS" in str(e).upper().replace(" ", "_"):
@@ -202,11 +254,13 @@ def _kv_get(client, key: str, timeout_ms: int) -> bytes:
     # time that is left
     deadline = time.monotonic() + timeout_ms / 1000.0
 
+    skey = scoped_key(key)
+
     def op():
         fault_point("hostwire.kv_get")
         left = max(1, int((deadline - time.monotonic()) * 1000))
         return base64.b64decode(
-            client.blocking_key_value_get(key, left))
+            client.blocking_key_value_get(skey, left))
 
     return retry_transient(op, site=f"hostwire.kv_get {key}")
 
@@ -241,9 +295,11 @@ class KVSignals:
                 "KVSignals.wait: no coordination-service client attached "
                 "(single-process run?) — nothing ever posts keys here")
 
+        skey = scoped_key(key)
+
         def op():
             fault_point("kv.wait")
-            return self.client.blocking_key_value_get(key, int(timeout_ms))
+            return self.client.blocking_key_value_get(skey, int(timeout_ms))
 
         # the blocking timeout IS the dead-peer detector here (commit
         # barrier): transient transport blips retry, deadlines do not —
@@ -255,7 +311,7 @@ class KVSignals:
     def delete(self, key: str) -> None:
         if self.client is None:
             return
-        self.client.key_value_delete(key)
+        self.client.key_value_delete(scoped_key(key))
 
 
 class HostWire:
@@ -359,13 +415,18 @@ class HostWire:
                 for i in range(counts[r])))
         # nobody may delete until everyone has read; nobody may proceed
         # to the NEXT step's set() until this step's keys are gone
-        self.client.wait_at_barrier(f"{key}/read", self.timeout_ms)
+        # (barrier ids and deletes carry the same incarnation scope the
+        # sets landed under)
+        self.client.wait_at_barrier(scoped_key(f"{key}/read"),
+                                    self.timeout_ms)
         if self.rank == 0:
             for r in range(self.world):
-                self.client.key_value_delete(f"{key}/{r}/n")
+                self.client.key_value_delete(scoped_key(f"{key}/{r}/n"))
                 for i in range(counts[r]):
-                    self.client.key_value_delete(f"{key}/{r}/{i}")
-        self.client.wait_at_barrier(f"{key}/clean", self.timeout_ms)
+                    self.client.key_value_delete(
+                        scoped_key(f"{key}/{r}/{i}"))
+        self.client.wait_at_barrier(scoped_key(f"{key}/clean"),
+                                    self.timeout_ms)
         self._step += 1
         return out
 

@@ -1056,14 +1056,17 @@ class SocketExchange(_ExchangeBase):
         deadline = time.monotonic() + timeout_ms / 1000.0
 
         def read(q: int):
-            # raw read (values ride the wire base64'd, like _kv_get):
-            # remaining time recomputed per attempt from ONE shared
-            # deadline, and deadline-exceeded NOT retried
+            # raw read (values ride the wire base64'd, like _kv_get, and
+            # the key carries the same incarnation scope _kv_set wrote
+            # it under): remaining time recomputed per attempt from ONE
+            # shared deadline, and deadline-exceeded NOT retried
             import base64
+
+            from .hostwire import scoped_key
 
             left = max(1, int((deadline - time.monotonic()) * 1000))
             return base64.b64decode(self._kv.blocking_key_value_get(
-                f"{self._scope}/demote/arrive/r{q}", left))
+                scoped_key(f"{self._scope}/demote/arrive/r{q}"), left))
 
         final = target
         for q in range(self.nproc):

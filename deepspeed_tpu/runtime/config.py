@@ -321,6 +321,7 @@ class DeepSpeedFaultsConfig(DeepSpeedConfigObject):
       "retry": {"max_attempts": 4, "base_delay_ms": 50,
                 "max_delay_ms": 2000, "jitter": 0.25},
       "watchdog": {"enabled": false, "deadline_s": 600, "poll_s": 1.0,
+                   "first_beat_mult": 4.0,  # pre-first-beat grace
                    "snapshot_dir": null}   # default: the monitor run dir
     }
 
@@ -386,7 +387,8 @@ class DeepSpeedFaultsConfig(DeepSpeedConfigObject):
 
         w = d.get(c.FAULTS_WATCHDOG) or {}
         known_w = {c.FAULTS_WATCHDOG_ENABLED, c.FAULTS_WATCHDOG_DEADLINE_S,
-                   c.FAULTS_WATCHDOG_POLL_S, c.FAULTS_WATCHDOG_SNAPSHOT_DIR}
+                   c.FAULTS_WATCHDOG_POLL_S, c.FAULTS_WATCHDOG_SNAPSHOT_DIR,
+                   c.FAULTS_WATCHDOG_FIRST_BEAT_MULT}
         unknown = set(w) - known_w
         if unknown:
             raise ValueError(
@@ -401,6 +403,28 @@ class DeepSpeedFaultsConfig(DeepSpeedConfigObject):
             w, c.FAULTS_WATCHDOG_POLL_S, c.FAULTS_WATCHDOG_POLL_S_DEFAULT))
         self.watchdog_snapshot_dir = get_scalar_param(
             w, c.FAULTS_WATCHDOG_SNAPSHOT_DIR, None)
+        # grace multiplier on the deadline before the FIRST beat: covers
+        # first-step compile — including an elastic restart's recompile
+        # at the new mesh shape (StepWatchdog docstring).  An explicit
+        # null selects the legacy mode: not armed at all until beat 1.
+        fbm = (w[c.FAULTS_WATCHDOG_FIRST_BEAT_MULT]
+               if c.FAULTS_WATCHDOG_FIRST_BEAT_MULT in w
+               else c.FAULTS_WATCHDOG_FIRST_BEAT_MULT_DEFAULT)
+        try:
+            self.watchdog_first_beat_mult = (None if fbm is None
+                                             else float(fbm))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"faults.watchdog.{c.FAULTS_WATCHDOG_FIRST_BEAT_MULT} "
+                f"must be a number >= 1 or null (null: never armed "
+                f"before the first beat), got {fbm!r}")
+        if self.watchdog_first_beat_mult is not None and \
+                self.watchdog_first_beat_mult < 1.0:
+            raise ValueError(
+                f"faults.watchdog.{c.FAULTS_WATCHDOG_FIRST_BEAT_MULT} "
+                f"must be >= 1 (a sub-1 multiplier would make the "
+                f"compile window stricter than steady state), got "
+                f"{self.watchdog_first_beat_mult}")
         if self.watchdog_enabled and self.watchdog_deadline_s <= 0:
             raise ValueError(
                 f"faults.watchdog.{c.FAULTS_WATCHDOG_DEADLINE_S} must be "

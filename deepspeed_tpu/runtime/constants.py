@@ -193,6 +193,11 @@ FAULTS_WATCHDOG_DEADLINE_S_DEFAULT = 600.0
 FAULTS_WATCHDOG_POLL_S = "poll_s"
 FAULTS_WATCHDOG_POLL_S_DEFAULT = 1.0
 FAULTS_WATCHDOG_SNAPSHOT_DIR = "snapshot_dir"
+FAULTS_WATCHDOG_FIRST_BEAT_MULT = "first_beat_mult"
+# grace multiplier on the deadline BEFORE the first step-boundary beat:
+# an elastic shrink/grow restart pays a full recompile at the new mesh
+# shape, which legitimately lands between construction and beat 1
+FAULTS_WATCHDOG_FIRST_BEAT_MULT_DEFAULT = 4.0
 
 #############################################
 # Precision: fp16 section doubles as the precision section via "type"

@@ -48,12 +48,15 @@ WORKER_RESPAWN_BACKOFF_S = 0.05
 class RepeatingLoader:
     """Wrap an iterator to restart on StopIteration (reference :10-31).
     Advances the wrapped loader's epoch on each wrap so shuffling loaders
-    re-shuffle instead of replaying one permutation."""
+    re-shuffle instead of replaying one permutation.  The counter seeds
+    from the wrapped loader's CURRENT epoch (when it exposes one), so a
+    loader restored mid-run from a sample cursor keeps its shuffle
+    schedule instead of snapping back to epoch 1 on the first wrap."""
 
     def __init__(self, loader):
         self.loader = loader
         self.data_iter = iter(self.loader)
-        self._epoch = 0
+        self._epoch = int(getattr(loader, "epoch", 0) or 0)
 
     def __iter__(self):
         return self
@@ -131,6 +134,22 @@ class DeepSpeedDataLoader:
         self.len = self._samples_per_shard // self._per_shard
         if not self.drop_last and self._samples_per_shard % self._per_shard:
             self.len += 1
+        # sample cursor (elastic exactly-once stream): the CONSUMED-side
+        # position — batches the training loop actually trained on, NOT
+        # batches a prefetch worker produced ahead.  The engine advances
+        # it per trained batch (record_consumed), checkpoints it in the
+        # commit marker's meta (sample_cursor), and a restored loader —
+        # possibly at a DIFFERENT shard count after an elastic shrink —
+        # resumes the epoch at `_start_batch`.  Positions count GLOBAL
+        # batches, which are width-independent: at any shard count W,
+        # batch k of an epoch consumes exactly positions [k*B, (k+1)*B)
+        # of the epoch's padded sample order (rank-strided slicing
+        # commutes with the per-shard batch boundaries), so skipping k
+        # batches at a new width skips exactly the samples the old
+        # width already consumed.
+        self._consumed_epoch = 0
+        self._consumed_position = 0
+        self._start_batch = 0
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -138,12 +157,108 @@ class DeepSpeedDataLoader:
     def __len__(self):
         return self.len
 
+    # -- sample cursor (elastic exactly-once stream) -------------------
+
+    def record_consumed(self, n: int = 1) -> None:
+        """Advance the consumed-side cursor by `n` trained batches
+        (engine-called at train_batch boundaries)."""
+        per_epoch = max(1, self.len)
+        self._consumed_position += int(n)
+        while self._consumed_position >= per_epoch:
+            self._consumed_position -= per_epoch
+            self._consumed_epoch += 1
+
+    def sample_cursor(self) -> dict:
+        """The checkpointable cursor: everything a restoring run (at
+        any shard count) needs to regenerate the exact remaining sample
+        stream."""
+        return {
+            "epoch": self._consumed_epoch,
+            "position": self._consumed_position,
+            "seed": int(self.seed),
+            "shuffle": bool(self.shuffle),
+            "batch_size": int(self.batch_size),
+            "drop_last": bool(self.drop_last),
+            "dataset_len": len(self.dataset),
+        }
+
+    def load_sample_cursor(self, cursor: dict) -> None:
+        """Shard-aware restore of a `sample_cursor()` snapshot, possibly
+        at a different shard count / global batch size than it was saved
+        at.  The saving run's (seed, shuffle) are ADOPTED — the epoch
+        permutation must match or samples would drop/duplicate — and a
+        position in old-batch units converts through the sample count
+        (loud error when the old progress doesn't land on a new batch
+        boundary).  A position past this width's epoch length (padding
+        differences across widths) rolls into the next epoch."""
+        try:
+            epoch = int(cursor["epoch"])
+            position = int(cursor["position"])
+            saved_bs = int(cursor.get("batch_size", self.batch_size))
+        except (KeyError, TypeError, ValueError):
+            raise ValueError(
+                f"sample cursor is malformed (needs integer epoch/"
+                f"position): {cursor!r}")
+        if epoch < 0 or position < 0 or saved_bs < 1:
+            raise ValueError(f"sample cursor out of range: {cursor!r}")
+        if "seed" in cursor and int(cursor["seed"]) != self.seed:
+            logger.warning(
+                f"sample cursor: adopting the saving run's shuffle seed "
+                f"{cursor['seed']} (this loader was built with "
+                f"{self.seed}) — the epoch permutation must match for "
+                f"an exactly-once stream")
+            self.seed = int(cursor["seed"])
+        if "shuffle" in cursor and bool(cursor["shuffle"]) != self.shuffle:
+            logger.warning(
+                f"sample cursor: adopting the saving run's "
+                f"shuffle={bool(cursor['shuffle'])} (this loader was "
+                f"built with {self.shuffle})")
+            self.shuffle = bool(cursor["shuffle"])
+        if cursor.get("dataset_len") is not None and \
+                int(cursor["dataset_len"]) != len(self.dataset):
+            logger.warning(
+                f"sample cursor: dataset length changed "
+                f"({cursor['dataset_len']} -> {len(self.dataset)}) — "
+                f"the exactly-once guarantee only holds over an "
+                f"unchanged dataset")
+        if saved_bs != self.batch_size:
+            samples = position * saved_bs
+            if samples % self.batch_size:
+                raise ValueError(
+                    f"sample cursor: {position} batches of {saved_bs} "
+                    f"({samples} samples) do not land on a batch "
+                    f"boundary of the new global batch size "
+                    f"{self.batch_size} — keep the global batch "
+                    f"constant across elastic transitions (or resume "
+                    f"at a divisible point)")
+            position = samples // self.batch_size
+        per_epoch = max(1, self.len)
+        if position >= per_epoch:
+            # a different width's padding gave the saved epoch more
+            # batches than this width has: the overflow is the next
+            # epoch's head
+            epoch += position // per_epoch
+            position %= per_epoch
+        self.epoch = epoch
+        self._consumed_epoch = epoch
+        self._consumed_position = position
+        self._start_batch = position
+
     def _batch_indices(self):
         """Yield this shard's per-batch sample-index arrays for the
-        CURRENT epoch.  Pure numpy (cheap) — the expensive part
-        (dataset[j] + collate) lives in _materialize, so PrefetchLoader
-        workers can collate different batches in parallel while this
-        generator fixes the deterministic order."""
+        CURRENT epoch, skipping the first `_start_batch` batches after
+        a sample-cursor restore (consumed once; later epochs start at
+        0).  Pure numpy (cheap) — the expensive part (dataset[j] +
+        collate) lives in _materialize, so PrefetchLoader workers can
+        collate different batches in parallel while this generator
+        fixes the deterministic order."""
+        start, self._start_batch = self._start_batch, 0
+        for i, ids in enumerate(self._epoch_batch_indices()):
+            if i >= start:
+                yield ids
+
+    def _epoch_batch_indices(self):
+        """The full epoch's batch-index stream (no cursor skip)."""
         n = len(self.dataset)
         order = np.arange(n)
         if self.shuffle:
@@ -441,6 +556,13 @@ class PrefetchLoader:
 
     def __len__(self):
         return len(self.loader)
+
+    @property
+    def epoch(self):
+        """The wrapped loader's current epoch (RepeatingLoader seeds
+        its wrap counter from this, so a cursor-restored loader keeps
+        its shuffle schedule through the prefetch wrapper)."""
+        return getattr(self.loader, "epoch", 0)
 
     def set_epoch(self, epoch: int):
         if hasattr(self.loader, "set_epoch"):

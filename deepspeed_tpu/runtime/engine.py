@@ -160,6 +160,12 @@ class DeepSpeedEngine:
             raise ValueError(
                 "DeepSpeed requires --deepspeed_config or a config dict")
 
+        # elastic handoff BEFORE the mesh: the supervisor's
+        # DSTPU_SURVIVING_WORLD drives the dp width the mesh is built
+        # at, and a garbled handoff must fail here, loudly, not train
+        # at the wrong world size (elasticity/elastic_env.py validates)
+        self._elastic = self._read_elastic_env()
+
         # mesh first (config's dp world size derives from it)
         self.mesh_info = self._build_mesh(config, mpu)
         self._config = DeepSpeedConfig(
@@ -389,6 +395,79 @@ class DeepSpeedEngine:
             else const.COMM_OVERLAP_TIMEOUT_MS_DEFAULT) / 1000.0
         return cc
 
+    def _read_elastic_env(self):
+        """Consume + validate the supervisor's elastic relaunch handoff
+        (DSTPU_SURVIVING_WORLD / DSTPU_DEAD_RANKS / DSTPU_INCARNATION —
+        elasticity/elastic_env.py).  Non-numeric or inconsistent values
+        raise at init by contract; a legitimate handoff is LOGGED even
+        before the shrink path engages, and the incarnation id is
+        pinned so every coordination-service KV key this process posts
+        is namespaced away from the dead generation's."""
+        from ..elasticity.elastic_env import read_elastic_env
+
+        env = read_elastic_env()
+        # pin unconditionally: a prior engine in this process may have
+        # cached a HIGHER incarnation — booting under a cleared env must
+        # return the KV namespace to unprefixed keys, not inherit it
+        from .comm.hostwire import set_incarnation
+
+        set_incarnation(env.incarnation)
+        if env.active:
+            log_dist(
+                env.describe()
+                + (f"; KV keys scoped to incarnation {env.incarnation}"
+                   if env.incarnation > 0 else "")
+                + ("; the mesh will be rebuilt at the surviving world "
+                   "and state resumes through resharding-on-restore"
+                   if env.surviving_world is not None else ""),
+                ranks=[0])
+        return env
+
+    def _elastic_devices(self, mesh_dict):
+        """Device slice for a DSTPU_SURVIVING_WORLD boot, or None when
+        the mesh should resolve naturally.  The supervisor counts the
+        surviving world in PROCESS units (its dead ranks are process
+        ranks), so:
+
+        * relaunch matches (`process_count == surviving_world`): the
+          survivors' real devices ARE the new world — no override; the
+          mesh resolves over them naturally, so multi-device hosts keep
+          every local chip (dp = devices/other, not the process count).
+        * single-process simulation (`process_count == 1 <
+          surviving_world`): the chaos dry-run shape — the surviving
+          world is read as the dp DEVICE width and the mesh is built
+          over the leading device slice.  Every non-data axis must be
+          explicit (a -1 "take the rest" axis has no defined size once
+          data is pinned).
+        * anything else is a launcher/supervisor disagreement on the
+          world size — refusing loudly beats guessing a mesh."""
+        sw = self._elastic.surviving_world
+        if sw is None:
+            return None
+        procs = jax.process_count()
+        if procs == sw:
+            log_dist(
+                f"elastic restart: running on the {sw} surviving "
+                f"process(es) with {jax.device_count()} device(s) — the "
+                f"mesh resolves over the survivors' devices", ranks=[0])
+            return None
+        if procs != 1:
+            raise ValueError(
+                f"elastic restart: this relaunch has {procs} processes "
+                f"but DSTPU_SURVIVING_WORLD={sw} — the launcher and the "
+                f"supervisor disagree on the surviving world; refusing "
+                f"to guess a mesh")
+        other = 1
+        for axis in ("model", "pipe", "seq"):
+            size = int(mesh_dict.get(axis, 1) or 1)
+            if size == -1:
+                raise ValueError(
+                    f"elastic restart: mesh.{axis}=-1 cannot be resolved "
+                    f"under DSTPU_SURVIVING_WORLD={sw} — give the "
+                    f"{axis} axis an explicit size")
+            other *= max(1, size)
+        return comm.elastic_device_slice(sw * other)
+
     def _build_mesh(self, config, mpu) -> MeshInfo:
         if isinstance(config, str):
             # file-path configs must drive the mesh/hierarchy exactly
@@ -404,14 +483,30 @@ class DeepSpeedEngine:
             mesh_dict = dict(config.get(const.MESH) or {})
         if mpu is not None and not mesh_dict:
             mesh_dict = {"model": mpu.get_model_parallel_world_size()}
+        devices = self._elastic_devices(mesh_dict)
+        if devices is not None:
+            # single-process simulation path only: a matching true
+            # relaunch returned None above and resolves naturally
+            sw = self._elastic.surviving_world
+            if mesh_dict.get("data") not in (None, -1, sw):
+                log_dist(
+                    f"elastic restart: mesh.data={mesh_dict['data']} "
+                    f"overridden by DSTPU_SURVIVING_WORLD={sw} — the "
+                    f"supervisor's survivor count wins", ranks=[0])
+            mesh_dict["data"] = sw
         return comm.make_mesh(
             data=mesh_dict.get("data", -1),
             model=mesh_dict.get("model", 1),
             pipe=mesh_dict.get("pipe", 1),
             seq=mesh_dict.get("seq", 1),
-            data_outer=self._resolve_hierarchy(config, mesh_dict))
+            data_outer=self._resolve_hierarchy(
+                config, mesh_dict,
+                device_count=len(devices) if devices is not None
+                else None),
+            devices=devices)
 
-    def _resolve_hierarchy(self, config, mesh_dict) -> int:
+    def _resolve_hierarchy(self, config, mesh_dict,
+                           device_count=None) -> int:
         """Outer factor for a hierarchical data axis, resolved BEFORE
         full config parsing (the mesh must exist first).  1 == flat.
         Only the bucketed gradient wire consumes the factored axis, so
@@ -419,7 +514,12 @@ class DeepSpeedEngine:
         mesh is pure-DP; anything else logs the reason and stays flat.
         An explicit factor that doesn't divide dp raises a ValueError
         naming the axis sizes (config.check_hierarchy_divides) instead
-        of tracing into a shape error later."""
+        of tracing into a shape error later — EXCEPT on an elastic
+        shrink restart, where a factor sized for the full world may
+        legitimately stop dividing the surviving dp: there it is
+        re-derived from the surviving topology (auto) with a log,
+        because failing the relaunch over a stale perf knob would turn
+        one dead host into a dead job."""
         from .config import check_hierarchy_divides, parse_comm_hierarchy
 
         comm_dict = (config.get(const.COMM) or {}) \
@@ -436,18 +536,28 @@ class DeepSpeedEngine:
                                  _resolve_sizes)
 
         data = mesh_dict.get("data", -1)
-        sizes = _resolve_sizes(jax.device_count(), {
+        sizes = _resolve_sizes(device_count if device_count is not None
+                               else jax.device_count(), {
             _DA: -1 if data is None else data,
             _MA: mesh_dict.get("model", 1),
             _PA: mesh_dict.get("pipe", 1),
             _SA: mesh_dict.get("seq", 1)})
         dp = sizes[_DA]
         if isinstance(hierarchy, int):
-            # an explicit non-dividing factor is a config error even
-            # when another blocker keeps the mesh flat: raising here
-            # (before any "falling back" log) matches the comm-config
-            # validator instead of contradicting it
-            check_hierarchy_divides(hierarchy, dp)
+            if self._elastic.surviving_world is not None and \
+                    dp % int(hierarchy) != 0:
+                log_dist(
+                    f"elastic restart: comm.hierarchy outer={hierarchy} "
+                    f"no longer divides the surviving dp={dp} — "
+                    f"re-deriving the factor from the surviving "
+                    f"topology (auto)", ranks=[0])
+                hierarchy = "auto"
+            else:
+                # an explicit non-dividing factor is a config error even
+                # when another blocker keeps the mesh flat: raising here
+                # (before any "falling back" log) matches the comm-config
+                # validator instead of contradicting it
+                check_hierarchy_divides(hierarchy, dp)
         blockers = []
         if str(comm_dict.get(const.COMM_GRADIENT_REDUCTION,
                              const.COMM_GRADIENT_REDUCTION_DEFAULT)
@@ -635,7 +745,8 @@ class DeepSpeedEngine:
         return resilience.StepWatchdog(
             fc.watchdog_deadline_s, snap_dir,
             escalate_dir=run_dir or snap_dir,
-            poll_s=fc.watchdog_poll_s, rank=comm.get_rank())
+            poll_s=fc.watchdog_poll_s, rank=comm.get_rank(),
+            first_beat_mult=fc.watchdog_first_beat_mult)
 
     def _init_preemption(self):
         """Honor the supervisor's "SIGTERM = save-if-possible" contract
@@ -2127,7 +2238,9 @@ class DeepSpeedEngine:
                     self.gradient_accumulation_steps() == 0)
         feed = self._data_feed(data_iter, scan=use_scan)
         if use_scan:
-            return self._scan_train_batch(data_iter, feed)
+            loss = self._scan_train_batch(data_iter, feed)
+            self._advance_sample_cursor(data_iter)
+            return loss
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
             batch = feed.next() if feed is not None else timed_next(data_iter)
@@ -2136,7 +2249,20 @@ class DeepSpeedEngine:
             if feed is not None:
                 feed.schedule()  # H2D of micro N+1 rides under micro N
         self.step()
+        self._advance_sample_cursor(data_iter)
         return jnp.mean(jnp.stack(losses))
+
+    def _advance_sample_cursor(self, data_iter):
+        """Advance the engine-owned loader's consumed-side sample
+        cursor by the gas batches this train_batch trained on.  Only
+        the OWNED iterator advances it: batches a user iterator serves
+        are outside the exactly-once contract, and prefetch lookahead
+        never counts (produced != consumed)."""
+        if data_iter is not getattr(self, "_train_iter", None):
+            return
+        rec = getattr(self.training_dataloader, "record_consumed", None)
+        if rec is not None:
+            rec(self.gradient_accumulation_steps())
 
     def _scan_train_batch(self, data_iter, feed=None):
         if self._overlap_exchange is not None:
@@ -2622,14 +2748,25 @@ class DeepSpeedEngine:
         """reference engine.py:882 — build the distributed dataloader.
 
         Single-controller JAX consumes the GLOBAL micro batch
-        (micro_per_gpu * dp_world) per forward; the loader internally
-        strides it across processes in multi-host mode."""
+        (micro_per_gpu * dp_world) per forward, and EVERY process
+        assembles the SAME global batch: `device_put(host_value,
+        global_sharding)` treats each process's value as the global
+        array (the same-value-everywhere contract, _compat.py), so a
+        process-strided per-shard slice here would hand it W different
+        "globals" and silently train on a torn mix of them — found by
+        the elastic campaign's cross-width loss-parity pin.  Each
+        process transfers only its addressable shard of the batch it
+        assembled, so device bytes stay 1/dp; the host-side read
+        amplification is the single-controller trade.  (Per-process
+        strided loading remains available to direct
+        DeepSpeedDataLoader users via the data_parallel_* arguments.)"""
         global_micro = (batch_size if batch_size is not None else
                         self.train_micro_batch_size_per_gpu() *
                         self.dp_world_size)
         return DeepSpeedDataLoader(
             dataset, batch_size=global_micro, shuffle=True,
-            collate_fn=collate_fn or self.collate_fn)
+            collate_fn=collate_fn or self.collate_fn,
+            data_parallel_world_size=1, data_parallel_rank=0)
 
     def save_fp16_model(self, save_dir, save_filename="mp_rank_00_model_states.msgpack"):
         """Weights-only export in the compute dtype (reference
@@ -2723,6 +2860,15 @@ class DeepSpeedEngine:
         }
         if self.zero_plan is not None:
             meta.update(self.zero_plan.partition_layout())
+        cursor_fn = getattr(self.training_dataloader, "sample_cursor",
+                            None)
+        if cursor_fn is not None:
+            # global sample cursor (epoch, position, shuffle seed): a
+            # restoring run — at ANY dp width — resumes the engine-owned
+            # loader exactly one batch past the last trained one, so
+            # across a shrink->grow cycle every sample is consumed
+            # exactly once (runtime/dataloader.py load_sample_cursor)
+            meta["sample_cursor"] = cursor_fn()
         return meta
 
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
@@ -2824,14 +2970,54 @@ class DeepSpeedEngine:
         """Announce a topology transition recorded in the commit marker
         (saved (dp, hierarchy, stage) != restoring) — the actual
         re-partition is the device_put under this run's own sharding
-        plan below; this makes it legible instead of silent."""
+        plan below; this makes it legible instead of silent.  An
+        elastic world-size transition additionally bumps the
+        `elastic.shrinks`/`elastic.regrows` counters (rendered in the
+        run report's Resilience section, excluded from the comm byte
+        table like `fault.*`).  Returns the marker so callers (sample-
+        cursor restore) don't pay the read twice."""
         from .zero.partition import describe_reshard
 
         marker = ckpt_io.read_tag_meta(load_dir, os.path.basename(ckpt_dir))
-        msg = describe_reshard((marker or {}).get("meta"),
-                               self._checkpoint_meta())
+        saved = (marker or {}).get("meta")
+        msg = describe_reshard(saved, self._checkpoint_meta(),
+                               reason=(self._elastic.reason
+                                       if self._elastic.active else None))
         if msg:
             log_dist(msg, ranks=[0])
+        try:
+            saved_dp = int((saved or {}).get("dp_world_size"))
+        except (TypeError, ValueError):
+            saved_dp = None
+        if saved_dp is not None:
+            cur_dp = self.mesh_info.get_data_parallel_world_size()
+            if cur_dp < saved_dp:
+                COUNTERS.add("elastic.shrinks")
+            elif cur_dp > saved_dp:
+                COUNTERS.add("elastic.regrows")
+        return marker
+
+    def _restore_sample_cursor(self, marker):
+        """Apply the commit marker's global sample cursor to the
+        engine-owned loader (shard-aware: the loader converts the
+        position to ITS width), and drop any iterator/prefetch/device-
+        feed state built before the restore — those batches came from
+        the pre-restore cursor and would double-serve samples."""
+        loader = self.training_dataloader
+        restore = getattr(loader, "load_sample_cursor", None)
+        cursor = ((marker or {}).get("meta") or {}).get("sample_cursor")
+        if cursor is None or restore is None:
+            return
+        restore(cursor)
+        # drop iterator/prefetch/device-feed state built on the stale
+        # cursor (one teardown path: prefetch threads, both feeds,
+        # the owned iterator)
+        self.close_data_pipeline()
+        log_dist(
+            f"sample cursor restored: epoch {loader._consumed_epoch}, "
+            f"batch {loader._consumed_position} of {len(loader)} — the "
+            f"exactly-once stream resumes shard-aware at "
+            f"dp={self.dp_world_size}", ranks=[0])
 
     def _checkpoint_tag_validation(self, tag):
         """All ranks must agree on the tag (reference :1671-1686). In
@@ -2861,7 +3047,8 @@ class DeepSpeedEngine:
             # throw the run away.
             logger.warning(f"load_checkpoint: {e}")
             return None, {}
-        self._log_checkpoint_reshard(load_dir, ckpt_dir)
+        marker = self._log_checkpoint_reshard(load_dir, ckpt_dir)
+        self._restore_sample_cursor(marker)
 
         if self._infinity is not None:
             if paged and ckpt_io.has_stream_markers(model_state["module"]):

@@ -564,13 +564,26 @@ class StepWatchdog:
     it spuriously; the 600 s default is sized for that, chaos tests use
     a couple of seconds.
 
+    First-beat grace (`first_beat_mult`): BEFORE the first beat lands,
+    the effective deadline is `deadline_s * first_beat_mult` anchored
+    at construction.  The window before beat 1 is where full program
+    compilation lives, and an ELASTIC restart (shrink-to-survivors or
+    grow-back, elasticity/supervisor.py) recompiles every step program
+    at the new mesh shape — a legitimate shrink-restart must not trip
+    the watchdog that exists to catch the hang it is recovering from.
+    `first_beat_mult=None` keeps the legacy behavior: not armed until
+    the first beat (a pre-training hang is then the supervisor's
+    stall-timeout's problem, not this watchdog's).  The engine wires
+    `faults.watchdog.first_beat_mult` (default 4.0) here.
+
     The thread is daemonized and wakes every `poll_s`; `clock` and
     `on_trip` are injectable for tests."""
 
     def __init__(self, deadline_s: float, snapshot_dir: str,
                  escalate_dir: Optional[str] = None, poll_s: float = 1.0,
                  rank: int = 0, clock=time.monotonic,
-                 on_trip: Optional[Callable[[Dict[str, Any]], None]] = None):
+                 on_trip: Optional[Callable[[Dict[str, Any]], None]] = None,
+                 first_beat_mult: Optional[float] = None):
         if float(deadline_s) <= 0:
             raise ValueError(
                 f"watchdog deadline_s must be > 0, got {deadline_s}")
@@ -578,7 +591,14 @@ class StepWatchdog:
             # Event.wait(0) never blocks: a zero poll busy-spins the
             # daemon thread on a core for the whole run
             raise ValueError(f"watchdog poll_s must be > 0, got {poll_s}")
+        if first_beat_mult is not None and float(first_beat_mult) < 1.0:
+            # a sub-1 multiplier would make the COMPILE window stricter
+            # than steady state — always wrong
+            raise ValueError(f"watchdog first_beat_mult must be >= 1, "
+                             f"got {first_beat_mult}")
         self.deadline_s = float(deadline_s)
+        self.first_beat_mult = (None if first_beat_mult is None
+                                else float(first_beat_mult))
         self.snapshot_dir = snapshot_dir
         self.escalate_dir = escalate_dir or snapshot_dir
         self.poll_s = float(poll_s)
@@ -586,6 +606,7 @@ class StepWatchdog:
         self._clock = clock
         self._on_trip = on_trip
         self._lock = threading.Lock()
+        self._armed_at = clock()
         self._last_beat: Optional[float] = None
         self._last_step: Optional[int] = None
         self._tripped = False
@@ -647,7 +668,22 @@ class StepWatchdog:
             with self._lock:
                 beat, step = self._last_beat, self._last_step
                 tripped = self._tripped
-            if beat is None or tripped:
+                armed_at = self._armed_at
+            if tripped:
+                continue
+            if beat is None:
+                # pre-first-beat: only armed when a first-beat grace
+                # multiplier was configured (recompile after an elastic
+                # restart legitimately lands in this window)
+                if self.first_beat_mult is None:
+                    continue
+                stalled = self._clock() - armed_at
+                if stalled > self.deadline_s * self.first_beat_mult:
+                    try:
+                        self.trip(stalled, None, first_beat=True)
+                    except Exception as e:
+                        logger.error(
+                            f"watchdog trip handling failed: {e}")
                 continue
             stalled = self._clock() - beat
             if stalled > self.deadline_s:
@@ -656,16 +692,25 @@ class StepWatchdog:
                 except Exception as e:  # the watchdog must never crash
                     logger.error(f"watchdog trip handling failed: {e}")
 
-    def trip(self, stalled_s: float, step: Optional[int]) -> None:
+    def trip(self, stalled_s: float, step: Optional[int],
+             first_beat: bool = False) -> None:
         with self._lock:
             if self._tripped:
                 return
             self._tripped = True
             self._trips += 1
             n = self._trips
-        reason = (f"step deadline exceeded: no step-boundary progress in "
-                  f"{stalled_s:.1f}s (> {self.deadline_s:.1f}s) after step "
-                  f"{step}")
+        if first_beat:
+            reason = (f"first step never completed: no step-boundary "
+                      f"beat in {stalled_s:.1f}s since arming (> "
+                      f"{self.deadline_s:.1f}s x first_beat_mult "
+                      f"{self.first_beat_mult:g} — sized to cover "
+                      f"first-step compile, incl. an elastic restart's "
+                      f"recompile at the new mesh shape)")
+        else:
+            reason = (f"step deadline exceeded: no step-boundary progress "
+                      f"in {stalled_s:.1f}s (> {self.deadline_s:.1f}s) "
+                      f"after step {step}")
         logger.error(f"watchdog TRIP (rank {self.rank}): {reason}")
         COUNTERS.add("watchdog.trips")
         snapshot = {

@@ -562,12 +562,16 @@ class QuantizedWeightGather:
                 f"gather; master weights stay full precision")
 
 
-def describe_reshard(saved: Optional[dict], current: dict) -> Optional[str]:
+def describe_reshard(saved: Optional[dict], current: dict,
+                     reason: Optional[str] = None) -> Optional[str]:
     """Human-readable description of a checkpoint topology transition, or
     None when the saved and restoring layouts match (nothing to reshard
     beyond placement).  `saved` is a partition_layout() dict out of the
     checkpoint's commit marker; unknown/legacy checkpoints (None) return
-    None — there is nothing trustworthy to compare against."""
+    None — there is nothing trustworthy to compare against.  `reason`
+    (an elastic trigger, e.g. "rank 3 died: heartbeat stall") is
+    appended so the shrink/regrow log line names WHY the world changed,
+    not just that it did."""
     if not saved:
         return None
 
@@ -584,4 +588,5 @@ def describe_reshard(saved: Optional[dict], current: dict) -> Optional[str]:
     return (f"resharding checkpoint state: saved at {fmt(saved)} -> "
             f"restoring at {fmt(current)} (ZeRO-1/2 partitions, including "
             f"hpZ secondary shards, re-partition to the new layout on "
-            f"device_put)")
+            f"device_put)"
+            + (f" [elastic trigger: {reason}]" if reason else ""))

@@ -854,20 +854,634 @@ def run_tcp_overlap(nproc=2, steps=8, record=True, scratch=None,
             shutil.rmtree(scratch, ignore_errors=True)
 
 
+# ---------------------------------------------------------------------------
+# elastic shrink-to-survivors campaigns: kill a rank, shrink, grow back,
+# lose zero samples (ISSUE 11; docs/tutorials/elasticity.md)
+# ---------------------------------------------------------------------------
+
+ELASTIC_BATCH = 24            # divisible by every width in the campaign
+ELASTIC_DRY_N = 144           # 6 batches/epoch at B=24
+ELASTIC_DRY_TOTAL = 12        # 2 full epochs
+ELASTIC_DRY_KILL_AT = 5       # the simulated rank death lands here
+ELASTIC_DRY_REGROW_AT = 9     # the shrunken phase hands back here
+
+
+class _LedgerRegression(_SyntheticRegression):
+    """_SyntheticRegression that LOGS every __getitem__ index — the
+    sample ledger the exactly-once claim is pinned against.  Lanes run
+    with the data pipeline disabled so pulls == trained batches."""
+
+    def __init__(self, n, dim=DIM, out=4, seed=0):
+        super().__init__(n, dim=dim, out=out, seed=seed)
+        self.log = []
+
+    def __getitem__(self, i):
+        self.log.append(int(i))
+        return super().__getitem__(i)
+
+
+def _elastic_env_vars():
+    """The elastic env contract, from its single source of truth
+    (imported lazily: deepspeed_tpu pulls jax, which launcher-side code
+    paths must not)."""
+    from deepspeed_tpu.elasticity.elastic_env import ELASTIC_ENV_VARS
+
+    return ELASTIC_ENV_VARS
+
+
+class _elastic_env:
+    """Scoped DSTPU_* elastic env for one in-process phase (the dry run
+    plays supervisor: each phase is one incarnation's boot)."""
+
+    def __init__(self, surviving=None, dead=None, incarnation=0,
+                 restart=False, reason=None):
+        self._want = {
+            "DSTPU_SURVIVING_WORLD": (None if surviving is None
+                                      else str(surviving)),
+            "DSTPU_DEAD_RANKS": (None if not dead else
+                                 ",".join(str(r) for r in dead)),
+            "DSTPU_INCARNATION": str(incarnation),
+            "DSTPU_ELASTIC_RESTART": "1" if restart else None,
+            "DSTPU_ELASTIC_REASON": reason,
+        }
+
+    def __enter__(self):
+        from deepspeed_tpu.runtime.comm.hostwire import set_incarnation
+
+        env_vars = _elastic_env_vars()
+        self._saved = {k: os.environ.get(k) for k in env_vars}
+        for k in env_vars:
+            os.environ.pop(k, None)
+        for k, v in self._want.items():
+            if v is not None:
+                os.environ[k] = v
+        set_incarnation(None)  # re-read the env lazily
+        return self
+
+    def __exit__(self, *exc):
+        from deepspeed_tpu.runtime.comm.hostwire import set_incarnation
+
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        set_incarnation(None)
+        return False
+
+
+def elastic_dry_lane(dataset, ckpt_dir, until_step, *, resume=False,
+                     save=True, monitor_path=None, job_name="elastic"):
+    """One incarnation of the dry campaign: boot (under whatever elastic
+    env the caller scoped), optionally resume from `ckpt_dir`, train to
+    `until_step` off the engine-owned loader, checkpointing each step.
+    Returns (losses, counter_delta, run_dir)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    cfg = {
+        "train_batch_size": ELASTIC_BATCH,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        # pulls == trained batches: the ledger dataset logs consumption
+        "data_pipeline": {"enabled": False},
+    }
+    if monitor_path is not None:
+        cfg["monitor"] = {"enabled": True, "output_path": monitor_path,
+                          "job_name": job_name, "flush_interval": 1,
+                          "flops": False, "heartbeat_interval": 1}
+    engine, *_ = ds.initialize(model=_mlp(), config_params=cfg,
+                               training_data=dataset,
+                               dist_init_required=False)
+    snap = COUNTERS.snapshot()
+    if resume:
+        engine.load_checkpoint(ckpt_dir)
+    losses = []
+    while engine.global_steps < until_step:
+        losses.append(float(engine.train_batch()))
+        if save:
+            engine.save_checkpoint(ckpt_dir,
+                                   tag=f"step{engine.global_steps}")
+    ckpt_io.flush_pending()
+    delta = COUNTERS.delta_since(snap)
+    run_dir = (engine.run_monitor.run_dir
+               if engine.run_monitor is not None else None)
+    engine.finalize_monitoring()
+    return losses, delta, run_dir
+
+
+def run_dry_elastic(artifact_root=None, record=True, root=None):
+    """Tier-1 CPU elastic campaign (in-process, 8 virtual devices):
+    kill-simulated rank at dp 4 -> shrink to the 3 survivors -> grow
+    back to 4 — with the sample ledger pinned exactly-once and the loss
+    ledger pinned against the uninterrupted oracle.
+
+    Lanes (each a fresh engine booted under the env the supervisor
+    would export — `plan_world_transition` computes the same shrink/
+    regrow the real supervise() loop applies):
+
+      oracle   dp4, 12 steps uninterrupted (2 exact epochs of 144)
+      A        dp4, incarnation 0: 5 steps, checkpoint each, "killed"
+      D        dp4 resume (same world): remaining 7 steps — loss parity
+               EXACT vs the oracle
+      B        dp3 shrink (incarnation 1): 4 steps — resharding-on-
+               restore, `elastic.shrinks` == 1, parity within
+               reduction-order tolerance
+      C        dp4 regrow (incarnation 2): 3 steps — `elastic.regrows`
+               == 1, ledger + report render both transitions
+
+    The A+B+C sample ledger must equal the oracle's: every one of the
+    144 samples consumed exactly twice (once per epoch) — no drops, no
+    double-counts across either transition."""
+    import numpy as np
+
+    from collections import Counter
+
+    from deepspeed_tpu.elasticity.supervisor import (_ledger_append,
+                                                     plan_world_transition)
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    made_root = root is None
+    root = root or tempfile.mkdtemp(prefix="chaos_elastic_")
+    try:
+        ck = os.path.join(root, "ck")
+        runs = os.path.join(root, "runs")
+
+        def fresh_data():
+            return _LedgerRegression(ELASTIC_DRY_N)
+
+        with _elastic_env(surviving=4):
+            oracle_data = fresh_data()
+            oracle_losses, _, _ = elastic_dry_lane(
+                oracle_data, os.path.join(root, "ck_oracle"),
+                ELASTIC_DRY_TOTAL)
+
+        with _elastic_env(surviving=4, incarnation=0):
+            a_data = fresh_data()
+            a_losses, _, _ = elastic_dry_lane(a_data, ck,
+                                              ELASTIC_DRY_KILL_AT)
+        assert a_losses == oracle_losses[:ELASTIC_DRY_KILL_AT], \
+            "pre-kill lane diverged from the oracle"
+
+        # same-world resume: EXACT parity (saves nothing — lane B must
+        # resume from the kill-point tag, not D's later ones)
+        with _elastic_env(surviving=4):
+            d_data = fresh_data()
+            d_losses, d_delta, _ = elastic_dry_lane(
+                d_data, ck, ELASTIC_DRY_TOTAL, resume=True, save=False)
+        assert d_losses == oracle_losses[ELASTIC_DRY_KILL_AT:], \
+            (f"same-world resume must be EXACT: "
+             f"{d_losses} vs {oracle_losses[ELASTIC_DRY_KILL_AT:]}")
+        assert not d_delta.get("elastic.shrinks") and \
+            not d_delta.get("elastic.regrows"), d_delta
+        assert Counter(a_data.log + d_data.log) == \
+            Counter(oracle_data.log), "same-world resume ledger mismatch"
+
+        # shrink to the 3 survivors (what supervise() would compute)
+        to_w, transition = plan_world_transition(
+            4, 4, [3], elastic_shrink=True, min_world=1)
+        assert (to_w, transition) == (3, "shrink")
+        with _elastic_env(surviving=3, dead=[3], incarnation=1,
+                          restart=True,
+                          reason="rank(s) [3] went quiet first"):
+            b_data = fresh_data()
+            b_losses, b_delta, _ = elastic_dry_lane(
+                b_data, ck, ELASTIC_DRY_REGROW_AT, resume=True)
+        assert b_delta.get("elastic.shrinks", {}).get("calls") == 1, \
+            b_delta
+        assert np.allclose(
+            b_losses,
+            oracle_losses[ELASTIC_DRY_KILL_AT:ELASTIC_DRY_REGROW_AT],
+            rtol=1e-4, atol=1e-6), \
+            (f"cross-world resume outside reduction-order tolerance: "
+             f"{b_losses} vs "
+             f"{oracle_losses[ELASTIC_DRY_KILL_AT:ELASTIC_DRY_REGROW_AT]}")
+
+        # capacity back: grow to the full width
+        to_w2, transition2 = plan_world_transition(
+            3, 4, [], elastic_shrink=True, min_world=1)
+        assert (to_w2, transition2) == (4, "regrow")
+        with _elastic_env(surviving=4, incarnation=2, restart=True,
+                          reason="capacity restored"):
+            c_data = fresh_data()
+            c_losses, c_delta, run_dir = elastic_dry_lane(
+                c_data, ck, ELASTIC_DRY_TOTAL, resume=True,
+                monitor_path=runs, job_name="elastic")
+        assert c_delta.get("elastic.regrows", {}).get("calls") == 1, \
+            c_delta
+        assert np.allclose(c_losses,
+                           oracle_losses[ELASTIC_DRY_REGROW_AT:],
+                           rtol=1e-4, atol=1e-6), \
+            (c_losses, oracle_losses[ELASTIC_DRY_REGROW_AT:])
+        # the replicate-over-data-axis fallback must never fire: the
+        # padded loader keeps every batch on the sharded path at every
+        # width, so a resume can't double-count through replication
+        for d in (b_delta, c_delta, d_delta):
+            assert not d.get("input.replicated_batches"), d
+
+        # THE claim: across kill -> shrink -> regrow, every sample of
+        # every epoch is consumed exactly once — the multiset equals
+        # the uninterrupted oracle's (each index exactly twice here)
+        ledger = Counter(a_data.log + b_data.log + c_data.log)
+        assert ledger == Counter(oracle_data.log), (
+            "sample ledger broken across the shrink/grow cycle: "
+            f"{len(+(ledger - Counter(oracle_data.log)))} over-consumed, "
+            f"{len(+(Counter(oracle_data.log) - ledger))} dropped")
+        assert set(ledger.values()) == {2}, ledger
+
+        # the supervisor-side ledger + report: both transitions render
+        ledger_path = os.path.join(run_dir, "restarts.jsonl")
+        _ledger_append(ledger_path, {
+            "t": time.time(), "event": "restart", "attempt": 2,
+            "ran_for_s": 1.0, "exit_code": 1,
+            "reason": "rank(s) [3] went quiet first",
+            "dead_ranks": [3], "backoff_s": 0.05,
+            "from_world": 4, "to_world": to_w, "transition": transition,
+            "incarnation": 1, "restarts_used": 1})
+        _ledger_append(ledger_path, {
+            "t": time.time(), "event": "restart", "attempt": 3,
+            "ran_for_s": 1.0, "exit_code": 75,
+            "reason": "capacity restored", "dead_ranks": [],
+            "backoff_s": 0.05, "from_world": to_w, "to_world": to_w2,
+            "transition": transition2, "incarnation": 2,
+            "restarts_used": 2})
+        md = render_markdown(load_run(run_dir))
+        assert "Elastic transitions" in md, md
+        assert "shrink | 4 → 3" in md and "regrow | 3 → 4" in md, md
+        assert "elastic regrows (resumed at a larger dp)" in md, md
+
+        result = {
+            "metric": "chaos_elastic_cpu_dryrun",
+            "platform": "cpu",
+            "steps": ELASTIC_DRY_TOTAL,
+            "world_path": [4, 3, 4],
+            "kill_at": ELASTIC_DRY_KILL_AT,
+            "samples_exactly_once": True,
+            "same_world_resume_parity": "exact",
+            "cross_world_resume_parity": "reduction-order tolerance",
+            "shrinks": 1,
+            "regrows": 1,
+            "supervisor_restarts": 0,
+            "value": 2,
+            "unit": "elastic_transitions_survived",
+            "losses": [round(x, 6) for x in oracle_losses],
+        }
+        if record:
+            from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+            result["artifact"] = record_bench_result(
+                result, root=artifact_root, name=result["metric"])
+        return result
+    finally:
+        from deepspeed_tpu.runtime import resilience
+
+        resilience.install_fault_plan(None)
+        resilience.install_retry_policy(None)
+        if made_root:
+            shutil.rmtree(root, ignore_errors=True)
+
+
+# -- the real 2-proc TCP shrink lane ----------------------------------------
+# supervise() drives a LAUNCHER child; the launcher spawns the jax
+# worker processes at whatever world DSTPU_SURVIVING_WORLD dictates,
+# reports a dead worker's rank via elastic_report.json, and the
+# supervisor's --elastic-shrink policy relaunches the survivors.
+
+ELASTIC_TCP_N = 96            # 4 batches/epoch at B=24
+ELASTIC_TCP_TOTAL = 12        # 3 exact epochs
+ELASTIC_TCP_KILL_AT = 5       # rank 1 self-kills at this step boundary
+ELASTIC_TCP_REGROW_AT = 9     # the shrunken incarnation hands back here
+
+
+def _elastic_rank(args):
+    """One jax worker of the elastic TCP campaign.  Appends one JSON
+    line per COMPLETED step to result_rank<r>.jsonl (a killed
+    incarnation's in-flight step therefore never pollutes the ledger —
+    exactly the batch the resume re-serves), plus a `done` record with
+    the incarnation's counter deltas."""
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    world = args.nproc
+    if world > 1:
+        jax.distributed.initialize(coordinator_address=args.coord,
+                                   num_processes=world,
+                                   process_id=args.proc_id)
+    import deepspeed_tpu as ds  # noqa: F401  (gloo-collectives flag first)
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.runtime import checkpointing as ckpt_io
+
+    inc = int(os.environ.get("DSTPU_INCARNATION", "0") or 0)
+    ckpt_dir = os.path.join(args.scratch, "ck")
+    data = _LedgerRegression(ELASTIC_TCP_N)
+    cfg = {
+        "train_batch_size": ELASTIC_BATCH,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 0,
+        "data_pipeline": {"enabled": False},
+    }
+    if args.monitor_dir:
+        cfg["monitor"] = {"enabled": True,
+                          "output_path": os.path.dirname(args.monitor_dir),
+                          "job_name": os.path.basename(args.monitor_dir),
+                          "flush_interval": 1, "flops": False,
+                          "heartbeat_interval": 1}
+    if args.kill_rank >= 0:
+        cfg["faults"] = {"rules": [
+            {"site": "engine.step", "kind": "kill", "exit_code": 173,
+             "steps": [ELASTIC_TCP_KILL_AT], "rank": args.kill_rank}]}
+    engine, *_ = ds.initialize(model=_mlp(), config_params=cfg,
+                               training_data=data,
+                               dist_init_required=False)
+    snap = COUNTERS.snapshot()
+    engine.load_checkpoint(ckpt_dir)  # fresh start just warns
+    start = engine.global_steps
+    out_path = os.path.join(args.scratch,
+                            f"result_rank{args.proc_id}.jsonl")
+
+    def emit(payload):
+        with open(out_path, "a") as f:
+            f.write(json.dumps(payload) + "\n")
+            f.flush()
+
+    emit({"kind": "boot", "rank": args.proc_id, "incarnation": inc,
+          "world": world, "start_step": start})
+    while engine.global_steps < args.steps:
+        step_id = engine.global_steps
+        mark = len(data.log)
+        loss = float(engine.train_batch())
+        engine.save_checkpoint(ckpt_dir, tag=f"step{engine.global_steps}")
+        emit({"kind": "step", "rank": args.proc_id, "incarnation": inc,
+              "step": step_id, "loss": round(loss, 8),
+              "samples": data.log[mark:]})
+    ckpt_io.flush_pending()
+    delta = COUNTERS.delta_since(snap)
+    engine.finalize_monitoring()
+    emit({"kind": "done", "rank": args.proc_id, "incarnation": inc,
+          "world": world,
+          "shrinks": delta.get("elastic.shrinks", {}).get("calls", 0),
+          "regrows": delta.get("elastic.regrows", {}).get("calls", 0),
+          "replicated": delta.get("input.replicated_batches",
+                                  {}).get("calls", 0)})
+
+
+def _elastic_launcher(args):
+    """The supervised child: spawns DSTPU_SURVIVING_WORLD jax workers
+    (full width when unset), forwards SIGTERM, and — when a worker dies
+    — kills the rest and writes `elastic_report.json` naming the dead
+    rank into the monitor dir, then exits nonzero so the supervisor's
+    shrink policy takes over.  A shrunken incarnation that reaches its
+    step quota exits 75 ("capacity restored, restart me"), which the
+    policy reads as a no-dead-ranks failure -> grow back to full."""
+    inc = int(os.environ.get("DSTPU_INCARNATION", "0") or 0)
+    try:
+        world = int(os.environ.get("DSTPU_SURVIVING_WORLD", "")
+                    or args.nproc)
+    except ValueError:
+        world = args.nproc
+    until = args.steps if world >= args.nproc else ELASTIC_TCP_REGROW_AT
+    coord = f"127.0.0.1:{_free_port()}" if world > 1 else ""
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--elastic-rank",
+             "--proc-id", str(r), "--nproc", str(world),
+             "--coord", coord, "--steps", str(until),
+             "--scratch", args.scratch, "--monitor-dir", args.monitor_dir,
+             "--kill-rank", str(args.kill_rank if inc == 0 else -1)],
+            env=env)
+        for r in range(world)
+    ]
+
+    def forward(signum, _frame):
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signum)
+
+    signal.signal(signal.SIGTERM, forward)
+    dead_rank = None
+    while dead_rank is None and any(p.poll() is None for p in procs):
+        for r, p in enumerate(procs):
+            rc = p.poll()
+            if rc is not None and rc != 0:
+                dead_rank = r
+                break
+        time.sleep(0.1)
+    if dead_rank is not None:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+        os.makedirs(args.monitor_dir, exist_ok=True)
+        with open(os.path.join(args.monitor_dir, "elastic_report.json"),
+                  "w") as f:
+            json.dump({"dead_ranks": [dead_rank],
+                       "reason": f"worker rank {dead_rank} exited "
+                       f"{procs[dead_rank].returncode}"}, f)
+        return 1
+    for p in procs:
+        p.wait()
+    return 0 if until >= args.steps else 75
+
+
+def run_tcp_elastic(nproc=2, record=True, scratch=None, timeout=900):
+    """The real shrink-to-survivors lane: kill 1 of 2 ranks mid-run ->
+    supervise()'s --elastic-shrink relaunches the survivor at world 1
+    -> trains on -> exits asking for capacity -> grows back to 2 ->
+    finishes.  Assertions: exactly-once sample ledger across all three
+    incarnations (3 exact epochs, every sample 3x), same-world prefix
+    losses exact vs an uninterrupted 2-proc oracle, cross-world within
+    reduction-order tolerance, shrink+regrow counters and ledger
+    entries present, and the run report renders both transitions."""
+    import numpy as np
+
+    from collections import Counter
+
+    from deepspeed_tpu.elasticity.supervisor import supervise
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    made = scratch is None
+    scratch = scratch or tempfile.mkdtemp(prefix="chaos_elastic_tcp_")
+    saved_env = {k: os.environ.pop(k, None) for k in _elastic_env_vars()}
+    try:
+        def read_records(root):
+            recs = []
+            for r in range(nproc):
+                path = os.path.join(root, f"result_rank{r}.jsonl")
+                if not os.path.exists(path):
+                    continue
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if line:
+                            recs.append(json.loads(line))
+            return recs
+
+        def launcher_cmd(root, monitor_dir, kill_rank):
+            return [sys.executable, os.path.abspath(__file__),
+                    "--elastic-launcher", "--nproc", str(nproc),
+                    "--steps", str(ELASTIC_TCP_TOTAL),
+                    "--scratch", root, "--monitor-dir", monitor_dir,
+                    "--kill-rank", str(kill_rank)]
+
+        # oracle: uninterrupted 2-proc run (no supervisor, no faults)
+        oracle_root = os.path.join(scratch, "oracle")
+        os.makedirs(oracle_root, exist_ok=True)
+        rc = subprocess.call(launcher_cmd(
+            oracle_root, os.path.join(oracle_root, "runs", "elastic"),
+            -1), timeout=timeout)
+        assert rc == 0, f"oracle launcher exited {rc}"
+        oracle = read_records(oracle_root)
+        oracle_steps = {e["step"]: e for e in oracle
+                        if e["kind"] == "step" and e["rank"] == 0}
+        assert sorted(oracle_steps) == list(range(ELASTIC_TCP_TOTAL))
+
+        # the campaign, under the real supervisor
+        camp = os.path.join(scratch, "camp")
+        monitor_dir = os.path.join(camp, "runs", "elastic")
+        os.makedirs(camp, exist_ok=True)
+        rc = supervise(
+            launcher_cmd(camp, monitor_dir, kill_rank=1),
+            max_restarts=5, backoff=0.05, backoff_cap=0.1,
+            monitor_dir=monitor_dir, stall_timeout=0.0,
+            grace=15.0, poll_interval=0.2,
+            elastic_shrink=True, min_world=1, world=nproc)
+        assert rc == 0, f"supervised campaign exited {rc}"
+
+        recs = read_records(camp)
+        boots = [e for e in recs if e["kind"] == "boot"]
+        dones = [e for e in recs if e["kind"] == "done"]
+        steps = [e for e in recs if e["kind"] == "step"]
+        incs = sorted({e["incarnation"] for e in boots})
+        assert incs == [0, 1, 2], boots
+        worlds = {e["incarnation"]: e["world"] for e in boots}
+        assert worlds == {0: nproc, 1: nproc - 1, 2: nproc}, worlds
+
+        # per-step stream: completed steps only (the killed step 5 was
+        # never recorded by incarnation 0 and re-trains in 1) — every
+        # step exactly once per RANK of its incarnation, in order
+        by_step = {}
+        for e in steps:
+            by_step.setdefault(e["step"], []).append(e)
+        assert sorted(by_step) == list(range(ELASTIC_TCP_TOTAL)), \
+            sorted(by_step)
+        for s, entries in by_step.items():
+            owner_inc = {e["incarnation"] for e in entries}
+            assert len(owner_inc) == 1, (s, entries)  # no re-trained step
+            # every rank of the incarnation saw the identical global loss
+            assert len({e["loss"] for e in entries}) == 1, (s, entries)
+            # ... and assembled the identical global batch (the
+            # same-value-everywhere device_put contract)
+            assert len({tuple(e["samples"]) for e in entries}) == 1, \
+                (s, entries)
+
+        # loss parity vs the oracle: incarnation 0 (same world) exact,
+        # the shrunken/regrown tail within reduction-order tolerance
+        for s in range(ELASTIC_TCP_KILL_AT):
+            assert by_step[s][0]["loss"] == oracle_steps[s]["loss"], \
+                (s, by_step[s][0]["loss"], oracle_steps[s]["loss"])
+        tail = [by_step[s][0]["loss"] for s in
+                range(ELASTIC_TCP_KILL_AT, ELASTIC_TCP_TOTAL)]
+        otail = [oracle_steps[s]["loss"] for s in
+                 range(ELASTIC_TCP_KILL_AT, ELASTIC_TCP_TOTAL)]
+        assert np.allclose(tail, otail, rtol=1e-4, atol=1e-6), \
+            (tail, otail)
+
+        # THE exactly-once claim, across incarnations: each step's
+        # global batch (identical on every rank, asserted above) counted
+        # once == every sample of every epoch exactly once (3 exact
+        # epochs here)
+        ledger = Counter()
+        for entries in by_step.values():
+            ledger.update(entries[0]["samples"])
+        assert set(ledger.values()) == {ELASTIC_TCP_TOTAL * ELASTIC_BATCH
+                                        // ELASTIC_TCP_N}, (
+            "sample ledger broken across the TCP shrink/grow cycle",
+            {k: v for k, v in ledger.items()
+             if v != ELASTIC_TCP_TOTAL * ELASTIC_BATCH // ELASTIC_TCP_N})
+        assert len(ledger) == ELASTIC_TCP_N, len(ledger)
+
+        # counters: the shrink landed in incarnation 1, the regrow in 2
+        inc_done = {e["incarnation"]: e for e in dones}
+        assert inc_done[1]["shrinks"] == 1 and \
+            inc_done[1]["regrows"] == 0, inc_done[1]
+        assert inc_done[2]["regrows"] == 1 and \
+            inc_done[2]["shrinks"] == 0, inc_done[2]
+        assert all(e["replicated"] == 0 for e in dones), dones
+
+        # supervisor ledger + report: both transitions recorded
+        with open(os.path.join(monitor_dir, "restarts.jsonl")) as f:
+            ledger_rows = [json.loads(x) for x in f if x.strip()]
+        trans = [(r.get("transition"), r.get("from_world"),
+                  r.get("to_world")) for r in ledger_rows
+                 if r.get("transition")]
+        assert ("shrink", nproc, nproc - 1) in trans, trans
+        assert ("regrow", nproc - 1, nproc) in trans, trans
+        md = render_markdown(load_run(monitor_dir))
+        assert "Elastic transitions" in md and "shrink" in md and \
+            "regrow" in md, md
+
+        result = {
+            "metric": f"chaos_elastic_{nproc}proc_tcp",
+            "platform": "cpu",
+            "world": {"processes": nproc},
+            "steps": ELASTIC_TCP_TOTAL,
+            "world_path": [nproc, nproc - 1, nproc],
+            "kill": f"rank 1 os._exit(173) at step {ELASTIC_TCP_KILL_AT}",
+            "samples_exactly_once": True,
+            "same_world_prefix_parity": "exact",
+            "cross_world_parity": "reduction-order tolerance",
+            "shrinks": 1,
+            "regrows": 1,
+            "supervisor_restarts": 2,
+            "value": 2,
+            "unit": "elastic_transitions_survived",
+            "losses": [by_step[s][0]["loss"]
+                       for s in range(ELASTIC_TCP_TOTAL)],
+        }
+        if record:
+            from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+            result["artifact"] = record_bench_result(
+                result, name=result["metric"])
+        return result
+    finally:
+        for k, v in saved_env.items():
+            if v is not None:
+                os.environ[k] = v
+        if made:
+            shutil.rmtree(scratch, ignore_errors=True)
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--nproc", type=int, default=1)
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--no-record", action="store_true")
     ap.add_argument("--overlap", action="store_true")
+    ap.add_argument("--elastic", action="store_true")
     ap.add_argument("--worker", action="store_true")
     ap.add_argument("--overlap-worker", dest="overlap_worker",
+                    action="store_true")
+    ap.add_argument("--elastic-launcher", dest="elastic_launcher",
+                    action="store_true")
+    ap.add_argument("--elastic-rank", dest="elastic_rank",
                     action="store_true")
     ap.add_argument("--phase", default="chaos",
                     choices=("chaos", "resume"))
     ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
     ap.add_argument("--coord", default="")
     ap.add_argument("--scratch", default="")
+    ap.add_argument("--monitor-dir", dest="monitor_dir", default="")
+    ap.add_argument("--kill-rank", dest="kill_rank", type=int, default=-1)
     args = ap.parse_args()
     if args.worker:
         _worker(args)
@@ -875,7 +1489,22 @@ def main() -> int:
     if args.overlap_worker:
         _overlap_worker(args)
         return 0
-    if args.overlap and args.nproc > 1:
+    if args.elastic_rank:
+        _elastic_rank(args)
+        return 0
+    if args.elastic_launcher:
+        return _elastic_launcher(args)
+    if args.elastic and args.nproc > 1:
+        result = run_tcp_elastic(nproc=args.nproc,
+                                 record=not args.no_record)
+    elif args.elastic:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        result = run_dry_elastic(record=not args.no_record)
+    elif args.overlap and args.nproc > 1:
         result = run_tcp_overlap(nproc=args.nproc,
                                  steps=max(8, args.steps),
                                  record=not args.no_record)

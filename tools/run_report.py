@@ -68,6 +68,10 @@ def selftest() -> int:
             COUNTERS.add("exchange.reconnects", calls=1)
             COUNTERS.add("exchange.resends", 2048, calls=1)
             COUNTERS.add("exchange.demotions", calls=1)
+            # elastic world-size transitions consumed on restore —
+            # Resilience rows, excluded from the comm byte table
+            COUNTERS.add("elastic.shrinks", calls=1)
+            COUNTERS.add("elastic.regrows", calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -89,6 +93,25 @@ def selftest() -> int:
                 "dead_ranks": [], "backoff_s": 5.0,
                 "diagnostics": "watchdog_snapshot.rank00000.1.json",
             }) + "\n")
+            # an elastic shrink + regrow pair (supervisor
+            # --elastic-shrink) renders as the "Elastic transitions"
+            # block beside the Restarts table
+            f.write(_json.dumps({
+                "t": 1.0, "event": "restart", "attempt": 2,
+                "ran_for_s": 33.0, "exit_code": 1,
+                "reason": "rank(s) [3] went quiet first",
+                "dead_ranks": [3], "backoff_s": 5.0,
+                "from_world": 4, "to_world": 3, "transition": "shrink",
+                "incarnation": 2,
+            }) + "\n")
+            f.write(_json.dumps({
+                "t": 2.0, "event": "restart", "attempt": 3,
+                "ran_for_s": 60.0, "exit_code": 1,
+                "reason": "exit code 1",
+                "dead_ranks": [], "backoff_s": 5.0,
+                "from_world": 3, "to_world": 4, "transition": "regrow",
+                "incarnation": 3,
+            }) + "\n")
         run = load_run(os.path.join(root, "selftest"))
         bad = [err for events in run["ranks"].values()
                for e in events for err in validate_event(e)]
@@ -109,7 +132,11 @@ def selftest() -> int:
                        "exchange frames resent", "6,144 B replayed",
                        "demotions to the serial path",
                        "Restarts (supervisor ledger)", "watchdog trip on "
-                       "rank 0"):
+                       "rank 0",
+                       "Elastic transitions", "shrink | 4 → 3",
+                       "regrow | 3 → 4",
+                       "elastic shrinks (resumed at a smaller dp)",
+                       "elastic regrows (resumed at a larger dp)"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
@@ -122,6 +149,9 @@ def selftest() -> int:
         assert "`exchange.reconnects`" not in md and \
             "`exchange.resends`" not in md, \
             "exchange.* rows must not leak into the comm table"
+        assert "`elastic.shrinks`" not in md and \
+            "`elastic.regrows`" not in md, \
+            "elastic.* rows must not leak into the comm table"
     print("run_report selftest ok")
     return 0
 
