@@ -10,6 +10,20 @@ and converts it to the fused layer's 12-tensor dict (transformer.py param
 names), or back. The model then runs those params through
 transformer_layer_forward — same capability (run HF weights on the fused
 kernel path), no monkey-patching.
+
+**Coverage contract (loud, never silent).**  One policy family is
+implemented: `HFBertLayerPolicy` (HF/flax BERT encoder layers).  A
+policy walk that recognizes NOTHING is almost always a caller error —
+wrong tree layout, a model family without a policy — and returning the
+tree unchanged would let the caller run UNINJECTED weights believing
+injection happened (the reference's silent-stub trap).  So
+`replace_transformer_layer` raises `NotImplementedError` when zero
+layers matched; pass `strict=False` to get the old pass-through with a
+logged warning instead (e.g. probing a mixed checkpoint).  For decoder
+/ GPT-family models there is no injection policy: convert the weights
+with `models/hf.py` (`load_hf_gpt2` — the supported path, after
+which every engine feature and `deepspeed_tpu.serving` apply
+unchanged).
 """
 
 from __future__ import annotations
@@ -19,6 +33,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax.numpy as jnp
 
 from ..ops.transformer.transformer import DeepSpeedTransformerConfig
+from ..utils.logging import logger
 
 FUSED_KEYS = ("attn_qkvw", "attn_qkvb", "attn_ow", "attn_ob", "attn_nw",
               "attn_nb", "inter_w", "inter_b", "output_w", "output_b",
@@ -161,12 +176,32 @@ def replace_module(params: Any, policy: InjectionPolicy,
 
 def replace_transformer_layer(policy: InjectionPolicy, params: Any,
                               config: Optional[DeepSpeedTransformerConfig]
-                              = None):
+                              = None, strict: bool = True):
     """reference replace_module.py:66-145. Returns (new_params, layer_config,
     replaced_paths): params with every recognized layer subtree converted to
     fused-layer params, plus the DeepSpeedTransformerConfig to run them with
-    (family overrides applied, e.g. post-LN for HF BERT)."""
+    (family overrides applied, e.g. post-LN for HF BERT).
+
+    Zero recognized layers is a loud failure (`strict=True`, default):
+    running un-injected weights while believing injection happened is
+    the silent-stub trap this contract exists to close.  `strict=False`
+    downgrades it to a logged pass-through (the tree returns
+    unchanged).  See the module docstring: decoder/GPT checkpoints have
+    no injection policy — import them via models/hf.py instead."""
     new_params, replaced = replace_module(params, policy)
+    if not replaced:
+        msg = (f"kernel injection: {type(policy).__name__} recognized NO "
+               f"layer subtree in the given params — either the tree "
+               f"layout does not match the policy, or this model family "
+               f"has no injection policy (only HF BERT encoder layers "
+               f"are covered; for GPT-family checkpoints convert the "
+               f"weights via deepspeed_tpu.models.hf instead — the "
+               f"supported path for the engine and for "
+               f"deepspeed_tpu.serving)")
+        if strict:
+            raise NotImplementedError(msg)
+        logger.warning(msg + "; strict=False: returning the params "
+                       "UNCHANGED (no layer runs the fused kernel)")
     if config is not None:
         for k, v in policy.layer_config_overrides().items():
             setattr(config, k, v)

@@ -63,6 +63,22 @@ Instrumented sites:
   a diagnostic snapshot + supervisor escalation file);
   `input.worker_respawns` — dead prefetch workers replaced by the
   consumer (counted under input.* but rendered with Resilience).
+* the serving engine (`serve.*` / `kv.*`, deepspeed_tpu/serving/,
+  rendered by monitor/report.py as the "Serving" section and excluded
+  from the comm byte table): `serve.requests` — requests completed
+  naturally (bytes = generated tokens); `serve.tokens` — tokens
+  decoded (prefill first tokens included); `serve.decode_steps` —
+  decode dispatches (bytes = active slots, so bytes/calls is the mean
+  batch occupancy continuous batching exists to maximize);
+  `serve.prefill_chunks` — chunked-prefill dispatches (bytes = prompt
+  tokens); `serve.ttft_ms` — time-to-first-token (integer MICROSECONDS
+  in the bytes slot, the ckpt.stall_ms convention; one call per first
+  token); `serve.shed` — in-flight requests shed after a wedged decode
+  step (watchdog escalation, state 'error'); `kv.blocks_in_use` —
+  paged-KV occupancy sampled once per engine step (mean =
+  bytes/calls); `kv.evictions` — KV blocks FORCIBLY reclaimed from
+  shed/errored requests (natural completion frees blocks without
+  counting here — a healthy run keeps this at zero).
 """
 
 from __future__ import annotations

@@ -64,7 +64,26 @@ def load_run(run_dir: str) -> Dict[str, Any]:
         events = read_events(path)
         rank = int(os.path.basename(path)[len("events.rank"):-len(".jsonl")])
         ranks[rank] = events
-    if not ranks:
+    # a serving-bench run dir (tools/serve_bench.py) carries its lane
+    # table as serving.json — with it present, telemetry event streams
+    # are optional (a pure serving run has no training steps to report)
+    serving = None
+    serving_err = None
+    spath = os.path.join(run_dir, "serving.json")
+    if os.path.exists(spath):
+        try:
+            with open(spath) as f:
+                serving = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            serving_err = e
+    if not ranks and serving is None:
+        if serving_err is not None:
+            # a serving-only dir with a torn serving.json: name the
+            # REAL defect instead of claiming telemetry is missing
+            raise ValueError(
+                f"{spath}: unreadable serving.json "
+                f"({type(serving_err).__name__}: {serving_err}) and no "
+                f"events.rank*.jsonl to fall back on")
         raise FileNotFoundError(
             f"no events.rank*.jsonl under {run_dir!r}")
     restarts: List[Dict[str, Any]] = []
@@ -88,7 +107,8 @@ def load_run(run_dir: str) -> Dict[str, Any]:
         except (OSError, json.JSONDecodeError):
             watchdog_trip = None
     return {"dir": run_dir, "manifest": manifest, "ranks": ranks,
-            "restarts": restarts, "watchdog_trip": watchdog_trip}
+            "restarts": restarts, "watchdog_trip": watchdog_trip,
+            "serving": serving}
 
 
 def _mean(xs):
@@ -166,9 +186,10 @@ def render_markdown(run: Dict[str, Any]) -> str:
                      f"{man.get('device_count', '?')} device(s) · "
                      f"world {man.get('world_size', '?')}")
         lines.append("")
-    lines.append("| rank | steps | wall ms/step | samples/s | tokens/s | "
-                 "TFLOPs | loss first→last | skipped | peak mem |")
-    lines.append("|---|---|---|---|---|---|---|---|---|")
+    if run["ranks"]:
+        lines.append("| rank | steps | wall ms/step | samples/s | tokens/s "
+                     "| TFLOPs | loss first→last | skipped | peak mem |")
+        lines.append("|---|---|---|---|---|---|---|---|---|")
     summaries = {}
     for rank in sorted(run["ranks"]):
         s = summarize(run["ranks"][rank])
@@ -202,11 +223,13 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # gradient-wire section below, not the comm byte table
     _WIRE_TIME_COUNTERS = ("grad_wire.exposed_ms", "qwz.prefetch_hits")
     # elastic.* counts world-size transitions (shrinks/regrows), not
-    # wire bytes — Resilience rows, like fault.*
+    # wire bytes — Resilience rows, like fault.*; serve.*/kv.* carry
+    # serving-engine metrics (tokens, µs, block occupancy) and render
+    # as the "Serving" section below
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
-                                          "elastic."))
+                                          "elastic.", "serve.", "kv."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -271,6 +294,97 @@ def render_markdown(run: Dict[str, Any]) -> str:
             lines.append(f"| mean async writer queue depth | "
                          f"{pend['bytes'] / pend['calls']:.2f} "
                          f"(sampled at {pend['calls']:,} saves) |")
+        lines.append("")
+
+    # serving engine counters (deepspeed_tpu/serving): requests/tokens
+    # decoded, batch occupancy, KV block pressure — their own section,
+    # like input.*/ckpt.*
+    serve_counters = {k: v for k, v in any_comm.items()
+                      if k.startswith(("serve.", "kv."))}
+    if serve_counters:
+        lines.append("## Serving")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        reqs = serve_counters.get("serve.requests")
+        if reqs:
+            lines.append(f"| requests completed | {reqs['calls']:,} "
+                         f"({reqs['bytes']:,} tokens generated) |")
+        toks = serve_counters.get("serve.tokens")
+        if toks:
+            lines.append(f"| tokens decoded | {toks['calls']:,} |")
+        dec = serve_counters.get("serve.decode_steps")
+        if dec and dec["calls"]:
+            lines.append(f"| decode steps | {dec['calls']:,} (mean batch "
+                         f"occupancy {dec['bytes'] / dec['calls']:.2f} "
+                         f"slots) |")
+        pre = serve_counters.get("serve.prefill_chunks")
+        if pre:
+            lines.append(f"| prefill chunks | {pre['calls']:,} "
+                         f"({pre['bytes']:,} prompt tokens) |")
+        ttft = serve_counters.get("serve.ttft_ms")
+        if ttft and ttft["calls"]:
+            total_ms = ttft["bytes"] / 1000.0  # stored as integer µs
+            lines.append(f"| mean time-to-first-token | "
+                         f"{total_ms / ttft['calls']:.2f} ms over "
+                         f"{ttft['calls']:,} first tokens |")
+        blk = serve_counters.get("kv.blocks_in_use")
+        if blk and blk["calls"]:
+            lines.append(f"| mean KV blocks in use | "
+                         f"{blk['bytes'] / blk['calls']:.2f} "
+                         f"(sampled at {blk['calls']:,} steps) |")
+        ev = serve_counters.get("kv.evictions")
+        if ev:
+            lines.append(f"| KV blocks force-reclaimed (evictions) | "
+                         f"{ev['calls']:,} |")
+        shed = serve_counters.get("serve.shed")
+        if shed:
+            lines.append(f"| requests shed (wedged decode) | "
+                         f"{shed['calls']:,} |")
+        lines.append("")
+
+    # serving-bench lane table (serving.json from tools/serve_bench.py)
+    sv = run.get("serving")
+    if sv and sv.get("lanes"):
+        lines.append("## Serving bench (continuous batching)")
+        lines.append("")
+        m = sv.get("model") or {}
+        if m:
+            lines.append(f"model: {m.get('layers', '?')}L x "
+                         f"d{m.get('d_model', '?')} x "
+                         f"{m.get('heads', '?')}h, vocab "
+                         f"{m.get('vocab', '?')} · "
+                         f"{sv.get('n_requests', '?')} requests, Poisson "
+                         f"{sv.get('rate_hz', '?')}/s")
+            lines.append("")
+        lines.append("| lane | done | tokens | tokens/s | TTFT p50/p99 ms "
+                     "| ITL p50/p99 ms | KV blocks mean/peak | shed |")
+        lines.append("|---|---|---|---|---|---|---|---|")
+        for name in sorted(sv["lanes"]):
+            lane = sv["lanes"][name]
+            ttft_l, itl = lane.get("ttft_ms", {}), lane.get("itl_ms", {})
+            kvb = lane.get("kv_blocks", {})
+            lines.append(
+                f"| {name} | {lane.get('completed', '?')}/"
+                f"{lane.get('requests', '?')} | "
+                f"{_fmt(lane.get('tokens'), 0)} | "
+                f"{_fmt(lane.get('tokens_per_sec'))} | "
+                f"{_fmt(ttft_l.get('p50'))} / {_fmt(ttft_l.get('p99'))} | "
+                f"{_fmt(itl.get('p50'))} / {_fmt(itl.get('p99'))} | "
+                f"{_fmt(kvb.get('mean'))} / {_fmt(kvb.get('peak'), 0)} "
+                f"(cap {_fmt(kvb.get('capacity'), 0)}) | "
+                f"{lane.get('shed', 0)} |")
+        cont = sv["lanes"].get("continuous")
+        stat = sv["lanes"].get("static")
+        if cont and stat and cont.get("tokens_per_sec") and \
+                stat.get("tokens_per_sec"):
+            lines.append("")
+            lines.append(
+                f"continuous vs static batching: "
+                f"{cont['tokens_per_sec'] / stat['tokens_per_sec']:.2f}x "
+                f"tokens/s at p99 TTFT "
+                f"{_fmt(cont.get('ttft_ms', {}).get('p99'))} vs "
+                f"{_fmt(stat.get('ttft_ms', {}).get('p99'))} ms")
         lines.append("")
 
     # resilience: fault injection + transient-retry + watchdog activity

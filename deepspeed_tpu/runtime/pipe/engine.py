@@ -1573,7 +1573,29 @@ class PipelineEngine(DeepSpeedEngine):
                 if count else None)
 
     def inference_batch(self, data_iter):
-        """EleutherAI addition (reference pipe/engine.py:422)."""
+        """One-shot forward over the pipeline stages (EleutherAI
+        addition, reference pipe/engine.py:422).
+
+        This is the reference-era SINGLE-BATCH path: one fixed batch,
+        full forward, no KV cache, no admission — every token of every
+        sequence recomputes the whole prefix.  For actual serving
+        (autoregressive decode, continuous batching, paged KV,
+        latency/throughput accounting) use `deepspeed_tpu.serving`
+        (docs/tutorials/serving.md): `ServeEngine.submit()` /
+        `generate()` is the supported inference path, pinned
+        token-identical to `models/generation.generate`.  This method
+        stays for batch-scoring workloads (perplexity eval over a
+        fixed set) where recompute is acceptable and the pipeline
+        stages are already resident — the two paths must not silently
+        diverge, hence the one-time pointer logged below."""
+        from ...utils.logging import warning_once
+
+        warning_once(
+            "pipe.engine.inference_batch is the reference-era one-shot "
+            "forward (full prefix recompute, no batching across "
+            "requests); for serving use deepspeed_tpu.serving "
+            "(ServeEngine — continuous batching over a paged KV cache, "
+            "docs/tutorials/serving.md)")
         batch = next(data_iter) if hasattr(data_iter, "__next__") else data_iter
         inputs = batch[0] if isinstance(batch, (tuple, list)) else batch
         if not self._staged:

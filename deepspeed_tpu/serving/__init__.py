@@ -1,0 +1,24 @@
+"""deepspeed_tpu.serving — the continuous-batching inference engine.
+
+The headline serving scenario (ROADMAP item 1): a paged, mesh-sharded
+KV cache (`kv_cache.py`), in-flight admission with chunked prefill
+(`scheduler.py`), compiled prefill/decode programs built
+StepBuilder-style (`programs.py`), and the engine + worker loop
+(`engine.py`).  Benchmarked by `tools/serve_bench.py`; tutorial at
+docs/tutorials/serving.md.
+"""
+
+from .engine import ServeConfig, ServeEngine, ServeWorker
+from .kv_cache import TRASH_BLOCK, PagedKVCache
+from .programs import (ServeProgramBuilder, ServeSchedule,
+                       dequantize_params, quantize_params, sample_token)
+from .scheduler import (ADMISSION_POLICIES, ERROR, FINISHED, PREFILL,
+                        RUNNING, WAITING, Request, Scheduler)
+
+__all__ = [
+    "ServeConfig", "ServeEngine", "ServeWorker", "PagedKVCache",
+    "TRASH_BLOCK", "ServeProgramBuilder", "ServeSchedule", "sample_token",
+    "quantize_params", "dequantize_params", "Request", "Scheduler",
+    "ADMISSION_POLICIES", "WAITING", "PREFILL", "RUNNING", "FINISHED",
+    "ERROR",
+]

@@ -1,0 +1,449 @@
+"""The continuous-batching serving engine.
+
+One `ServeEngine` owns: a `PagedKVCache` (block pool + free list), a
+`Scheduler` (admission + slots), and the jitted {prefill, decode}
+program pair from `ServeProgramBuilder`.  `step()` is the whole serving
+loop body — admit, prefill one chunk round, decode one token for every
+running slot — and everything else (the bench's Poisson arrival thread,
+`generate()`'s synchronous loop, a `ServeWorker` daemon) just drives
+`step()`.
+
+Resilience contract (the PR-8 machinery, applied to serving):
+
+* `fault_point` sites `serve.step` / `serve.admit` / `serve.prefill` /
+  `serve.decode` make the engine chaos-testable like every other layer.
+* `attach_watchdog(wd)` registers the serving worker thread as a
+  StepWatchdog thread group and beats the watchdog at every step
+  boundary; wiring the watchdog's `on_trip` to `request_shed()` closes
+  the loop: a wedged decode step trips the deadline, the trip handler
+  flags the engine, and the moment the engine thread is live again it
+  SHEDS the in-flight batch — those requests finish in state "error"
+  with their KV blocks reclaimed (`kv.evictions`), waiting requests are
+  admitted and complete normally.  Shedding the stuck work instead of
+  hanging the fleet is the serving analogue of the supervisor's
+  SIGTERM-first restart.
+
+Counters (monitor/counters.py "Serving" section): `serve.requests`
+(completed; bytes = generated tokens), `serve.tokens`,
+`serve.decode_steps` (bytes = active slots -> mean batch occupancy),
+`serve.prefill_chunks` (bytes = prompt tokens prefetched),
+`serve.ttft_ms` (µs in the bytes slot, the ckpt.stall_ms convention),
+`serve.shed`, plus `kv.blocks_in_use` / `kv.evictions` from the cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models.gpt import GPT
+from ..monitor.counters import COUNTERS
+from ..runtime.resilience import fault_point
+from ..utils.logging import logger
+from .kv_cache import PagedKVCache, TRASH_BLOCK
+from .programs import ServeProgramBuilder, ServeSchedule
+from .scheduler import (ADMISSION_POLICIES, ERROR, FINISHED, RUNNING,
+                        Request, Scheduler)
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Serving knobs (validated at construction; see
+    docs/tutorials/serving.md for sizing guidance)."""
+
+    block_size: int = 16              # tokens per KV block
+    num_blocks: int = 64              # pool size INCLUDING the trash block
+    max_batch: int = 8                # decode slots
+    prefill_chunk: int = 32           # prompt tokens per prefill call
+    max_seq_len: Optional[int] = None  # per-request cap; default model's
+    admission: str = "continuous"     # "continuous" | "static"
+    max_prefill_chunks_per_step: int = 1
+    quantized_weights: Any = False    # False | "int8" | "int4"
+    kv_dtype: Any = None              # default: model param_dtype
+
+    def __post_init__(self):
+        for name in ("block_size", "max_batch", "prefill_chunk",
+                     "max_prefill_chunks_per_step"):
+            if int(getattr(self, name)) < 1:
+                raise ValueError(
+                    f"serving {name} must be >= 1, got "
+                    f"{getattr(self, name)}")
+        if int(self.num_blocks) < 2:
+            raise ValueError(
+                f"serving num_blocks must be >= 2 (block 0 is reserved), "
+                f"got {self.num_blocks}")
+        if self.admission not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"serving admission must be one of {ADMISSION_POLICIES}, "
+                f"got {self.admission!r}")
+        q = self.quantized_weights
+        if q not in (False, None, "int8", "int4"):
+            raise ValueError(
+                f"serving quantized_weights must be False, 'int8' or "
+                f"'int4', got {q!r}")
+
+    @property
+    def quant_mode(self) -> str:
+        return self.quantized_weights if self.quantized_weights else "none"
+
+
+class ServeEngine:
+    """Continuous-batching decode engine over a mesh-sharded paged KV
+    cache.  Single engine thread drives `step()`; `submit()` is safe
+    from any thread."""
+
+    def __init__(self, model: GPT, params, config: Optional[ServeConfig]
+                 = None, mesh_info=None, programs: Optional[dict] = None,
+                 clock=time.monotonic):
+        self.model = model
+        self.config = config or ServeConfig()
+        self.clock = clock
+        cfg = model.config
+        c = self.config
+        self.max_seq_len = int(c.max_seq_len or cfg.max_seq_len)
+        if self.max_seq_len > cfg.max_seq_len:
+            raise ValueError(
+                f"serving max_seq_len {self.max_seq_len} exceeds the "
+                f"model's positional table ({cfg.max_seq_len})")
+        table_width = -(-self.max_seq_len // c.block_size)
+        if mesh_info is None:
+            from ..comm.mesh import peek_mesh
+
+            mesh_info = peek_mesh()
+        self.mesh_info = mesh_info
+        self.kv = PagedKVCache(
+            num_layers=cfg.num_layers, num_heads=cfg.num_heads,
+            head_dim=cfg.head_dim, num_blocks=c.num_blocks,
+            block_size=c.block_size, table_width=table_width,
+            dtype=(c.kv_dtype or cfg.param_dtype), mesh_info=mesh_info)
+        self.scheduler = Scheduler(self.kv, c.max_batch,
+                                   admission=c.admission, clock=clock)
+        schedule = ServeSchedule(
+            max_batch=c.max_batch, prefill_chunk=c.prefill_chunk,
+            block_size=c.block_size, num_blocks=c.num_blocks,
+            table_width=table_width, quantized=c.quant_mode)
+        if programs is None:
+            programs = ServeProgramBuilder(model, schedule).build()
+        elif programs["schedule"].program_key() != schedule.program_key():
+            raise ValueError(
+                f"prebuilt programs were compiled for "
+                f"{programs['schedule'].describe()!r} but this engine "
+                f"needs {schedule.describe()!r}")
+        self.programs = programs
+        self.params = programs["prepare_params"](
+            self._place_params(params))
+        logger.info(f"serving engine up: {schedule.describe()}; "
+                    f"{self.kv.describe()}")
+        # packed decode-batch state (one row per slot)
+        R, W = c.max_batch, table_width
+        self._tokens = np.zeros((R,), np.int32)
+        self._positions = np.zeros((R,), np.int32)
+        self._active = np.zeros((R,), bool)
+        self._tables = np.full((R, W), TRASH_BLOCK, np.int32)
+        self._temps = np.zeros((R,), np.float32)
+        self._topks = np.zeros((R,), np.int32)
+        self._seeds = np.zeros((R,), np.uint32)
+        self.steps = 0
+        self.peak_blocks_in_use = 0
+        self._shed_reason: Optional[str] = None
+        self._watchdog = None
+        self._worker: Optional["ServeWorker"] = None
+        self._wake = threading.Event()
+
+    # -- placement ----------------------------------------------------
+
+    def _place_params(self, params):
+        """Best-effort TP placement: when a mesh with model > 1 is in
+        scope, put each leaf at its GPT param_spec so the programs run
+        Megatron-sharded; otherwise leave leaves where they are."""
+        info = self.mesh_info
+        if info is None:
+            return params
+        from ..comm.mesh import MODEL_AXIS
+
+        if info.axis_size(MODEL_AXIS) <= 1:
+            return params
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        try:
+            return jax.tree_util.tree_map(
+                lambda leaf, spec: jax.device_put(
+                    leaf, NamedSharding(info.mesh, spec)),
+                params, self.model.param_specs,
+                is_leaf=lambda x: hasattr(x, "ndim"))
+        except Exception as e:
+            logger.warning(
+                f"serving TP param placement failed ({e}); weights stay "
+                f"replicated")
+            return params
+
+    # -- submission ---------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               eos_token: Optional[int] = None) -> Request:
+        prompt = [int(t) for t in prompt]
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if int(max_new_tokens) < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if len(prompt) + int(max_new_tokens) > self.max_seq_len:
+            raise ValueError(
+                f"prompt {len(prompt)} + max_new {max_new_tokens} "
+                f"exceeds the engine's max_seq_len {self.max_seq_len}")
+        if int(top_k) < 0 or float(temperature) < 0.0:
+            raise ValueError(
+                f"top_k must be >= 0 and temperature >= 0, got "
+                f"{top_k}, {temperature}")
+        req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                      temperature=float(temperature), top_k=int(top_k),
+                      seed=int(seed), eos_token=eos_token)
+        self.scheduler.submit(req)
+        self._wake.set()
+        return req
+
+    # -- shedding (watchdog escalation target) ------------------------
+
+    def request_shed(self, reason: str = "watchdog trip") -> None:
+        """Flag the in-flight batch for shedding; safe from any thread
+        (the watchdog's on_trip handler).  Consumed at the next point
+        the engine thread is live — the requests wedged in the stuck
+        step finish in state 'error', everything waiting proceeds."""
+        self._shed_reason = str(reason)
+
+    def _check_shed(self) -> bool:
+        reason = self._shed_reason
+        if reason is None:
+            return False
+        self._shed_reason = None
+        victims = self.scheduler.occupied()
+        for req in victims:
+            slot = req.slot
+            self.scheduler.finish(req, ERROR, error=reason)
+            if slot is not None:
+                self._active[slot] = False
+                self._tables[slot] = TRASH_BLOCK
+        if victims:
+            COUNTERS.add("serve.shed", calls=len(victims))
+            logger.error(
+                f"serving: SHED {len(victims)} in-flight request(s) "
+                f"({reason}); {self.kv.blocks_in_use} blocks still held, "
+                f"{self.scheduler.n_waiting} waiting proceed")
+        return bool(victims)
+
+    # -- the serving loop body ----------------------------------------
+
+    def step(self) -> bool:
+        """One engine iteration: admit -> prefill chunk round -> decode.
+        Returns True when any work was done (callers idle otherwise)."""
+        fault_point("serve.step")
+        self._check_shed()
+        if self._watchdog is not None:
+            self._watchdog.beat(self.steps)
+        fault_point("serve.admit")
+        self.scheduler.admit()
+        did = False
+        for req in self.scheduler.prefilling()[
+                :self.config.max_prefill_chunks_per_step]:
+            fault_point("serve.prefill")
+            if self._check_shed():
+                return True
+            self._prefill_chunk(req)
+            did = True
+        running = self.scheduler.running()
+        if running:
+            fault_point("serve.decode")
+            if self._check_shed():
+                return True
+            self._decode_step(running)
+            did = True
+        if did:
+            self.steps += 1
+            self.kv.sample_occupancy()
+            self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                          self.kv.blocks_in_use)
+        return did
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work() or self._shed_reason is not None
+
+    def run(self) -> None:
+        """Drive step() until every submitted request is terminal."""
+        while self.scheduler.has_work():
+            self.step()
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int, temperature: float = 0.0,
+                 top_k: int = 0, seeds: Optional[Sequence[int]] = None,
+                 eos_token: Optional[int] = None) -> List[List[int]]:
+        """Synchronous convenience: submit all, run to completion,
+        return the token lists (raises if any request errored)."""
+        reqs = [self.submit(p, max_new_tokens, temperature=temperature,
+                            top_k=top_k,
+                            seed=(seeds[i] if seeds is not None else 0),
+                            eos_token=eos_token)
+                for i, p in enumerate(prompts)]
+        self.run()
+        for r in reqs:
+            if r.state == ERROR:
+                raise RuntimeError(f"request {r.rid} failed: {r.error}")
+        return [r.out for r in reqs]
+
+    # -- phases --------------------------------------------------------
+
+    def _prefill_chunk(self, req: Request) -> None:
+        C = self.config.prefill_chunk
+        chunk = req.prompt[req.prefill_pos:req.prefill_pos + C]
+        n_valid = len(chunk)
+        tokens = np.zeros((1, C), np.int32)
+        tokens[0, :n_valid] = chunk
+        tok, _logits, caches = self.programs["prefill"](
+            self.params, self.kv.caches, jnp.asarray(tokens),
+            np.int32(req.prefill_pos), np.int32(n_valid),
+            jnp.asarray(req.table), np.float32(req.temperature),
+            np.int32(req.top_k), np.uint32(req.seed))
+        self.kv.caches = caches
+        req.prefill_pos += n_valid
+        req.cached_len = req.prefill_pos
+        COUNTERS.add("serve.prefill_chunks", nbytes=n_valid)
+        if req.prefill_pos < len(req.prompt):
+            return
+        # final chunk: the program sampled the request's FIRST token
+        first = int(tok)
+        now = self.clock()
+        req.t_first_token = now
+        req.token_times.append(now)
+        req.out.append(first)
+        COUNTERS.add("serve.tokens")
+        COUNTERS.add("serve.ttft_ms", nbytes=int(req.ttft_s * 1e6))
+        if self._is_finished(req, first):
+            self._finish(req)
+            return
+        req.state = RUNNING
+        slot = req.slot
+        self._tokens[slot] = first
+        # the first decode step writes this token's K/V at position P
+        self._positions[slot] = len(req.prompt)
+        self._active[slot] = True
+        self._tables[slot] = req.table
+        self._temps[slot] = req.temperature
+        self._topks[slot] = req.top_k
+        self._seeds[slot] = np.uint32(req.seed)
+
+    def _decode_step(self, running: List[Request]) -> None:
+        toks, caches = self.programs["decode"](
+            self.params, self.kv.caches, jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(self._active),
+            jnp.asarray(self._tables), jnp.asarray(self._temps),
+            jnp.asarray(self._topks), jnp.asarray(self._seeds))
+        self.kv.caches = caches
+        toks = np.asarray(toks)
+        now = self.clock()
+        COUNTERS.add("serve.decode_steps", nbytes=len(running))
+        for req in running:
+            slot = req.slot
+            tok = int(toks[slot])
+            req.out.append(tok)
+            req.token_times.append(now)
+            req.cached_len += 1
+            COUNTERS.add("serve.tokens")
+            if self._is_finished(req, tok):
+                self._finish(req)
+                self._active[slot] = False
+                self._tables[slot] = TRASH_BLOCK
+            else:
+                self._tokens[slot] = tok
+                self._positions[slot] += 1
+
+    def _is_finished(self, req: Request, last_tok: int) -> bool:
+        if req.eos_token is not None and last_tok == req.eos_token:
+            return True
+        return len(req.out) >= req.max_new_tokens
+
+    def _finish(self, req: Request) -> None:
+        COUNTERS.add("serve.requests", nbytes=len(req.out))
+        self.scheduler.finish(req, FINISHED)
+
+    # -- watchdog / worker integration ---------------------------------
+
+    def attach_watchdog(self, watchdog) -> None:
+        """Register with a runtime.resilience.StepWatchdog: the engine
+        beats it at every step boundary and its serving worker thread
+        (when one is attached) reports as the 'serving' thread group in
+        trip snapshots.  Wire the watchdog's `on_trip` to
+        `request_shed` to get shed-instead-of-hang behavior.
+
+        Idle semantics: a ServeWorker beats the watchdog from its idle
+        loop too (no traffic != wedged).  When driving step() yourself
+        without a worker, either keep calling step()/beating during
+        quiet periods or only arm the watchdog while work is in
+        flight."""
+        self._watchdog = watchdog
+        watchdog.register_threads(
+            "serving",
+            lambda: [t for t in (self._worker,)
+                     if t is not None and t.is_alive()])
+
+    def close(self) -> None:
+        if self._worker is not None:
+            self._worker.stop()
+            self._worker = None
+        if self._watchdog is not None:
+            self._watchdog.unregister_threads("serving")
+            self._watchdog = None
+
+
+class ServeWorker(threading.Thread):
+    """Daemon thread driving engine.step() while work is pending —
+    what the bench (and a real frontend) runs so submission and
+    decoding overlap.  Exceptions terminate every in-flight and
+    waiting request loudly (state 'error'), never silently."""
+
+    def __init__(self, engine: ServeEngine, idle_wait_s: float = 0.002):
+        super().__init__(name="dstpu-serve-worker", daemon=True)
+        self.engine = engine
+        self.idle_wait_s = float(idle_wait_s)
+        self._halt = threading.Event()
+        self.error: Optional[BaseException] = None
+        engine._worker = self
+
+    def run(self) -> None:
+        eng = self.engine
+        try:
+            while not self._halt.is_set():
+                if eng.has_work():
+                    eng.step()
+                else:
+                    # idle is not wedged: keep beating the watchdog so
+                    # a quiet traffic period never trips it.  A truly
+                    # wedged step blocks THIS thread inside step(), so
+                    # the idle beat can never mask a real hang.
+                    if eng._watchdog is not None:
+                        eng._watchdog.beat(eng.steps)
+                    eng._wake.wait(self.idle_wait_s)
+                    eng._wake.clear()
+        except BaseException as e:  # noqa: BLE001 — reported, not hidden
+            self.error = e
+            logger.error(f"serving worker died: {type(e).__name__}: {e}")
+            eng.request_shed(f"serving worker died: {e}")
+            for req in eng.scheduler.requests:
+                if not req.done:
+                    eng.scheduler.finish(req, ERROR,
+                                         error=f"worker died: {e}")
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._halt.set()
+        self.engine._wake.set()
+        self.join(timeout=timeout)
+        if self.error is not None:
+            raise RuntimeError(
+                f"serving worker failed: {self.error}") from self.error

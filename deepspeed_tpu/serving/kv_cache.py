@@ -1,0 +1,188 @@
+"""Paged KV cache: fixed-size blocks, a free-list allocator, per-request
+block tables.
+
+The serving problem the static cache in models/generation.py cannot
+solve: a decode batch whose membership changes every step.  A contiguous
+[B, L, H, Dh] cache ties a request's KV memory to its batch slot and its
+maximum length — admitting a request mid-flight or finishing one early
+strands memory.  Paging (vLLM's PagedAttention recipe, PAPERS.md) breaks
+the cache into fixed-size blocks owned by a host-side free list; a
+request holds exactly the blocks its current length needs, a finished
+request returns them the same step, and the decode program addresses KV
+through a per-request block table — so fragmentation is bounded at one
+partially-filled block per request and admission is a free-list check,
+not a compaction.
+
+Device layout: per layer, K and V each live in ONE flat array
+`[num_blocks * block_size, block_size-major]` -> shaped
+`[num_blocks * block_size, H, Dh]`.  The flat first dimension makes both
+program-side accesses a single primitive: the decode write is a batched
+row scatter at `table[pos // bs] * bs + pos % bs`, the attention read a
+row gather of the table's blocks.  On a mesh the head dimension is
+sharded over the `model` axis (the same Megatron TP layout as the
+weights), so each TP rank holds its heads' share of every block and the
+gather/scatter stay local to the row dimension.
+
+Block 0 is the reserved TRASH block: the allocator never hands it out,
+block tables are padded with it, and inactive decode slots write to it —
+so the jitted programs need no branches for "this slot/table entry is
+not real"; bogus traffic lands in (and is read from) a block whose
+contents are never attended unmasked.
+
+Counters (monitor/counters.py): `kv.blocks_in_use` is sampled by the
+engine each step (bytes += in-use blocks, mean = bytes/calls, the
+input.queue_depth convention); `kv.evictions` counts blocks reclaimed
+from requests that did NOT finish naturally (shed / errored), i.e.
+forced frees — a healthy run keeps it at zero.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..monitor.counters import COUNTERS
+
+TRASH_BLOCK = 0
+
+
+class PagedKVCache:
+    """Device block pool + host allocator for one serving engine.
+
+    `caches` is the functional state the jitted programs thread: a list
+    of (k, v) per layer, each `[num_blocks * block_size, H, Dh]`.  The
+    engine passes it into a program and stores the returned (donated)
+    arrays back; this object owns the allocator book-keeping only.
+    """
+
+    def __init__(self, num_layers: int, num_heads: int, head_dim: int,
+                 num_blocks: int, block_size: int, table_width: int,
+                 dtype=jnp.float32, mesh_info=None):
+        if num_blocks < 2:
+            raise ValueError(
+                f"num_blocks must be >= 2 (block 0 is the reserved trash "
+                f"block), got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        if table_width < 1:
+            raise ValueError(f"table_width must be >= 1, got {table_width}")
+        self.num_layers = int(num_layers)
+        self.num_heads = int(num_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.table_width = int(table_width)
+        self.dtype = dtype
+        self._sharding = self._kv_sharding(mesh_info)
+        self.caches = self._init_caches()
+        # block 0 reserved as trash; LIFO free list so the fragmentation
+        # tests exercise immediate reuse of just-freed blocks
+        self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
+        self._owned: Dict[int, List[int]] = {}
+        self.evictions = 0
+
+    # -- device state -------------------------------------------------
+
+    def _kv_sharding(self, mesh_info):
+        """Heads sharded over the TP `model` axis when a mesh is in
+        scope and divides them; None otherwise (plain local arrays)."""
+        if mesh_info is None:
+            return None
+        from ..comm.mesh import MODEL_AXIS
+
+        tp = mesh_info.axis_size(MODEL_AXIS)
+        if tp <= 1:
+            return None
+        if self.num_heads % tp:
+            from ..utils.logging import logger
+
+            logger.warning(
+                f"serving KV cache: model axis {tp} does not divide "
+                f"num_heads {self.num_heads}; cache stays unsharded")
+            return None
+        return mesh_info.sharding(None, MODEL_AXIS, None)
+
+    def _init_caches(self):
+        shape = (self.num_blocks * self.block_size, self.num_heads,
+                 self.head_dim)
+        z = lambda: jnp.zeros(shape, self.dtype)
+        if self._sharding is not None:
+            z_s = lambda: jax.device_put(jnp.zeros(shape, self.dtype),
+                                         self._sharding)
+            return [(z_s(), z_s()) for _ in range(self.num_layers)]
+        return [(z(), z()) for _ in range(self.num_layers)]
+
+    def nbytes(self) -> int:
+        return sum(int(k.size) * k.dtype.itemsize + int(v.size) *
+                   v.dtype.itemsize for k, v in self.caches)
+
+    # -- allocator ----------------------------------------------------
+
+    @property
+    def capacity_blocks(self) -> int:
+        """Allocatable blocks (the trash block is not capacity)."""
+        return self.num_blocks - 1
+
+    @property
+    def blocks_in_use(self) -> int:
+        return self.capacity_blocks - len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_needed(self, n_tokens: int) -> int:
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, rid: int, n_blocks: int) -> Optional[np.ndarray]:
+        """Allocate `n_blocks` for request `rid`; returns the padded
+        block table `[table_width] int32` (unused entries point at the
+        trash block) or None when the free list cannot cover it."""
+        n_blocks = int(n_blocks)
+        if rid in self._owned:
+            raise ValueError(f"request {rid} already holds blocks")
+        if n_blocks > self.table_width:
+            raise ValueError(
+                f"request {rid} needs {n_blocks} blocks > table width "
+                f"{self.table_width} (engine capacity "
+                f"{self.table_width * self.block_size} tokens)")
+        if n_blocks > len(self._free):
+            return None
+        blocks = [self._free.pop() for _ in range(n_blocks)]
+        self._owned[rid] = blocks
+        table = np.full((self.table_width,), TRASH_BLOCK, np.int32)
+        table[:n_blocks] = blocks
+        return table
+
+    def blocks_of(self, rid: int) -> List[int]:
+        return list(self._owned.get(rid, ()))
+
+    def free(self, rid: int, evicted: bool = False) -> int:
+        """Return `rid`'s blocks to the free list.  `evicted=True`
+        marks a FORCED reclaim (shed/errored request) and bumps
+        `kv.evictions`; natural completion does not."""
+        blocks = self._owned.pop(rid, None)
+        if not blocks:
+            return 0
+        self._free.extend(reversed(blocks))
+        if evicted:
+            self.evictions += len(blocks)
+            COUNTERS.add("kv.evictions", calls=len(blocks))
+        return len(blocks)
+
+    def sample_occupancy(self) -> None:
+        """Per-step occupancy sample (mean = bytes/calls in the
+        report, the input.queue_depth convention)."""
+        COUNTERS.add("kv.blocks_in_use", nbytes=self.blocks_in_use)
+
+    def describe(self) -> str:
+        return (f"PagedKVCache(layers={self.num_layers}, "
+                f"blocks={self.num_blocks} x {self.block_size} tok, "
+                f"table_width={self.table_width}, heads={self.num_heads}, "
+                f"head_dim={self.head_dim}, "
+                f"sharded={self._sharding is not None}, "
+                f"{self.nbytes() / (1 << 20):.2f} MiB)")

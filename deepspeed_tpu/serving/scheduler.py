@@ -1,0 +1,190 @@
+"""Continuous-batching scheduler: in-flight admission over the paged
+KV cache.
+
+The scheduling loop the engine drives once per `step()`:
+
+1. **admit** — move waiting requests into free decode slots whenever
+   the free list can cover their whole KV budget
+   (ceil((prompt + max_new) / block_size) blocks).  Admission policy:
+
+   * `"continuous"` (the subsystem's reason to exist): a request joins
+     the RUNNING batch at ANY decode step, and a finished request frees
+     its slot + blocks the same step — the decode batch stays full
+     under load instead of draining to the longest request.
+   * `"static"` (the baseline serve_bench beats): a new batch is
+     admitted only when every slot is empty — classic static batching,
+     head-of-line blocked on the longest request of the previous batch.
+
+2. **prefill** — admitted requests stream their prompt through the
+   chunked prefill program, at most `max_prefill_chunks_per_step`
+   chunks per engine step, so a long prompt never stalls the decode
+   batch for more than one chunk's worth of compute.
+
+3. **decode** — every RUNNING slot advances one token.
+
+Requests own their block table for their whole life; finishing
+(naturally or shed) frees the blocks immediately.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from .kv_cache import PagedKVCache
+
+WAITING = "waiting"
+PREFILL = "prefill"
+RUNNING = "running"
+FINISHED = "finished"
+ERROR = "error"
+
+ADMISSION_POLICIES = ("continuous", "static")
+
+
+@dataclass
+class Request:
+    """One generation request and its whole lifecycle."""
+
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: int = 0
+    eos_token: Optional[int] = None
+    rid: int = -1
+    state: str = WAITING
+    out: List[int] = field(default_factory=list)
+    error: Optional[str] = None
+    # engine book-keeping
+    slot: Optional[int] = None
+    table = None                      # np.int32 [table_width]
+    prefill_pos: int = 0              # tokens already prefilled
+    cached_len: int = 0               # cache positions written (real)
+    # timestamps (engine clock)
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+    t_finish: Optional[float] = None
+    token_times: List[float] = field(default_factory=list)
+
+    @property
+    def done(self) -> bool:
+        return self.state in (FINISHED, ERROR)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+
+class Scheduler:
+    """Slot + admission book-keeping for one ServeEngine.  Thread-safe
+    submission (the bench submits from an arrival thread while a worker
+    thread drives steps); everything else runs on the engine thread."""
+
+    def __init__(self, kv: PagedKVCache, max_batch: int,
+                 admission: str = "continuous",
+                 clock=time.monotonic):
+        if admission not in ADMISSION_POLICIES:
+            raise ValueError(
+                f"admission must be one of {ADMISSION_POLICIES}, got "
+                f"{admission!r}")
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.kv = kv
+        self.max_batch = int(max_batch)
+        self.admission = admission
+        self.clock = clock
+        self.slots: List[Optional[Request]] = [None] * self.max_batch
+        self._waiting: List[Request] = []
+        self._lock = threading.Lock()
+        self._rid = itertools.count()
+        self.requests: List[Request] = []
+
+    # -- submission (any thread) --------------------------------------
+
+    def submit(self, req: Request) -> Request:
+        req.rid = next(self._rid)
+        req.t_submit = self.clock()
+        needed = self.kv.blocks_needed(len(req.prompt) + req.max_new_tokens)
+        if needed > self.kv.table_width:
+            raise ValueError(
+                f"request needs {needed} KV blocks > table width "
+                f"{self.kv.table_width}: prompt {len(req.prompt)} + "
+                f"max_new {req.max_new_tokens} exceeds the engine's "
+                f"{self.kv.table_width * self.kv.block_size}-token "
+                f"per-request capacity")
+        if needed > self.kv.capacity_blocks:
+            raise ValueError(
+                f"request needs {needed} KV blocks but the cache only "
+                f"has {self.kv.capacity_blocks}")
+        with self._lock:
+            self._waiting.append(req)
+            self.requests.append(req)
+        return req
+
+    # -- engine-thread scheduling -------------------------------------
+
+    def admit(self) -> List[Request]:
+        """Admission pass; returns the newly admitted requests."""
+        if self.admission == "static" and any(
+                s is not None for s in self.slots):
+            return []
+        admitted = []
+        with self._lock:
+            while self._waiting:
+                free_slots = [i for i, s in enumerate(self.slots)
+                              if s is None]
+                if not free_slots:
+                    break
+                req = self._waiting[0]
+                needed = self.kv.blocks_needed(
+                    len(req.prompt) + req.max_new_tokens)
+                table = self.kv.alloc(req.rid, needed)
+                if table is None:
+                    break  # FIFO: never starve the head of the queue
+                self._waiting.pop(0)
+                req.table = table
+                req.slot = free_slots[0]
+                req.state = PREFILL
+                self.slots[req.slot] = req
+                admitted.append(req)
+        return admitted
+
+    def prefilling(self) -> List[Request]:
+        return [r for r in self.slots if r is not None and
+                r.state == PREFILL]
+
+    def running(self) -> List[Request]:
+        return [r for r in self.slots if r is not None and
+                r.state == RUNNING]
+
+    def occupied(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def finish(self, req: Request, state: str = FINISHED,
+               error: Optional[str] = None) -> None:
+        """Terminal transition: free the slot and the KV blocks NOW —
+        immediate reclaim is what lets the next waiting request join
+        at the very next step."""
+        req.state = state
+        req.error = error
+        req.t_finish = self.clock()
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        self.kv.free(req.rid, evicted=(state == ERROR))
+
+    def has_work(self) -> bool:
+        with self._lock:
+            waiting = bool(self._waiting)
+        return waiting or any(s is not None for s in self.slots)
+
+    @property
+    def n_waiting(self) -> int:
+        with self._lock:
+            return len(self._waiting)

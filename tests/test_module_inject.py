@@ -121,3 +121,45 @@ def test_torch_layout_transposed():
     for k in fused_flax:
         np.testing.assert_allclose(np.asarray(fused_flax[k]),
                                    np.asarray(fused_torch[k]), atol=1e-6)
+
+
+def test_zero_matches_raises_loudly():
+    """The coverage contract: a policy walk recognizing NOTHING must
+    never silently return the tree unchanged (the caller would run
+    un-injected weights believing injection happened)."""
+    gpt_like = {"wte": jnp.ones((8, 4)), "wpe": jnp.ones((8, 4)),
+                "blocks": [{"ln1": {"scale": jnp.ones(4)},
+                            "mlp": {"fc1": {"kernel": jnp.ones((4, 8))}}}]}
+    with pytest.raises(NotImplementedError) as ei:
+        replace_transformer_layer(HFBertLayerPolicy(), gpt_like, _cfg())
+    # the error routes the caller to the supported paths
+    assert "models.hf" in str(ei.value)
+    assert "serving" in str(ei.value)
+
+
+def test_zero_matches_non_strict_logged_passthrough(caplog):
+    import logging
+
+    gpt_like = {"wte": jnp.ones((8, 4))}
+    logger = logging.getLogger("deepspeed_tpu")
+    records = []
+    h = logging.Handler()
+    h.emit = records.append
+    logger.addHandler(h)
+    try:
+        new, cfg, replaced = replace_transformer_layer(
+            HFBertLayerPolicy(), gpt_like, strict=False)
+    finally:
+        logger.removeHandler(h)
+    assert replaced == []
+    np.testing.assert_array_equal(np.asarray(new["wte"]),
+                                  np.asarray(gpt_like["wte"]))
+    assert any("recognized NO layer" in r.getMessage() for r in records)
+
+
+def test_matching_layer_is_unaffected_by_strict():
+    t = {"encoder": _hf_flax_layer(jax.random.PRNGKey(4))}
+    new, _cfg_out, replaced = replace_transformer_layer(
+        HFBertLayerPolicy(), t, _cfg())
+    assert replaced == [("encoder",)]
+    assert "attn_qkvw" in new["encoder"]

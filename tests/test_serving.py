@@ -1,0 +1,517 @@
+"""Serving engine (deepspeed_tpu/serving): batching invariance, paged-KV
+fragmentation, chaos shed, and the serve-bench tier-1 lanes.
+
+THE acceptance pin: continuous-batched decode is token-identical to the
+one-request-at-a-time oracle — greedy AND seeded-sampling — across
+batch join/leave and KV block reuse.  Every program operation is
+row-wise by construction (programs.py), so the identity is exact, not
+tolerance-based."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.models import GPT, gpt2_config
+from deepspeed_tpu.models.generation import generate
+from deepspeed_tpu.monitor.counters import COUNTERS
+from deepspeed_tpu.serving import (ERROR, FINISHED, TRASH_BLOCK, ServeConfig,
+                                   ServeEngine, ServeProgramBuilder,
+                                   ServeSchedule, WAITING)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+VOCAB = 64
+MAX_SEQ = 64
+BS = 4            # KV block size
+WIDTH = MAX_SEQ // BS
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPT(gpt2_config("nano", num_layers=2, num_heads=4, d_model=32,
+                            vocab_size=VOCAB, max_seq_len=MAX_SEQ))
+    return model, model.init(jax.random.PRNGKey(1))
+
+
+@pytest.fixture(scope="module")
+def programs(model_and_params):
+    """ONE compiled (prefill, decode) pair shared by every engine in
+    this module — engines differ only in allocator/scheduler state."""
+    model, _ = model_and_params
+    sched = ServeSchedule(max_batch=4, prefill_chunk=8, block_size=BS,
+                          num_blocks=40, table_width=WIDTH)
+    return ServeProgramBuilder(model, sched).build()
+
+
+def _cfg(**over):
+    base = dict(block_size=BS, num_blocks=40, max_batch=4,
+                prefill_chunk=8, max_seq_len=MAX_SEQ)
+    base.update(over)
+    return ServeConfig(**base)
+
+
+def _engine(model_and_params, programs=None, **over):
+    model, params = model_and_params
+    return ServeEngine(model, params, _cfg(**over), programs=programs)
+
+
+def _prompts(seed=0, lens=(5, 9, 3, 12)):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(0, VOCAB, (n,)).tolist() for n in lens]
+
+
+def _alone(model_and_params, programs, prompt, n, **kw):
+    """The one-request-at-a-time oracle: a fresh engine, one request."""
+    eng = _engine(model_and_params, programs)
+    return eng.generate([prompt], n, **kw)[0]
+
+
+# -- the acceptance pins ----------------------------------------------------
+
+
+def test_greedy_matches_generate_exactly(model_and_params, programs):
+    """Serving greedy == models/generation.generate token for token
+    (same cache length, whole prompt in one chunk: the programs mirror
+    _block_with_cache op for op)."""
+    model, params = model_and_params
+    prompt = _prompts()[0]
+    got = _alone(model_and_params, programs, prompt, 10)
+    want = np.asarray(generate(model, params,
+                               np.asarray([prompt], np.int32), 10,
+                               cache_len=WIDTH * BS))[0].tolist()
+    assert got == want
+
+
+def test_greedy_alone_static_and_midflight_are_token_identical(
+        model_and_params, programs):
+    prompts = _prompts()
+    oracle = [_alone(model_and_params, programs, p, 8) for p in prompts]
+
+    # static batch: all submitted before any step
+    eng = _engine(model_and_params, programs)
+    batch = eng.generate(prompts, 8)
+    assert batch == oracle
+
+    # continuous: requests join mid-flight at staggered decode steps
+    eng = _engine(model_and_params, programs)
+    r0 = eng.submit(prompts[0], 8)
+    for _ in range(3):
+        eng.step()
+    r1 = eng.submit(prompts[1], 8)
+    eng.step()
+    r2 = eng.submit(prompts[2], 8)
+    for _ in range(2):
+        eng.step()
+    r3 = eng.submit(prompts[3], 8)
+    eng.run()
+    assert [r.out for r in (r0, r1, r2, r3)] == oracle
+    assert all(r.state == FINISHED for r in (r0, r1, r2, r3))
+
+
+def test_sampled_identical_under_seed_across_join_leave(
+        model_and_params, programs):
+    """Temperature/top-k sampling: the RNG key is a pure function of
+    (request seed, position) — batch composition can never reach it."""
+    prompts = _prompts(seed=3)
+    kw = dict(temperature=0.8, top_k=5)
+    oracle = []
+    for i, p in enumerate(prompts):
+        eng = _engine(model_and_params, programs)
+        r = eng.submit(p, 8, seed=100 + i, **kw)
+        eng.run()
+        oracle.append(r.out)
+    # tokens must actually vary (a collapsed distribution would make
+    # the invariance pin vacuous)
+    assert any(len(set(o)) > 1 for o in oracle)
+
+    eng = _engine(model_and_params, programs)
+    r0 = eng.submit(prompts[0], 8, seed=100, **kw)
+    for _ in range(2):
+        eng.step()
+    r1 = eng.submit(prompts[1], 8, seed=101, **kw)
+    r2 = eng.submit(prompts[2], 8, seed=102, **kw)
+    for _ in range(3):
+        eng.step()
+    r3 = eng.submit(prompts[3], 8, seed=103, **kw)
+    eng.run()
+    assert [r.out for r in (r0, r1, r2, r3)] == oracle
+
+
+def test_mixed_greedy_and_sampled_requests_in_one_batch(
+        model_and_params, programs):
+    prompts = _prompts(seed=5)
+    greedy_oracle = _alone(model_and_params, programs, prompts[0], 6)
+    sampled_oracle = _alone(model_and_params, programs, prompts[1], 6,
+                            temperature=1.0, top_k=0, seeds=[7])
+    eng = _engine(model_and_params, programs)
+    rg = eng.submit(prompts[0], 6)
+    rs_ = eng.submit(prompts[1], 6, temperature=1.0, seed=7)
+    eng.run()
+    assert rg.out == greedy_oracle
+    assert rs_.out == sampled_oracle
+
+
+# -- paged-KV allocator / fragmentation -------------------------------------
+
+
+def test_block_free_realloc_decode_fragmentation(model_and_params,
+                                                 programs):
+    """The fragmentation pin: blocks freed by a finished request are
+    REUSED by later requests (LIFO free list), and decode through the
+    recycled (stale-content) blocks is still token-identical."""
+    prompts = _prompts(seed=8)
+    eng = _engine(model_and_params, programs, num_blocks=9)  # 8 usable
+    r0 = eng.submit(prompts[0], 6)
+    eng.step()
+    blocks0 = set(eng.kv.blocks_of(r0.rid))
+    assert blocks0 and TRASH_BLOCK not in blocks0
+    eng.run()
+    assert eng.kv.blocks_in_use == 0
+    oracle0 = list(r0.out)
+
+    # two new requests re-occupy the just-freed physical blocks
+    r1 = eng.submit(prompts[1], 6)
+    r2 = eng.submit(prompts[0], 6)
+    eng.step()  # admission happens in the first step
+    used = set(eng.kv.blocks_of(r1.rid)) | set(eng.kv.blocks_of(r2.rid))
+    assert used & blocks0, "free list must recycle r0's blocks"
+    eng.run()
+    assert TRASH_BLOCK not in used
+    # same outputs through recycled (stale-content) blocks as fresh ones
+    assert r2.out == oracle0
+    assert r1.out == _alone(model_and_params, programs, prompts[1], 6)
+    assert eng.kv.blocks_in_use == 0 and eng.kv.evictions == 0
+
+
+def test_kv_exhaustion_queues_instead_of_erroring(model_and_params,
+                                                  programs):
+    """More demand than blocks: later requests WAIT for frees (FIFO),
+    everything completes, occupancy never exceeds capacity."""
+    prompts = _prompts(seed=11, lens=(6, 6, 6, 6))
+    eng = _engine(model_and_params, programs, num_blocks=7)  # 6 usable
+    # each request: ceil((6 + 6) / 4) = 3 blocks -> two fit at once
+    reqs = [eng.submit(p, 6) for p in prompts]
+    eng.step()
+    states = [r.state for r in reqs]
+    assert states.count(WAITING) == 2, states
+    eng.run()
+    assert all(r.state == FINISHED for r in reqs)
+    assert eng.peak_blocks_in_use <= eng.kv.capacity_blocks
+    assert eng.kv.blocks_in_use == 0
+    oracle = [_alone(model_and_params, programs, p, 6) for p in prompts]
+    assert [r.out for r in reqs] == oracle
+
+
+def test_eos_finishes_early_and_frees_blocks(model_and_params, programs):
+    # sampled run: varied tokens, so the eos pick is discriminative
+    # (greedy on a random-init model collapses to one token)
+    prompt = _prompts(seed=13)[1]
+    kw = dict(temperature=0.9, top_k=6, seeds=[42])
+    full = _alone(model_and_params, programs, prompt, 8, **kw)
+    stop_at = next(i for i in range(1, 8) if full[i] not in full[:i])
+    eos = full[stop_at]
+    eng = _engine(model_and_params, programs)
+    r = eng.submit(prompt, 8, temperature=0.9, top_k=6, seed=42,
+                   eos_token=eos)
+    eng.run()
+    assert r.out == full[:stop_at + 1]   # eos included, then stop
+    assert len(r.out) < 8
+    assert r.state == FINISHED
+    assert eng.kv.blocks_in_use == 0
+
+
+def test_prefill_final_chunk_past_wpe_table_stays_exact():
+    """Regression: when the final (padded) prefill chunk runs past the
+    wpe table (max_seq_len not a chunk multiple), the VALID rows must
+    keep their exact positional embeddings — a dynamic_slice would
+    clamp its start backwards and silently shift them."""
+    model = GPT(gpt2_config("nano", num_layers=2, num_heads=4, d_model=32,
+                            vocab_size=VOCAB, max_seq_len=30))
+    params = model.init(jax.random.PRNGKey(2))
+    # chunk 8: prompt 27 -> final chunk at pos 24 wants wpe[24:32] but
+    # the table has 30 rows
+    eng = ServeEngine(model, params, ServeConfig(
+        block_size=BS, num_blocks=40, max_batch=2, prefill_chunk=8,
+        max_seq_len=30))
+    prompt = _prompts(seed=41, lens=(27,))[0]
+    got = eng.generate([prompt], 3)[0]
+    want = np.asarray(generate(
+        model, params, np.asarray([prompt], np.int32), 3,
+        cache_len=eng.kv.table_width * BS))[0].tolist()
+    assert got == want
+
+
+def test_idle_engine_does_not_trip_watchdog(model_and_params, programs):
+    """Regression: a ServeWorker with no traffic beats the watchdog
+    from its idle loop — quiet periods are not hangs and must not
+    shed/escalate."""
+    import tempfile
+    import time
+
+    from deepspeed_tpu.runtime.resilience import StepWatchdog
+    from deepspeed_tpu.serving import ServeWorker
+
+    eng = _engine(model_and_params, programs)
+    with tempfile.TemporaryDirectory() as d:
+        wd = StepWatchdog(deadline_s=0.2, snapshot_dir=d, poll_s=0.05,
+                          on_trip=lambda t: eng.request_shed(t["reason"]))
+        eng.attach_watchdog(wd)
+        w = ServeWorker(eng)
+        w.start()
+        try:
+            r = eng.submit(_prompts()[0], 4)
+            t0 = time.monotonic()
+            while not r.done and time.monotonic() - t0 < 30:
+                time.sleep(0.01)
+            # idle for several deadlines AFTER the traffic drains
+            time.sleep(0.6)
+            assert wd.trips == 0, "idle period tripped the watchdog"
+            # and the watchdog still works for real wedges afterwards
+            assert r.state == FINISHED
+        finally:
+            w.stop()
+            eng.close()
+            wd.stop()
+
+
+def test_corrupt_serving_json_names_the_real_defect(tmp_path):
+    from deepspeed_tpu.monitor.report import load_run
+
+    run_dir = tmp_path / "svrun"
+    run_dir.mkdir()
+    (run_dir / "serving.json").write_text('{"lanes": {"contin')  # torn
+    with pytest.raises(ValueError, match="serving.json"):
+        load_run(str(run_dir))
+
+
+def test_chunked_prefill_token_identical_to_one_shot(model_and_params):
+    """prefill_chunk 4 vs 32 (whole prompt in one call) — chunking is
+    a scheduling choice, never a numerics choice."""
+    model, params = model_and_params
+    prompt = _prompts(seed=17, lens=(19,))[0]
+    outs = {}
+    for chunk in (4, 32):
+        eng = ServeEngine(model, params, _cfg(prefill_chunk=chunk))
+        outs[chunk] = eng.generate([prompt], 6)[0]
+    assert outs[4] == outs[32]
+
+
+def test_static_admission_policy_blocks_until_batch_drains(
+        model_and_params, programs):
+    prompts = _prompts(seed=19)
+    eng = _engine(model_and_params, programs, admission="static")
+    r_first = eng.submit(prompts[0], 8)
+    eng.step()
+    r_late = eng.submit(prompts[1], 4)
+    eng.step()
+    # static: the late request cannot join the occupied batch
+    assert r_late.state == WAITING
+    eng.run()
+    assert r_first.state == FINISHED and r_late.state == FINISHED
+    # outputs are policy-independent (the invariance contract)
+    assert r_first.out == _alone(model_and_params, programs, prompts[0], 8)
+    assert r_late.out == _alone(model_and_params, programs, prompts[1], 4)
+
+
+# -- counters ---------------------------------------------------------------
+
+
+def test_serving_counters_pinned_exactly(model_and_params, programs):
+    prompt = _prompts(seed=23, lens=(5,))[0]
+    eng = _engine(model_and_params, programs)
+    snap = COUNTERS.snapshot()
+    r = eng.submit(prompt, 3)
+    eng.run()
+    d = COUNTERS.delta_since(snap)
+    assert r.out and len(r.out) == 3
+    # prompt 5 -> one chunk of 5 valid tokens
+    assert d["serve.prefill_chunks"] == {"calls": 1, "bytes": 5}, d
+    # token 1 from prefill (same engine step dispatches the first
+    # decode), tokens 2..3 from two decode steps of one active slot
+    assert d["serve.decode_steps"] == {"calls": 2, "bytes": 2}, d
+    assert d["serve.tokens"]["calls"] == 3, d
+    assert d["serve.requests"] == {"calls": 1, "bytes": 3}, d
+    assert d["serve.ttft_ms"]["calls"] == 1, d
+    assert d["serve.ttft_ms"]["bytes"] > 0, d
+    # ceil((5 + 3) / 4) = 2 blocks, occupancy sampled per engine step:
+    # step 1 = prefill + first decode (2 in use), step 2 = final
+    # decode, which finishes + frees before the sample -> [2, 0]
+    assert d["kv.blocks_in_use"] == {"calls": 2, "bytes": 2}, d
+    assert "kv.evictions" not in d and "serve.shed" not in d
+
+
+# -- chaos: wedged decode -> watchdog trip -> shed --------------------------
+
+
+def test_wedged_decode_sheds_requests_not_the_fleet():
+    """The chaos lane (satellite: serve_bench + in-test): a decode-step
+    hang trips the StepWatchdog, the wedged batch is shed with an
+    error, waiting requests complete with oracle-identical output."""
+    import serve_bench
+
+    result = serve_bench.run_dry_chaos(record=False)
+    assert result["shed"] == 2
+    assert result["watchdog_trips"] == 1
+    assert result["survivors_ok"]
+
+
+def test_shed_requests_report_error_and_evictions(model_and_params,
+                                                  programs):
+    """request_shed() directly (no watchdog): victims get state
+    'error' + the reason, their blocks count as kv.evictions."""
+    prompts = _prompts(seed=29)
+    eng = _engine(model_and_params, programs)
+    snap = COUNTERS.snapshot()
+    r0 = eng.submit(prompts[0], 8)
+    r1 = eng.submit(prompts[1], 8)
+    for _ in range(3):
+        eng.step()
+    held = eng.kv.blocks_in_use
+    assert held > 0
+    eng.request_shed("test wedge")
+    r2 = eng.submit(prompts[2], 4)
+    eng.run()
+    d = COUNTERS.delta_since(snap)
+    assert r0.state == ERROR and "test wedge" in r0.error
+    assert r1.state == ERROR
+    assert r2.state == FINISHED
+    assert r2.out == _alone(model_and_params, programs, prompts[2], 4)
+    assert d["serve.shed"]["calls"] == 2
+    assert d["kv.evictions"]["calls"] == held
+    assert eng.kv.blocks_in_use == 0
+
+
+def test_worker_death_fails_requests_loudly(model_and_params, programs):
+    """A ServeWorker that dies marks every non-terminal request
+    'error' (never a silent hang) and re-raises on stop()."""
+    from deepspeed_tpu.serving import ServeWorker
+
+    eng = _engine(model_and_params, programs)
+    orig = eng.step
+
+    def boom():
+        raise RuntimeError("injected engine failure")
+
+    eng.step = boom
+    w = ServeWorker(eng)
+    w.start()
+    r = eng.submit(_prompts()[0], 4)
+    w.join(timeout=10.0)
+    assert not w.is_alive()
+    assert r.state == ERROR and "injected engine failure" in r.error
+    with pytest.raises(RuntimeError, match="injected engine failure"):
+        w.stop()
+    eng.step = orig
+
+
+# -- quantized weights / mesh sharding --------------------------------------
+
+
+def test_qwz_weights_invariance_and_memory_shape(model_and_params):
+    """int8 qwZ weights: the invariance contract holds unchanged, and
+    matmul leaves really are stored quantized (uint8/int8 + fp16
+    scales)."""
+    from deepspeed_tpu.serving.programs import QuantLeaf
+
+    model, params = model_and_params
+    cfg = _cfg(quantized_weights="int8")
+    eng = ServeEngine(model, params, cfg)
+    qleaves = [l for l in jax.tree_util.tree_leaves(
+        eng.params, is_leaf=lambda x: isinstance(x, QuantLeaf))
+        if isinstance(l, QuantLeaf)]
+    assert qleaves, "no quantized leaves found"
+    assert all(l.payload.dtype == jnp.int8 for l in qleaves)
+    assert all(l.scales.dtype == jnp.float16 for l in qleaves)
+
+    prompts = _prompts(seed=31)
+    batched = eng.generate(prompts[:3], 6, temperature=0.7, top_k=8,
+                           seeds=[1, 2, 3])
+    alone = ServeEngine(model, params, cfg, programs=eng.programs)
+    assert alone.generate([prompts[1]], 6, temperature=0.7, top_k=8,
+                          seeds=[2])[0] == batched[1]
+
+
+def test_mesh_sharded_kv_cache_invariance(model_and_params):
+    """TP=2 mesh: the KV cache shards its head dimension over `model`,
+    and batching invariance still holds exactly (same program, same
+    shardings for the alone and batched runs)."""
+    from deepspeed_tpu.comm.mesh import make_mesh
+
+    model, params = model_and_params
+    info = make_mesh(data=1, model=2, devices=jax.devices()[:2])
+    eng = ServeEngine(model, params, _cfg(), mesh_info=info)
+    assert eng.kv._sharding is not None, "cache should shard over model"
+    prompts = _prompts(seed=37)
+    batched = eng.generate(prompts[:3], 6, temperature=0.7, top_k=8,
+                           seeds=[1, 2, 3])
+    eng2 = ServeEngine(model, params, _cfg(), mesh_info=info,
+                       programs=eng.programs)
+    assert eng2.generate([prompts[0]], 6, temperature=0.7, top_k=8,
+                         seeds=[1])[0] == batched[0]
+
+
+# -- validation -------------------------------------------------------------
+
+
+def test_config_and_submit_validation(model_and_params, programs):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="admission"):
+        ServeConfig(admission="greedy")
+    with pytest.raises(ValueError, match="num_blocks"):
+        ServeConfig(num_blocks=1)
+    with pytest.raises(ValueError, match="quantized_weights"):
+        ServeConfig(quantized_weights="fp8")
+    with pytest.raises(ValueError, match="max_seq_len"):
+        ServeEngine(model, params, _cfg(max_seq_len=MAX_SEQ * 2))
+
+    eng = _engine(model_and_params, programs)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        eng.submit(list(range(60)), 10)
+    with pytest.raises(ValueError, match="non-empty"):
+        eng.submit([], 4)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        eng.submit([1, 2], 0)
+    with pytest.raises(ValueError, match="temperature"):
+        eng.submit([1, 2], 4, temperature=-1.0)
+    # a tiny pool can never serve a request wider than its free list
+    small = _engine(model_and_params, num_blocks=3, max_seq_len=32)
+    with pytest.raises(ValueError, match="KV blocks"):
+        small.submit(list(range(10)), 10)
+
+
+def test_prebuilt_program_schedule_mismatch_is_loud(model_and_params,
+                                                    programs):
+    model, params = model_and_params
+    with pytest.raises(ValueError, match="prebuilt programs"):
+        ServeEngine(model, params, _cfg(max_batch=2), programs=programs)
+
+
+def test_moe_and_pipeline_configs_rejected():
+    model = GPT(gpt2_config("nano", vocab_size=VOCAB, num_experts=4,
+                            moe_top_k=2))
+    sched = ServeSchedule(max_batch=2, prefill_chunk=8, block_size=BS,
+                          num_blocks=8, table_width=WIDTH)
+    with pytest.raises(NotImplementedError, match="dense GPT"):
+        ServeProgramBuilder(model, sched)
+
+
+# -- the bench lane ---------------------------------------------------------
+
+
+def test_serve_bench_dry_run():
+    """tools/serve_bench.py --dry-run (tier-1 so the lane cannot rot):
+    both admission lanes complete every request and agree on token
+    totals (the invariance contract seen from the bench)."""
+    import serve_bench
+
+    result = serve_bench.run_dry(record=False)
+    for lane in result["lanes"].values():
+        assert lane["completed"] == lane["requests"]
+        assert lane["errored"] == 0
+    assert result["lanes"]["continuous"]["tokens"] == \
+        result["lanes"]["static"]["tokens"]

@@ -72,6 +72,18 @@ def selftest() -> int:
             # Resilience rows, excluded from the comm byte table
             COUNTERS.add("elastic.shrinks", calls=1)
             COUNTERS.add("elastic.regrows", calls=1)
+            # serving engine (deepspeed_tpu/serving): rendered as the
+            # "Serving" section, never comm byte rows; serve.ttft_ms
+            # carries µs in the bytes slot, kv.blocks_in_use is an
+            # occupancy sample (mean = bytes/calls)
+            COUNTERS.add("serve.requests", 24, calls=2)
+            COUNTERS.add("serve.tokens", calls=12)
+            COUNTERS.add("serve.decode_steps", 9, calls=3)
+            COUNTERS.add("serve.prefill_chunks", 16, calls=2)
+            COUNTERS.add("serve.ttft_ms", 250_000, calls=2)
+            COUNTERS.add("serve.shed", calls=1)
+            COUNTERS.add("kv.blocks_in_use", 10, calls=4)
+            COUNTERS.add("kv.evictions", calls=3)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -112,6 +124,24 @@ def selftest() -> int:
                 "from_world": 3, "to_world": 4, "transition": "regrow",
                 "incarnation": 3,
             }) + "\n")
+        # a serving-bench lane table (tools/serve_bench.py serving.json)
+        # renders as the "Serving bench" table beside the training
+        # sections
+        with open(os.path.join(root, "selftest", "serving.json"),
+                  "w") as f:
+            lane = lambda tps, p99: {
+                "requests": 8, "completed": 8, "errored": 0,
+                "tokens": 96, "tokens_per_sec": tps, "makespan_s": 1.0,
+                "ttft_ms": {"p50": 12.0, "p99": p99, "mean": 20.0},
+                "itl_ms": {"p50": 2.0, "p99": 6.0},
+                "kv_blocks": {"mean": 9.5, "peak": 14, "capacity": 31},
+                "shed": 0}
+            _json.dump({"schema_version": 1, "n_requests": 8,
+                        "rate_hz": 4.0,
+                        "model": {"layers": 2, "d_model": 32, "heads": 4,
+                                  "vocab": 64},
+                        "lanes": {"continuous": lane(120.0, 40.0),
+                                  "static": lane(80.0, 90.0)}}, f)
         run = load_run(os.path.join(root, "selftest"))
         bad = [err for events in run["ranks"].values()
                for e in events for err in validate_event(e)]
@@ -136,7 +166,14 @@ def selftest() -> int:
                        "Elastic transitions", "shrink | 4 → 3",
                        "regrow | 3 → 4",
                        "elastic shrinks (resumed at a smaller dp)",
-                       "elastic regrows (resumed at a larger dp)"):
+                       "elastic regrows (resumed at a larger dp)",
+                       "## Serving", "requests completed",
+                       "mean batch occupancy", "mean time-to-first-token",
+                       "mean KV blocks in use",
+                       "KV blocks force-reclaimed",
+                       "requests shed (wedged decode)",
+                       "Serving bench (continuous batching)",
+                       "continuous vs static batching: 1.50x"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
@@ -152,6 +189,19 @@ def selftest() -> int:
         assert "`elastic.shrinks`" not in md and \
             "`elastic.regrows`" not in md, \
             "elastic.* rows must not leak into the comm table"
+        assert "`serve.tokens`" not in md and \
+            "`kv.blocks_in_use`" not in md, \
+            "serve.*/kv.* rows must not leak into the comm table"
+        # serving.json alone must render without event streams (the
+        # serve-bench run-dir shape)
+        import shutil as _shutil
+
+        sv_dir = os.path.join(root, "sv_only")
+        os.makedirs(sv_dir)
+        _shutil.copy(os.path.join(root, "selftest", "serving.json"),
+                     sv_dir)
+        md2 = render_markdown(load_run(sv_dir))
+        assert "Serving bench (continuous batching)" in md2, md2
     print("run_report selftest ok")
     return 0
 

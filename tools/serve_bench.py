@@ -1,0 +1,374 @@
+#!/usr/bin/env python
+"""Serve bench: continuous batching vs static batching under Poisson
+arrivals.
+
+The headline serving claim: a continuous-batching engine (in-flight
+admission over the paged KV cache, deepspeed_tpu/serving/) sustains
+more tokens/s at equal-or-better tail latency than classic static
+batching, because slots and KV blocks freed by a finished request are
+refilled the SAME step instead of draining the batch to its longest
+member.  This tool runs that claim as a bench:
+
+* one request timeline (seeded Poisson inter-arrivals, varied prompt
+  lengths and token budgets) replayed against TWO engines that differ
+  only in the admission policy (`continuous` vs `static`);
+* arrivals land from a submitter thread while a `ServeWorker` drives
+  the engine — real wall-clock, real overlap of admission and decode;
+* per-lane metrics: decoded tokens/s over the makespan, p50/p99
+  time-to-first-token, p50/p99 inter-token latency, mean/peak KV block
+  occupancy, plus the serve.*/kv.* counter deltas.
+
+Artifacts (the PR-2 rule): a flat result JSON via
+monitor/artifacts.record_bench_result PLUS a run directory
+`bench_artifacts/runs/<stamp>_serve_bench/serving.json` that
+`tools/run_report.py <dir>` renders as the "Serving bench" table.
+
+Campaigns:
+
+* default — the full two-lane Poisson comparison (committed numbers in
+  BENCH.md round-16).
+* `--dry-run` — a seconds-scale miniature of the same two lanes, wired
+  into tier-1 via tests/test_serving.py so the bench cannot rot.
+* `run_dry_chaos()` (tests/test_serving.py) — the chaos lane: a
+  FaultPlan hangs a decode step, the StepWatchdog trips and sheds the
+  wedged batch, the remaining requests complete with oracle-identical
+  outputs.
+
+Usage: python tools/serve_bench.py [--dry-run] [--requests 48]
+           [--rate 24.0] [--seed 0] [--no-record]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+SERVING_SCHEMA_VERSION = 1
+
+
+def _percentile(xs, q):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    idx = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[idx]
+
+
+def build_timeline(n_requests: int, rate_hz: float, seed: int,
+                   vocab: int, prompt_range=(4, 24), new_range=(4, 32)):
+    """Seeded Poisson arrival timeline: [(t_arrival_s, prompt, max_new,
+    temperature, top_k, seed)] — identical for every lane."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    t = 0.0
+    timeline = []
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_hz))
+        p_len = int(rng.randint(*prompt_range))
+        prompt = rng.randint(0, vocab, (p_len,)).tolist()
+        max_new = int(rng.randint(*new_range))
+        temp = float(rng.choice([0.0, 0.7, 1.0]))
+        timeline.append((t, prompt, max_new, temp, 8, 1000 + i))
+    return timeline
+
+
+def _nano_model(vocab=128, max_seq=128, layers=2, d_model=64, heads=4):
+    import jax
+
+    from deepspeed_tpu.models import GPT, gpt2_config
+
+    model = GPT(gpt2_config("nano", num_layers=layers, num_heads=heads,
+                            d_model=d_model, vocab_size=vocab,
+                            max_seq_len=max_seq))
+    params = model.init(jax.random.PRNGKey(0))
+    return model, params
+
+
+def run_lane(model, params, serve_cfg, timeline, programs=None,
+             watchdog=None):
+    """Replay `timeline` against one engine; returns (metrics, engine)."""
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.serving import ServeEngine, ServeWorker
+
+    eng = ServeEngine(model, params, serve_cfg, programs=programs)
+    if watchdog is not None:
+        eng.attach_watchdog(watchdog)
+    worker = ServeWorker(eng)
+    snap = COUNTERS.snapshot()
+    worker.start()
+    t0 = time.monotonic()
+    reqs = []
+    try:
+        for t_arr, prompt, max_new, temp, top_k, seed in timeline:
+            delay = t0 + t_arr - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(eng.submit(prompt, max_new, temperature=temp,
+                                   top_k=top_k, seed=seed))
+        while eng.has_work() and worker.is_alive():
+            time.sleep(0.005)
+    finally:
+        worker.stop()
+        eng.close()
+    delta = COUNTERS.delta_since(snap)
+
+    done = [r for r in reqs if r.state == "finished"]
+    errored = [r for r in reqs if r.state == "error"]
+    ttfts = [r.ttft_s * 1000.0 for r in done if r.ttft_s is not None]
+    itls = []
+    for r in done:
+        itls.extend((b - a) * 1000.0
+                    for a, b in zip(r.token_times, r.token_times[1:]))
+    n_tokens = sum(len(r.out) for r in done)
+    makespan = max((r.t_finish for r in done if r.t_finish is not None),
+                   default=t0) - t0
+    kv_samples = delta.get("kv.blocks_in_use", {})
+    mean_blocks = (kv_samples.get("bytes", 0) / kv_samples["calls"]
+                   if kv_samples.get("calls") else 0.0)
+    metrics = {
+        "requests": len(reqs),
+        "completed": len(done),
+        "errored": len(errored),
+        "tokens": n_tokens,
+        "makespan_s": round(makespan, 3),
+        "tokens_per_sec": round(n_tokens / makespan, 2) if makespan else None,
+        "ttft_ms": {"p50": round(_percentile(ttfts, 50), 2) if ttfts else None,
+                    "p99": round(_percentile(ttfts, 99), 2) if ttfts else None,
+                    "mean": round(sum(ttfts) / len(ttfts), 2) if ttfts
+                    else None},
+        "itl_ms": {"p50": round(_percentile(itls, 50), 2) if itls else None,
+                   "p99": round(_percentile(itls, 99), 2) if itls else None},
+        "kv_blocks": {"mean": round(mean_blocks, 2),
+                      "peak": eng.peak_blocks_in_use,
+                      "capacity": eng.kv.capacity_blocks},
+        "decode_steps": delta.get("serve.decode_steps", {}).get("calls", 0),
+        "shed": delta.get("serve.shed", {}).get("calls", 0),
+        "counters": delta,
+    }
+    return metrics, eng
+
+
+def run_campaign(n_requests=48, rate_hz=24.0, seed=0, record=True,
+                 dry=False):
+    """The two-lane comparison; returns the result dict."""
+    import jax
+
+    from deepspeed_tpu.serving import ServeConfig
+
+    if dry:
+        n_requests, rate_hz = min(n_requests, 6), max(rate_hz, 8.0)
+        model, params = _nano_model(vocab=64, max_seq=64, d_model=32)
+        mk_cfg = lambda adm: ServeConfig(
+            block_size=4, num_blocks=48, max_batch=3, prefill_chunk=8,
+            max_seq_len=64, admission=adm)
+        timeline = build_timeline(n_requests, rate_hz, seed, 64,
+                                  prompt_range=(3, 10), new_range=(3, 10))
+    else:
+        # sized so arrivals SATURATE the engine on the CPU lane (~3.6
+        # ms/decode-step at full batch): the admission policies only
+        # differentiate under queueing pressure
+        model, params = _nano_model(vocab=512, max_seq=256, layers=4,
+                                    d_model=128, heads=8)
+        mk_cfg = lambda adm: ServeConfig(
+            block_size=8, num_blocks=128, max_batch=4, prefill_chunk=16,
+            max_seq_len=256, admission=adm)
+        timeline = build_timeline(n_requests, rate_hz, seed, 512,
+                                  prompt_range=(4, 32),
+                                  new_range=(16, 96))
+
+    # warm the compile cache OUTSIDE the timed lanes: both lanes share
+    # one (prefill, decode) program pair, so neither pays XLA
+    # compilation against its latency numbers
+    from deepspeed_tpu.serving import ServeEngine
+
+    warm = ServeEngine(model, params, mk_cfg("continuous"))
+    warm.generate([timeline[0][1]], 2)
+    programs = warm.programs
+    del warm
+
+    lanes = {}
+    for adm in ("continuous", "static"):
+        print(f"--- lane: {adm} batching ({n_requests} requests, "
+              f"Poisson {rate_hz:.1f}/s) ---")
+        metrics, _eng = run_lane(model, params, mk_cfg(adm), timeline,
+                                 programs=programs)
+        lanes[adm] = metrics
+        print(f"    {metrics['completed']}/{metrics['requests']} done, "
+              f"{metrics['tokens']} tok in {metrics['makespan_s']}s = "
+              f"{metrics['tokens_per_sec']} tok/s; TTFT p50/p99 "
+              f"{metrics['ttft_ms']['p50']}/{metrics['ttft_ms']['p99']} ms; "
+              f"ITL p50/p99 {metrics['itl_ms']['p50']}/"
+              f"{metrics['itl_ms']['p99']} ms; KV mean/peak "
+              f"{metrics['kv_blocks']['mean']}/"
+              f"{metrics['kv_blocks']['peak']}")
+
+    cont, stat = lanes["continuous"], lanes["static"]
+    result = {
+        "metric": "serve_bench",
+        "platform": jax.default_backend(),
+        "dry_run": dry,
+        "n_requests": n_requests,
+        "rate_hz": rate_hz,
+        "seed": seed,
+        "model": {"layers": model.config.num_layers,
+                  "d_model": model.config.d_model,
+                  "heads": model.config.num_heads,
+                  "vocab": model.config.vocab_size},
+        "lanes": lanes,
+        "value": cont["tokens_per_sec"],
+        "unit": "tokens/s (continuous)",
+        "speedup_tokens_per_sec": (
+            round(cont["tokens_per_sec"] / stat["tokens_per_sec"], 3)
+            if stat["tokens_per_sec"] else None),
+    }
+    if record:
+        result["artifact"], result["run_dir"] = record_serving(result)
+        print(f"artifact: {result['artifact']}")
+        print(f"report:   python tools/run_report.py {result['run_dir']}")
+    return result
+
+
+def record_serving(result):
+    """Flat artifact via record_bench_result + a run directory holding
+    serving.json for tools/run_report.py."""
+    from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+    rel = record_bench_result(result)
+    runs_root = os.path.join(os.path.dirname(HERE), "bench_artifacts",
+                             "runs")
+    stamp = os.path.basename(rel).rsplit(".", 1)[0]
+    run_dir = os.path.join(runs_root, stamp)
+    os.makedirs(run_dir, exist_ok=True)
+    serving = {"schema_version": SERVING_SCHEMA_VERSION,
+               "model": result["model"],
+               "n_requests": result["n_requests"],
+               "rate_hz": result["rate_hz"],
+               "lanes": {name: {k: v for k, v in lane.items()
+                                if k != "counters"}
+                         for name, lane in result["lanes"].items()}}
+    with open(os.path.join(run_dir, "serving.json"), "w") as f:
+        json.dump(serving, f, indent=2, sort_keys=True)
+    return rel, os.path.relpath(run_dir, os.path.dirname(HERE))
+
+
+def run_dry(record=False):
+    """Tier-1 CPU miniature (tests/test_serving.py): both lanes finish
+    every request, metrics are well-formed; no perf assertion — the
+    point is that the lane cannot rot."""
+    result = run_campaign(record=record, dry=True)
+    for name, lane in result["lanes"].items():
+        assert lane["completed"] == lane["requests"], (name, lane)
+        assert lane["errored"] == 0, (name, lane)
+        assert lane["tokens"] > 0 and lane["tokens_per_sec"], (name, lane)
+        assert lane["ttft_ms"]["p99"] is not None, (name, lane)
+        assert lane["kv_blocks"]["peak"] <= lane["kv_blocks"]["capacity"]
+    assert result["lanes"]["continuous"]["tokens"] == \
+        result["lanes"]["static"]["tokens"], \
+        "both lanes decode the same timeline: token totals must agree"
+    return result
+
+
+def run_dry_chaos(record=False):
+    """Chaos lane (tier-1 via tests/test_serving.py): hang one decode
+    step -> StepWatchdog trips -> the wedged batch is SHED (state
+    'error', blocks reclaimed) -> everything waiting completes with
+    oracle-identical output."""
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.runtime.resilience import (FaultPlan, FaultRule,
+                                                  StepWatchdog,
+                                                  install_fault_plan)
+    from deepspeed_tpu.serving import ServeConfig, ServeEngine
+
+    model, params = _nano_model(vocab=64, max_seq=64, d_model=32)
+    cfg = ServeConfig(block_size=4, num_blocks=48, max_batch=2,
+                      prefill_chunk=8, max_seq_len=64)
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 64, (n,)).tolist() for n in (5, 7, 4, 6)]
+
+    # oracle: every request alone, no faults
+    oracle_eng = ServeEngine(model, params, cfg)
+    oracle = [oracle_eng.generate([p], 6)[0] for p in prompts]
+
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as snap_dir:
+        eng = ServeEngine(model, params, cfg, programs=oracle_eng.programs)
+        wd = StepWatchdog(deadline_s=0.5, snapshot_dir=snap_dir,
+                          poll_s=0.05,
+                          on_trip=lambda trip: eng.request_shed(
+                              trip["reason"]))
+        eng.attach_watchdog(wd)
+        # two requests running, then the 3rd decode call hangs past the
+        # watchdog deadline
+        plan = FaultPlan([FaultRule(site="serve.decode", kind="hang",
+                                    hang_s=1.5, calls=[2])], seed=0)
+        install_fault_plan(plan)
+        snap = COUNTERS.snapshot()
+        try:
+            r01 = [eng.submit(prompts[0], 6), eng.submit(prompts[1], 6)]
+            while any(not r.done for r in r01):
+                eng.step()
+            r23 = [eng.submit(prompts[2], 6), eng.submit(prompts[3], 6)]
+            eng.run()
+        finally:
+            install_fault_plan(None)
+            eng.close()
+            wd.stop()
+        delta = COUNTERS.delta_since(snap)
+
+    shed = [r for r in r01 if r.state == "error"]
+    assert len(shed) == 2, [r.state for r in r01]
+    assert wd.trips == 1, wd.trips
+    assert delta.get("serve.shed", {}).get("calls") == 2, delta
+    assert delta.get("kv.evictions", {}).get("calls", 0) > 0, delta
+    assert delta.get("fault.injected", {}).get("calls") == 1, delta
+    # the batch behind the wedge completes, token-identical
+    assert [r.out for r in r23] == oracle[2:], \
+        (oracle[2:], [r.out for r in r23])
+    assert eng.kv.blocks_in_use == 0
+    result = {"metric": "serve_chaos", "shed": len(shed),
+              "watchdog_trips": wd.trips,
+              "survivors_ok": [r.out for r in r23] == oracle[2:]}
+    if record:
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        result["artifact"] = record_bench_result(result)
+    return result
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dry-run", action="store_true",
+                    help="seconds-scale miniature (the tier-1 lane)")
+    ap.add_argument("--requests", type=int, default=48)
+    ap.add_argument("--rate", type=float, default=24.0,
+                    help="Poisson arrival rate (req/s)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--no-record", action="store_true")
+    args = ap.parse_args()
+    if args.dry_run:
+        run_dry(record=not args.no_record)
+        print("serve_bench dry-run ok")
+        return 0
+    result = run_campaign(n_requests=args.requests, rate_hz=args.rate,
+                          seed=args.seed, record=not args.no_record)
+    cont = result["lanes"]["continuous"]
+    stat = result["lanes"]["static"]
+    print(f"\ncontinuous vs static: "
+          f"{cont['tokens_per_sec']} vs {stat['tokens_per_sec']} tok/s "
+          f"({result['speedup_tokens_per_sec']}x), TTFT p99 "
+          f"{cont['ttft_ms']['p99']} vs {stat['ttft_ms']['p99']} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
