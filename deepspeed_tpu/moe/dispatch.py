@@ -1,0 +1,733 @@
+"""Fused sort-based MoE dispatch/combine + the explicit expert
+all-to-all wire.
+
+The seed-era MoE path (layer.py) routes with GShard's dense one-hot
+machinery: dispatch/combine are materialized [N, E, C] tensors and both
+token movements are O(N·E·C·D) einsums — for what is fundamentally a
+PERMUTATION.  This module rebuilds token movement as explicit,
+instrumented, compressible data flow:
+
+* `topk_routing` — the single routing core BOTH dispatch paths share:
+  iterative top-k expert selection (GShard priority order), then queue
+  positions from ONE stable argsort over the round-major assignment
+  list.  Positions are exact INT32 throughout (the seed computed them
+  via an fp32 `cumsum(onehot)`, which silently loses integer exactness
+  past 2^24 tokens); the sort rank within an expert's segment equals
+  the seed's round-carrying cumsum by construction, so the dense and
+  sorted paths route IDENTICALLY.
+* `sorted_dispatch` / `sorted_combine` — gather tokens into [E, C, D]
+  expert buckets through one scatter-add (capacity-overflowing
+  assignments land on a reserved trash slot, serving/kv_cache style:
+  branch-free, static shapes) and scatter-combine back with the gate
+  weights: O(N log N + k·N·D) instead of O(N·E·C·D).
+* dropless mode — a second-pass SHARED overflow bucket: assignments
+  past an expert's capacity take rank-ordered slots in one [O, D]
+  bucket processed with per-row gathered expert weights, so capacity
+  overflow degrades into a small dense matmul instead of dropped
+  tokens (exactly-once accounting pinned in tests).  The bucket is
+  static-shaped; assignments past BOTH buckets still drop (counted).
+* the explicit expert a2a wire — a `shard_map`-level `lax.all_to_all`
+  with its own per-level wire dtypes (`fp32`/`bf16`/`int8`/`int4`,
+  the int wires riding runtime/comm/quant.py's blockwise kernels with
+  payload+scales fused into ONE uint8 buffer per chunk), hierarchy
+  aware two ways on a PR-4 factored mesh: `placement` "inner" keeps
+  experts on `data_inner` (replicated across outer groups) so the
+  whole exchange stays on the fast fabric, while placement "data"
+  decomposes the global a2a into an inner hop + an outer hop so the
+  slow hop can compress independently.  The backward wire mirrors the
+  forward through a custom_vjp (cotangents ride the same quantized
+  a2a — the qgZ straight-through convention; fp32 stays the exact
+  transpose).
+* counters — `moe.a2a_bytes` / `moe.a2a_inter` / `moe.dropped_tokens`
+  / `moe.capacity_frac` recorded per EXECUTION via async
+  `jax.debug.callback` (never at trace time, so AOT lowering and flops
+  analysis can't bump them), pinned byte-exact against `a2a_plan` in
+  tier-1.  Counting is per LOCAL mesh rank: on the 8-device virtual
+  test mesh one dispatch fires 8 callbacks — the counter totals the
+  local fabric traffic, mirroring how a real per-process deployment
+  sums its local devices.
+
+Accuracy contract vs the dense path: routing (expert choice, gate
+weights, capacity drops) is IDENTICAL by construction.  The combined
+output differs only by floating-point reduction order: exact for
+top_k <= 2 (a two-term sum is commutative) and per-token tolerance for
+k > 2; the quantized wires add one quantization error per hop
+(documented in docs/tutorials/moe.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import functools
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..comm.mesh import (DATA_AXIS, DATA_INNER_AXIS, DATA_OUTER_AXIS,
+                         MODEL_AXIS, PIPE_AXIS, SEQ_AXIS, MeshInfo,
+                         peek_mesh)
+from ..monitor.counters import COUNTERS
+from ..runtime.comm.quant import (DEFAULT_BLOCK_SIZE, dequantize_blockwise,
+                                  pack_wire, payload_bytes, quantize_blockwise,
+                                  unpack_wire, validate_block_size)
+from ..utils.logging import logger
+
+DISPATCH_MODES = ("dense", "sorted")
+A2A_WIRES = ("fp32", "bf16", "int8", "int4")
+PLACEMENT_MODES = ("auto", "data", "inner")
+OVERLAP_MODES = ("none", "auto", "on")
+
+_WIRE_ITEMSIZE = {"fp32": 4, "bf16": 2}
+
+
+# ---------------------------------------------------------------------------
+# wire configuration (the validated `comm.moe` block)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class MoEWireConfig:
+    """Process-global MoE token-movement selection.
+
+    The default-constructed config is EXACTLY the seed behaviour: dense
+    one-hot dispatch, token exchange left implicit to XLA, no counters.
+    The engine installs a parsed config at initialize() from the
+    `"comm": {"moe": {...}}` block; direct layer users select modes
+    with the `moe_wire(...)` context manager."""
+
+    dispatch: str = "dense"            # "dense" | "sorted"
+    a2a_wire_dtype: Optional[str] = None   # None -> implicit XLA a2a
+    a2a_wire_dtype_inner: Optional[str] = None  # default: a2a_wire_dtype
+    a2a_wire_dtype_outer: Optional[str] = None
+    placement: str = "auto"            # "auto" | "data" | "inner"
+    dropless: bool = False
+    overflow_factor: float = 0.25      # overflow bucket = ceil(f * k * N)
+    quant_block_size: int = DEFAULT_BLOCK_SIZE
+    overlap: str = "none"
+    counters: bool = True
+
+    @property
+    def explicit(self) -> bool:
+        # a per-level override alone also selects the explicit wire
+        # (parse_moe_config normalizes the base to fp32; direct
+        # constructor users get the same semantics)
+        return (self.a2a_wire_dtype is not None
+                or self.a2a_wire_dtype_inner is not None
+                or self.a2a_wire_dtype_outer is not None)
+
+    def wire_inner(self) -> str:
+        return self.a2a_wire_dtype_inner or self.a2a_wire_dtype or "fp32"
+
+    def wire_outer(self) -> str:
+        return self.a2a_wire_dtype_outer or self.a2a_wire_dtype or "fp32"
+
+    def describe(self) -> str:
+        if not self.explicit:
+            return (f"moe wire: dispatch={self.dispatch}, a2a=implicit "
+                    f"(XLA), dropless={self.dropless}")
+        return (f"moe wire: dispatch={self.dispatch}, a2a=explicit "
+                f"inner={self.wire_inner()} outer={self.wire_outer()} "
+                f"placement={self.placement} block={self.quant_block_size}")
+
+
+def parse_moe_config(d, default_block: int = DEFAULT_BLOCK_SIZE
+                     ) -> MoEWireConfig:
+    """Validate the `comm.moe` dict -> MoEWireConfig.  Every invalid or
+    inherited-invalid combination is rejected HERE, naming the key and
+    the valid set — never left to fail inside a traced step program."""
+    d = d or {}
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"comm.moe must be an object, got {type(d).__name__}")
+    known = {"dispatch", "a2a_wire_dtype", "a2a_wire_dtype_inner",
+             "a2a_wire_dtype_outer", "placement", "dropless",
+             "overflow_factor", "quant_block_size", "overlap", "counters"}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(
+            f"comm.moe: unknown key(s) {sorted(unknown)}; expected a "
+            f"subset of {sorted(known)}")
+
+    def wire_param(key):
+        w = d.get(key)
+        if w is None:
+            return None
+        w = str(w).lower()
+        if w not in A2A_WIRES:
+            extra = ""
+            if w == "split":
+                extra = (" (the 24-bit frexp split wire carries two "
+                         "sidebands and has no all-to-all lowering; the "
+                         "fused int8/int4 blockwise wires are the "
+                         "compressed a2a options)")
+            raise ValueError(
+                f"comm.moe.{key} must be one of {A2A_WIRES}, "
+                f"got {w!r}{extra}")
+        return w
+
+    base = wire_param("a2a_wire_dtype")
+    inner = wire_param("a2a_wire_dtype_inner")
+    outer = wire_param("a2a_wire_dtype_outer")
+    if base is None and (inner is not None or outer is not None):
+        # per-level overrides imply the explicit wire; the unnamed level
+        # stays exact
+        base = "fp32"
+
+    # dispatch default: the seed's dense path — EXCEPT when an explicit
+    # a2a wire is requested, which only the sorted engine can feed
+    dispatch = str(d.get("dispatch",
+                         "sorted" if base is not None else "dense")).lower()
+    if dispatch not in DISPATCH_MODES:
+        raise ValueError(
+            f"comm.moe.dispatch must be one of {DISPATCH_MODES}, "
+            f"got {dispatch!r}")
+    if base is not None and "dispatch" in d and dispatch != "sorted":
+        raise ValueError(
+            "comm.moe.a2a_wire_dtype requires comm.moe.dispatch='sorted': "
+            "the explicit all-to-all wire moves sort-dispatched [E, C, D] "
+            "expert buckets; the dense one-hot path leaves the exchange "
+            f"to XLA (got dispatch={dispatch!r}; valid: ('sorted',))")
+
+    placement = str(d.get("placement", "auto")).lower()
+    if placement not in PLACEMENT_MODES:
+        raise ValueError(
+            f"comm.moe.placement must be one of {PLACEMENT_MODES}, "
+            f"got {placement!r}")
+    if placement != "auto" and base is None:
+        raise ValueError(
+            f"comm.moe.placement={placement!r} only applies to the "
+            "explicit a2a wire; set comm.moe.a2a_wire_dtype (valid: "
+            f"{A2A_WIRES}) or leave placement 'auto'")
+
+    dropless = d.get("dropless", False)
+    if not isinstance(dropless, bool):
+        raise ValueError(
+            f"comm.moe.dropless must be a bool, got {dropless!r}")
+    if dropless and dispatch != "sorted":
+        raise ValueError(
+            "comm.moe.dropless requires comm.moe.dispatch='sorted' (the "
+            "overflow bucket is a second sort-dispatch pass; the dense "
+            "one-hot path has no overflow machinery)")
+    if dropless and base is not None:
+        raise ValueError(
+            "comm.moe.dropless cannot ride the explicit a2a wire: the "
+            "shared overflow bucket holds tokens for ARBITRARY experts, "
+            "which an expert-sharded all-to-all cannot route; use "
+            "dropless with the implicit exchange, or size capacity_factor "
+            "for the wire (valid: dropless with a2a_wire_dtype null)")
+
+    of = d.get("overflow_factor", 0.25)
+    if isinstance(of, bool) or not isinstance(of, (int, float)) or of <= 0:
+        raise ValueError(
+            f"comm.moe.overflow_factor must be a number > 0, got {of!r}")
+
+    overlap = d.get("overlap", "none")
+    if isinstance(overlap, bool):
+        overlap = "on" if overlap else "none"
+    overlap = str(overlap).lower()
+    if overlap not in OVERLAP_MODES:
+        raise ValueError(
+            f"comm.moe.overlap must be one of {OVERLAP_MODES} (or a "
+            f"bool), got {d.get('overlap')!r}")
+
+    block = d.get("quant_block_size", default_block)
+    try:
+        block = validate_block_size(block)
+    except ValueError as e:
+        raise ValueError(f"comm.moe.quant_block_size: {e}")
+
+    counters = d.get("counters", True)
+    if not isinstance(counters, bool):
+        raise ValueError(
+            f"comm.moe.counters must be a bool, got {counters!r}")
+
+    return MoEWireConfig(
+        dispatch=dispatch, a2a_wire_dtype=base,
+        a2a_wire_dtype_inner=inner, a2a_wire_dtype_outer=outer,
+        placement=placement, dropless=dropless,
+        overflow_factor=float(of), quant_block_size=block,
+        overlap=overlap, counters=bool(counters))
+
+
+_WIRE_CONFIG = MoEWireConfig()
+
+
+def get_wire_config() -> MoEWireConfig:
+    return _WIRE_CONFIG
+
+
+def set_wire_config(cfg: MoEWireConfig) -> MoEWireConfig:
+    """Install `cfg` process-globally; returns the previous config."""
+    global _WIRE_CONFIG
+    prev = _WIRE_CONFIG
+    _WIRE_CONFIG = cfg
+    if cfg != prev:
+        logger.debug(cfg.describe())
+    return prev
+
+
+@contextlib.contextmanager
+def moe_wire(cfg: Optional[MoEWireConfig] = None, **kwargs):
+    """Scoped wire config for direct layer users / tests:
+    `with moe_wire(dispatch="sorted", a2a_wire_dtype="int8"): ...`"""
+    prev = set_wire_config(cfg if cfg is not None
+                           else MoEWireConfig(**kwargs))
+    try:
+        yield get_wire_config()
+    finally:
+        set_wire_config(prev)
+
+
+# ---------------------------------------------------------------------------
+# routing core (shared by the dense one-hot and sorted paths)
+# ---------------------------------------------------------------------------
+
+def topk_routing(probs, k: int, capacity: int):
+    """GShard top-k routing for one token group.
+
+    probs [N, E] fp32 -> (eidx, gate, pos, keep) all [k, N] round-major
+    + aux scalar.  Expert selection is the seed's iterative
+    argmax-and-mask (round r picks each token's r-th expert); queue
+    positions come from ONE stable argsort of the round-major assignment
+    list, whose within-segment rank equals the seed's round-carrying
+    `cumsum(onehot) + base_counts` — in exact int32, with no fp32
+    integer ceiling.  `keep` marks assignments inside `capacity`."""
+    N, E = probs.shape
+    masked = probs
+    eidxs, gates = [], []
+    aux = jnp.zeros((), jnp.float32)
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1).astype(jnp.int32)   # [N]
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)    # [N, E]
+        gates.append(jnp.take_along_axis(probs, idx[:, None], 1)[:, 0])
+        eidxs.append(idx)
+        aux = aux + jnp.mean(onehot, axis=0).dot(
+            jnp.mean(probs, axis=0)) * E
+        masked = masked * (1.0 - onehot)  # next round picks a new expert
+    eidx = jnp.stack(eidxs)   # [k, N]
+    gate = jnp.stack(gates)   # [k, N] fp32 (pre-capacity)
+
+    # queue positions: stable sort by expert id over the [k*N]
+    # round-major assignments; rank within the expert's segment is the
+    # per-round arrival order with earlier rounds queued first —
+    # exactly GShard's priority discipline
+    e_flat = eidx.reshape(-1)                              # [kN]
+    order = jnp.argsort(e_flat, stable=True)
+    counts = jnp.bincount(e_flat, length=E).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts                   # [E]
+    rank = (jnp.arange(k * N, dtype=jnp.int32)
+            - starts[e_flat[order]].astype(jnp.int32))
+    pos = jnp.zeros((k * N,), jnp.int32).at[order].set(rank)
+    pos = pos.reshape(k, N)
+    keep = pos < capacity
+    return eidx, gate, pos, keep, aux / k
+
+
+# ---------------------------------------------------------------------------
+# sorted dispatch / combine (one token group; vmapped over batch rows)
+# ---------------------------------------------------------------------------
+
+def _assignment_tokens(k: int, N: int):
+    """Token index of round-major assignment a = r*N + n."""
+    return jnp.tile(jnp.arange(N, dtype=jnp.int32), k)
+
+
+def sorted_dispatch(x, eidx, pos, keep, num_experts: int, capacity: int):
+    """x [N, D] + routing [k, N] -> expert inputs [E, C, D].
+
+    One gather of the selected token rows + one scatter-add into the
+    flattened [E*C (+1 trash), D] bucket buffer; kept destinations are
+    unique by construction, dropped assignments land on the trash row
+    (sliced off), so the program is branch-free with static shapes."""
+    k, N = eidx.shape
+    D = x.shape[-1]
+    E, C = num_experts, capacity
+    flat_keep = keep.reshape(-1)
+    dest = jnp.where(flat_keep,
+                     eidx.reshape(-1) * C + pos.reshape(-1),
+                     E * C)                                   # trash slot
+    vals = x[_assignment_tokens(k, N)]                        # [kN, D]
+    vals = vals * flat_keep[:, None].astype(x.dtype)
+    buf = jnp.zeros((E * C + 1, D), x.dtype).at[dest].add(vals)
+    return buf[:E * C].reshape(E, C, D)
+
+
+def sorted_combine(expert_out, eidx, gate, pos, keep):
+    """expert outputs [E, C, D] + routing -> y [N, D].
+
+    Gathers each kept assignment's slot and sums the k rounds' gated
+    contributions per token (a k=1/2 sum is order-exact vs the dense
+    einsum; k>2 differs only by fp reduction order)."""
+    E, C, D = expert_out.shape
+    k, N = eidx.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, D),
+         jnp.zeros((1, D), expert_out.dtype)])
+    src = jnp.where(keep.reshape(-1),
+                    eidx.reshape(-1) * C + pos.reshape(-1), E * C)
+    picked = flat[src].reshape(k, N, D)
+    w = (gate * keep).astype(expert_out.dtype)                # [k, N]
+    return jnp.sum(picked * w[:, :, None], axis=0)
+
+
+def overflow_capacity(k: int, tokens: int, factor: float) -> int:
+    """Static size of the dropless shared overflow bucket for one token
+    group: ceil(factor * k * tokens), factor 1.0 = guaranteed dropless
+    (the bucket can hold every assignment)."""
+    return max(1, int(math.ceil(factor * k * tokens - 1e-9)))
+
+
+def overflow_dispatch(x, eidx, pos, keep, ov_cap: int):
+    """Second-pass dropless bucket: assignments past their expert's
+    capacity take rank-ordered slots in ONE shared [O, D] bucket.
+    Returns (bucket [O, D], bucket expert ids [O], ov_keep [k*N],
+    ov_dest [k*N])."""
+    k, N = eidx.shape
+    D = x.shape[-1]
+    ov_mask = ~keep.reshape(-1)                               # [kN]
+    ov_rank = jnp.cumsum(ov_mask.astype(jnp.int32)) - 1
+    ov_keep = ov_mask & (ov_rank < ov_cap)
+    dest = jnp.where(ov_keep, ov_rank, ov_cap)
+    vals = x[_assignment_tokens(k, N)]
+    vals = vals * ov_keep[:, None].astype(x.dtype)
+    buf = jnp.zeros((ov_cap + 1, D), x.dtype).at[dest].add(vals)
+    e_buf = jnp.zeros((ov_cap + 1,), jnp.int32).at[dest].add(
+        jnp.where(ov_keep, eidx.reshape(-1), 0))
+    return buf[:ov_cap], e_buf[:ov_cap], ov_keep, dest
+
+
+def overflow_ffn(xov, ov_e, w1, b1, w2, b2):
+    """Expert FFN over the shared overflow bucket: each row selects its
+    expert's weights through a one-hot contraction (cost O·E·d·f — the
+    bucket is small, sized by overflow_factor)."""
+    E = w1.shape[0]
+    onehot = jax.nn.one_hot(ov_e, E, dtype=xov.dtype)         # [O, E]
+    h = jnp.einsum("od,edf,oe->of", xov, w1, onehot) + b1[ov_e]
+    h = jax.nn.gelu(h, approximate=True)
+    return jnp.einsum("of,efd,oe->od", h, w2, onehot) + b2[ov_e]
+
+
+def overflow_combine(ov_out, gate, ov_keep, ov_dest, N: int):
+    """Gather overflow-bucket outputs back to tokens, gated like the
+    primary combine (only overflow-kept assignments contribute; the
+    primary bucket's keeps already combined through sorted_combine)."""
+    O, D = ov_out.shape
+    k = gate.shape[0]
+    flat = jnp.concatenate([ov_out, jnp.zeros((1, D), ov_out.dtype)])
+    picked = flat[jnp.where(ov_keep, ov_dest, O)].reshape(k, N, D)
+    w = (gate * ov_keep.reshape(k, N)).astype(ov_out.dtype)
+    return jnp.sum(picked * w[:, :, None], axis=0)
+
+
+# ---------------------------------------------------------------------------
+# counters (async debug callbacks: per-execution, per local mesh rank)
+# ---------------------------------------------------------------------------
+
+def _bump_a2a(nbytes: int, inter: bool) -> None:
+    COUNTERS.add("moe.a2a_bytes", nbytes)
+    if inter:
+        COUNTERS.add("moe.a2a_inter", nbytes)
+
+
+def _bump_stats(dropped, used, total_slots: int) -> None:
+    COUNTERS.add("moe.dropped_tokens", int(dropped))
+    # ppm-in-bytes convention: mean utilisation % = bytes / calls / 1e4
+    COUNTERS.add("moe.capacity_frac",
+                 int(round(1e6 * float(used) / max(total_slots, 1))))
+
+
+def record_dispatch_stats(dropped, used, total_slots: int) -> None:
+    """Emit the data-dependent routing stats from inside a traced
+    program (async callback; fires per execution, never per trace)."""
+    jax.debug.callback(
+        functools.partial(_bump_stats, total_slots=int(total_slots)),
+        dropped, used)
+
+
+# ---------------------------------------------------------------------------
+# the explicit expert all-to-all wire
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class A2AHop:
+    axis: str        # mesh axis name
+    dim: int         # which leading buffer dim this hop exchanges
+    world: int
+    wire: str        # fp32 | bf16 | int8 | int4
+    inter: bool      # True = slow-fabric (data_outer) hop
+
+
+@dataclasses.dataclass(frozen=True)
+class A2APlan:
+    """Static description of one MoE layer's expert exchange on this
+    mesh: the hop sequence (fast->slow on dispatch) plus EXACT per-hop
+    wire bytes for one shard-level traversal — the number the
+    `moe.a2a_bytes` counter is pinned against byte-for-byte."""
+    hops: Tuple[A2AHop, ...]
+    ep: int                  # expert-parallel width (product of worlds)
+    local_elems: int         # buffer elements per shard (constant per hop)
+    quant_block: int
+
+    def hop_bytes(self, hop: A2AHop) -> int:
+        if hop.wire in _WIRE_ITEMSIZE:
+            return self.local_elems * _WIRE_ITEMSIZE[hop.wire]
+        chunk = self.local_elems // hop.world
+        return hop.world * payload_bytes(chunk, hop.wire, self.quant_block)
+
+    @property
+    def bytes_per_traversal(self) -> int:
+        """Wire bytes one shard moves in ONE direction (dispatch or
+        combine).  A training dispatch runs 4 traversals (forward
+        dispatch+combine and their mirrored backward); eval runs 2."""
+        return sum(self.hop_bytes(h) for h in self.hops)
+
+    @property
+    def inter_bytes_per_traversal(self) -> int:
+        return sum(self.hop_bytes(h) for h in self.hops if h.inter)
+
+    @property
+    def hops_per_traversal(self) -> int:
+        return len(self.hops)
+
+    def describe(self) -> str:
+        legs = ", ".join(
+            f"{h.axis}[{h.world}]={h.wire}"
+            f"{' (slow)' if h.inter else ''}" for h in self.hops)
+        return (f"moe a2a: ep={self.ep}, {legs}, "
+                f"{self.bytes_per_traversal} B/traversal/shard")
+
+
+def resolve_placement(wcfg: MoEWireConfig, mesh_info: MeshInfo) -> str:
+    """"inner" keeps experts on data_inner (exchange never leaves the
+    fast fabric) whenever the factored mesh is active; flat meshes and
+    placement="data" use the full data group."""
+    if wcfg.placement == "inner":
+        if not mesh_info.hierarchical:
+            return "data"  # no inner axis to pin to; logged by caller
+        return "inner"
+    if wcfg.placement == "data":
+        return "data"
+    return "inner" if mesh_info.hierarchical else "data"
+
+
+def expert_axes(wcfg: MoEWireConfig, mesh_info: MeshInfo
+                ) -> Tuple[str, ...]:
+    """Mesh axis names the expert dim is sharded over under the
+    explicit wire (= the a2a hop axes, outermost first)."""
+    if resolve_placement(wcfg, mesh_info) == "inner":
+        return (DATA_INNER_AXIS,)
+    return mesh_info.data_axes
+
+
+def build_a2a_plan(wcfg: MoEWireConfig, mesh_info: MeshInfo,
+                   num_experts: int, local_rows: int, capacity: int,
+                   d_model: int) -> A2APlan:
+    """The static wire plan for one MoE layer's exchange: `local_rows`
+    is this shard's batch-row count (B / dp), buffer elements are
+    E * local_rows * C * D and stay constant across hops (an a2a
+    permutes, never grows)."""
+    axes = expert_axes(wcfg, mesh_info)
+    local_elems = num_experts * local_rows * capacity * d_model
+    hops = []
+    if len(axes) == 1:
+        wire = (wcfg.wire_inner() if axes[0] == DATA_INNER_AXIS
+                else wcfg.wire_outer() if axes[0] == DATA_OUTER_AXIS
+                else (wcfg.a2a_wire_dtype or "fp32"))
+        hops.append(A2AHop(axis=axes[0], dim=0,
+                           world=mesh_info.axis_size(axes[0]), wire=wire,
+                           inter=axes[0] == DATA_OUTER_AXIS))
+    else:
+        # dispatch runs fast hop first (regroup locally, then one
+        # aggregated slow exchange — the hierarchical a2a decomposition)
+        outer_ax, inner_ax = axes
+        hops.append(A2AHop(axis=inner_ax, dim=1,
+                           world=mesh_info.axis_size(inner_ax),
+                           wire=wcfg.wire_inner(), inter=False))
+        hops.append(A2AHop(axis=outer_ax, dim=0,
+                           world=mesh_info.axis_size(outer_ax),
+                           wire=wcfg.wire_outer(), inter=True))
+    ep = 1
+    for a in axes:
+        ep *= mesh_info.axis_size(a)
+    return A2APlan(hops=tuple(hops), ep=ep, local_elems=local_elems,
+                   quant_block=wcfg.quant_block_size)
+
+
+def _hop_a2a(buf, hop: A2AHop, plan: A2APlan, record: bool):
+    """One all-to-all hop on `buf` (leading dims = hop grid).  The int
+    wires quantize per DESTINATION CHUNK so each received chunk carries
+    its own blockwise fp16 scales, fused with the payload into one
+    uint8 buffer per chunk — 1 collective per hop, like the qgZ wire."""
+    if record:
+        jax.debug.callback(functools.partial(
+            _bump_a2a, nbytes=plan.hop_bytes(hop), inter=hop.inter))
+    if hop.wire == "fp32":
+        return jax.lax.all_to_all(buf.astype(jnp.float32), hop.axis,
+                                  hop.dim, hop.dim,
+                                  tiled=True).astype(buf.dtype)
+    if hop.wire == "bf16":
+        return jax.lax.all_to_all(buf.astype(jnp.bfloat16), hop.axis,
+                                  hop.dim, hop.dim,
+                                  tiled=True).astype(buf.dtype)
+    # int8/int4: moveaxis the hop dim out front, flatten chunks,
+    # quantize+pack per chunk, exchange the fused uint8 buffer,
+    # unpack+dequantize per source chunk
+    shape = buf.shape
+    chunks = jnp.moveaxis(buf, hop.dim, 0).reshape(hop.world, -1)
+    chunk_elems = chunks.shape[1]
+
+    def enc(c):
+        payload, scales = quantize_blockwise(c, plan.quant_block, hop.wire)
+        return pack_wire(payload, scales)
+
+    wire_buf = jax.vmap(enc)(chunks.astype(jnp.float32))
+    wire_buf = jax.lax.all_to_all(wire_buf, hop.axis, 0, 0, tiled=True)
+
+    def dec(c):
+        p, s = unpack_wire(c, hop.wire, plan.quant_block, chunk_elems)
+        return dequantize_blockwise(p, s, hop.wire, chunk_elems)
+
+    out = jax.vmap(dec)(wire_buf).astype(buf.dtype)
+    moved = tuple(shape[hop.dim:hop.dim + 1]
+                  + shape[:hop.dim] + shape[hop.dim + 1:])
+    return jnp.moveaxis(out.reshape(moved), 0, hop.dim)
+
+
+def wire_all_to_all(buf, plan: A2APlan, reverse: bool, record: bool):
+    """The full (possibly two-hop) exchange with a mirrored backward:
+    the custom_vjp routes cotangents through the SAME per-hop wire
+    dtypes in the opposite direction — quantized wires use the qgZ
+    straight-through convention (each hop's quantization error applies
+    once per crossing, never accumulated in the narrow domain), fp32 is
+    the exact transpose.  `buf` leading dims must be the hop grid
+    ([outer, inner, ...] hierarchical, [ep, ...] flat)."""
+    hops = tuple(reversed(plan.hops)) if reverse else plan.hops
+
+    def run(x, hop_seq):
+        for hop in hop_seq:
+            x = _hop_a2a(x, hop, plan, record)
+        return x
+
+    @jax.custom_vjp
+    def xchg(x):
+        return run(x, hops)
+
+    def fwd(x):
+        return run(x, hops), None
+
+    def bwd(_, g):
+        # an a2a hop is involutive on its own dim; reversing the hop
+        # ORDER routes the cotangent back along the same fabric legs
+        return (run(g, tuple(reversed(hops))),)
+
+    xchg.defvjp(fwd, bwd)
+    return xchg(buf)
+
+
+# ---------------------------------------------------------------------------
+# engagement checks
+# ---------------------------------------------------------------------------
+
+_warned: set = set()
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key not in _warned:
+        _warned.add(key)
+        logger.warning(msg)
+
+
+def _inside_manual_region(axes: Sequence[str]) -> bool:
+    """True when a data mesh axis is already bound (we are being traced
+    inside another shard_map, e.g. the bucketed gradient wire's local-
+    grads region, which passes params REPLICATED — local dispatch is
+    then the correct lowering)."""
+    for a in axes:
+        try:
+            jax.lax.axis_index(a)
+            return True
+        except Exception:
+            continue
+    return False
+
+
+def wire_engagement(wcfg: MoEWireConfig, num_experts: int, batch: int
+                    ) -> Optional[Tuple[MeshInfo, Tuple[str, ...]]]:
+    """Decide (at trace time) whether the explicit a2a wire can serve
+    this call: returns (mesh_info, expert axes) or None with the reason
+    logged ONCE — the engine's fallback contract: never silent."""
+    if not wcfg.explicit:
+        return None
+    mesh_info = peek_mesh()
+    if mesh_info is None:
+        _warn_once("no-mesh", "comm.moe a2a wire requested but no mesh "
+                   "is current — falling back to the implicit exchange")
+        return None
+    for ax in (MODEL_AXIS, SEQ_AXIS, PIPE_AXIS):
+        if mesh_info.axis_size(ax) > 1:
+            _warn_once(
+                f"axis-{ax}",
+                f"comm.moe a2a wire requires a pure-DP mesh ({ax} axis "
+                f"is {mesh_info.axis_size(ax)}); legacy-jax full-manual "
+                "shard_map would silently replicate the non-data axes — "
+                "falling back to the implicit exchange")
+            return None
+    axes = expert_axes(wcfg, mesh_info)
+    if wcfg.placement == "inner" and not mesh_info.hierarchical:
+        _warn_once("inner-flat",
+                   "comm.moe.placement='inner' on a flat mesh: no "
+                   "data_inner axis exists — the exchange runs over the "
+                   "full data axis")
+    ep = 1
+    for a in axes:
+        ep *= mesh_info.axis_size(a)
+    dp = mesh_info.axis_size(DATA_AXIS)
+    if dp <= 1 or ep <= 1:
+        # name the REAL degenerate axis: on a hier mesh with inner
+        # placement, ep can be 1 (inner groups of 1) while dp is wide
+        reason = ("data-parallel width is 1" if dp <= 1 else
+                  f"the expert-parallel width over {'/'.join(axes)} "
+                  f"is 1 (dp={dp})")
+        _warn_once(f"ep1-{dp}-{ep}",
+                   f"comm.moe a2a wire: {reason} — nothing to "
+                   "exchange, running the local dispatch")
+        return None
+    if num_experts % ep != 0:
+        _warn_once(
+            f"experts-{num_experts}-{ep}",
+            f"comm.moe a2a wire: num_experts={num_experts} is not "
+            f"divisible by the expert-parallel width {ep} — falling "
+            "back to the implicit exchange")
+        return None
+    if batch % dp != 0:
+        _warn_once(
+            f"batch-{batch}-{dp}",
+            f"comm.moe a2a wire: batch rows {batch} not divisible by "
+            f"the data width {dp} — falling back to the implicit "
+            "exchange")
+        return None
+    if _inside_manual_region(mesh_info.data_axes):
+        _warn_once(
+            "manual-region",
+            "comm.moe a2a wire: already inside a manual collective "
+            "region (the bucketed gradient wire computes with "
+            "replicated experts in-program) — running the local "
+            "dispatch there")
+        return None
+    if wcfg.overlap in ("auto", "on"):
+        level = logger.warning if wcfg.overlap == "on" else logger.info
+        key = f"overlap-{wcfg.overlap}"
+        if key not in _warned:
+            _warned.add(key)
+            level(
+                "comm.moe.overlap: the expert a2a is consumed by the "
+                "very next expert matmul INSIDE the step program — a "
+                "dependent mid-layer collective has no independent "
+                "compute to hide behind, and the PR-9 host exchange "
+                "can only ride BETWEEN dispatched programs; running "
+                "the serial in-program wire (the bench's "
+                "moe.a2a_exposed_ms quantifies what a chunked overlap "
+                "would hide)")
+    return mesh_info, axes

@@ -79,6 +79,30 @@ Instrumented sites:
   bytes/calls); `kv.evictions` — KV blocks FORCIBLY reclaimed from
   shed/errored requests (natural completion frees blocks without
   counting here — a healthy run keeps this at zero).
+* the MoE wire (`moe.*`, moe/dispatch.py sorted dispatch + explicit
+  expert all-to-all; rendered by monitor/report.py as the "MoE wire"
+  section, excluded from the comm byte table).  Recorded per EXECUTION
+  via async `jax.debug.callback` from inside the traced program — one
+  callback per LOCAL mesh rank per event (the 8-device virtual test
+  mesh fires 8 per a2a hop; a real deployment sums its local devices),
+  never bumped by AOT lowering or flops analysis; read after
+  `jax.effects_barrier()` for exact totals:
+  `moe.a2a_bytes` — wire bytes per a2a hop (all local ranks; a
+  training dispatch runs 4 traversals: forward dispatch+combine and
+  the mirrored backward), pinned byte-exact against
+  `dispatch.A2APlan` in tier-1; `moe.a2a_inter` — the subset crossing
+  the slow fabric (`data_outer` hops; ZERO under inner placement —
+  the number the hierarchy-aware placement exists to minimize);
+  `moe.a2a_exposed_ms` — µs-in-bytes (the ckpt.stall_ms convention):
+  a2a wall time on the critical path, measured by the
+  `tools/moe_a2a_bench.py` wire-on/wire-off lanes (the in-program a2a
+  is consumed by the very next expert matmul, so today ALL of it is
+  exposed — this is what a future chunked overlap would hide);
+  `moe.dropped_tokens` — assignments past expert capacity (bytes;
+  calls = dispatches), zero in dropless mode while the overflow
+  bucket holds; `moe.capacity_frac` — ppm-in-bytes occupancy of the
+  [E, C] expert buckets per dispatch (mean utilisation % =
+  bytes / calls / 1e4).
 """
 
 from __future__ import annotations

@@ -226,10 +226,13 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # wire bytes — Resilience rows, like fault.*; serve.*/kv.* carry
     # serving-engine metrics (tokens, µs, block occupancy) and render
     # as the "Serving" section below
+    # moe.* carries MoE-wire metrics (hop bytes, µs, drop counts, ppm
+    # occupancy) and renders as the "MoE wire" section below
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
-                                          "elastic.", "serve.", "kv."))
+                                          "elastic.", "serve.", "kv.",
+                                          "moe."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -561,6 +564,52 @@ def render_markdown(run: Dict[str, Any]) -> str:
         lines.append(f"qwZ prefetch hits: {hits['calls']:,} gather(s) "
                      f"ready before the forward asked "
                      f"({head_ms:,.1f} ms total head start)")
+        lines.append("")
+
+    # MoE wire (moe/dispatch.py): the expert all-to-all's byte/fabric
+    # split, capacity discipline and exposed time — its own section,
+    # like the gradient-wire levels (moe.* is excluded from the comm
+    # byte table above)
+    moe_counters = {k: v for k, v in any_comm.items()
+                    if k.startswith("moe.")}
+    if moe_counters:
+        lines.append("## MoE wire (expert all-to-all)")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        a2a = moe_counters.get("moe.a2a_bytes")
+        if a2a:
+            lines.append(f"| a2a wire bytes (all local ranks) | "
+                         f"{_fmt_bytes(a2a['bytes'])} over "
+                         f"{a2a['calls']:,} hop(s) |")
+        inter = moe_counters.get("moe.a2a_inter")
+        if inter and a2a and a2a["bytes"]:
+            lines.append(f"| slow-fabric (inter-group) share | "
+                         f"{_fmt_bytes(inter['bytes'])} "
+                         f"({100.0 * inter['bytes'] / a2a['bytes']:.1f}%) |")
+        elif a2a:
+            # zero either because inner placement pinned the exchange
+            # to data_inner or because the mesh is flat (one fabric)
+            lines.append("| slow-fabric (inter-group) share | 0 B "
+                         "(no data_outer hop: flat mesh or inner "
+                         "placement) |")
+        exp = moe_counters.get("moe.a2a_exposed_ms")
+        if exp and exp["calls"]:
+            total_ms = exp["bytes"] / 1000.0  # stored as integer µs
+            lines.append(f"| exposed a2a time | {total_ms:,.1f} ms over "
+                         f"{exp['calls']:,} step(s) "
+                         f"({total_ms / exp['calls']:.2f} ms/step) |")
+        drop = moe_counters.get("moe.dropped_tokens")
+        if drop:
+            lines.append(f"| tokens dropped at capacity | "
+                         f"{drop['bytes']:,} over {drop['calls']:,} "
+                         f"dispatch(es) |")
+        frac = moe_counters.get("moe.capacity_frac")
+        if frac and frac["calls"]:
+            # ppm-in-bytes: mean utilisation % = bytes / calls / 1e4
+            lines.append(f"| mean expert-bucket utilisation | "
+                         f"{frac['bytes'] / frac['calls'] / 1e4:.1f}% "
+                         f"(sampled at {frac['calls']:,} dispatches) |")
         lines.append("")
 
     qwz = any_comm.get("qwz.gather")

@@ -250,6 +250,14 @@ class DeepSpeedCommConfig(DeepSpeedConfigObject):
             self.quant_block_size = validate_block_size(block)
         except ValueError as e:
             raise ValueError(f"comm.{c.COMM_QUANT_BLOCK_SIZE}: {e}")
+        # MoE token movement: sorted dispatch + the explicit expert
+        # all-to-all wire (moe/dispatch.py).  Parsed eagerly so a bad
+        # sub-key fails at config time; the engine installs the result
+        # process-globally at initialize().
+        from ..moe.dispatch import parse_moe_config
+
+        self.moe = parse_moe_config(d.get(c.COMM_MOE),
+                                    default_block=self.quant_block_size)
 
 
 class DeepSpeedDataPipelineConfig(DeepSpeedConfigObject):

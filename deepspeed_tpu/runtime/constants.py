@@ -144,6 +144,28 @@ COMM_OVERLAP_KEEPALIVE_MS = "overlap_keepalive_ms"
 COMM_OVERLAP_KEEPALIVE_MS_DEFAULT = 5_000
 FP32_ALLREDUCE = "fp32_allreduce"
 FP32_ALLREDUCE_DEFAULT = False
+# MoE token movement (moe/dispatch.py; validated by parse_moe_config —
+# every key is rejected at config time naming the key + valid set):
+#   "moe": {
+#     "dispatch": "dense" | "sorted",   # default dense (the seed path);
+#                                       # defaults to sorted when an a2a
+#                                       # wire dtype is requested
+#     "a2a_wire_dtype": null | "fp32" | "bf16" | "int8" | "int4",
+#                      # null = exchange left implicit to XLA; a dtype
+#                      # selects the EXPLICIT shard_map all-to-all wire
+#     "a2a_wire_dtype_inner": ...,      # per-level overrides on a
+#     "a2a_wire_dtype_outer": ...,      # factored (hierarchical) mesh
+#     "placement": "auto" | "data" | "inner",
+#                      # "inner" pins experts to data_inner (replicated
+#                      # across outer groups): the exchange never leaves
+#                      # the fast fabric.  "auto" = inner when factored.
+#     "dropless": false,                # second-pass overflow bucket
+#     "overflow_factor": 0.25,          # bucket = ceil(f * k * tokens)
+#     "quant_block_size": <even int>,   # default: comm.quant_block_size
+#     "overlap": "none" | "auto" | "on",  # accepted; falls back LOGGED
+#     "counters": true                  # moe.* callback counters
+#   }
+COMM_MOE = "moe"
 
 #############################################
 # Async input pipeline (TPU-specific addition; see runtime/dataloader.py

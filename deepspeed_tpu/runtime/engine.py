@@ -173,6 +173,16 @@ class DeepSpeedEngine:
         self.dp_world_size = self.mesh_info.get_data_parallel_world_size()
         self.mp_world_size = self.mesh_info.get_model_parallel_world_size()
 
+        # MoE token movement: install the validated comm.moe selection
+        # process-globally BEFORE params are placed (the sharding plan's
+        # expert-spec translation and the layer's dispatch engine both
+        # read it) — moe/dispatch.py
+        from ..moe import dispatch as _moe_dispatch
+
+        _moe_dispatch.set_wire_config(self._config.comm_config.moe)
+        if self._config.comm_config.moe != _moe_dispatch.MoEWireConfig():
+            log_dist(self._config.comm_config.moe.describe(), ranks=[0])
+
         self.compute_dtype = DTYPES[self._config.precision]
         self.loss_scaler = create_loss_scaler(self._config)
 
@@ -559,12 +569,21 @@ class DeepSpeedEngine:
                 # validator instead of contradicting it
                 check_hierarchy_divides(hierarchy, dp)
         blockers = []
+        # TWO consumers ride the factored axis: the bucketed gradient
+        # wire and the explicit MoE expert a2a (comm.moe — inner
+        # placement keeps the expert exchange on data_inner, and the
+        # two-hop lowering compresses the outer hop independently)
+        moe_dict = comm_dict.get(const.COMM_MOE) or {}
+        moe_wire_requested = isinstance(moe_dict, dict) and any(
+            moe_dict.get(k) is not None
+            for k in ("a2a_wire_dtype", "a2a_wire_dtype_inner",
+                      "a2a_wire_dtype_outer"))
         if str(comm_dict.get(const.COMM_GRADIENT_REDUCTION,
                              const.COMM_GRADIENT_REDUCTION_DEFAULT)
-               ).lower() != "bucketed":
+               ).lower() != "bucketed" and not moe_wire_requested:
             blockers.append("comm.gradient_reduction is not 'bucketed' "
-                            "(only the bucketed wire rides the factored "
-                            "axis)")
+                            "and no comm.moe a2a wire is requested "
+                            "(only those wires ride the factored axis)")
         for ax in (_MA, _PA, _SA):
             if sizes[ax] > 1:
                 blockers.append(f"{ax} axis > 1 (hierarchy needs a "

@@ -170,13 +170,31 @@ class ZeroShardingPlan:
         """One spec entry (axis name or tuple): on a hierarchical mesh
         the logical "data" name is not a mesh axis — a model-supplied
         spec using it (e.g. expert-parallel MoE params) expands to the
-        ("data_outer", "data_inner") pair, same total size."""
+        ("data_outer", "data_inner") pair, same total size.  Under the
+        explicit MoE a2a wire with INNER placement (comm.moe —
+        moe/dispatch.resolve_placement) the translation narrows to
+        `data_inner` only: experts replicate across outer groups so the
+        expert exchange never leaves the fast fabric (their gradients
+        pick up the outer psum from the replication, like any
+        replicated parameter)."""
         if not self.mesh_info.hierarchical or d is None:
             return d
+        target = (DATA_OUTER_AXIS, DATA_INNER_AXIS)
+        # NOTE: this narrowing keys off the process-global MoE wire
+        # config and applies to EVERY model-supplied DATA_AXIS param
+        # spec.  Today only expert-parallel MoE params use one (the
+        # engine's own data sharding never routes through model specs);
+        # a future non-expert data-sharded param would need a scoped
+        # marker here rather than inheriting the MoE placement.
+        from ...moe import dispatch as _moe_dispatch
+
+        wcfg = _moe_dispatch.get_wire_config()
+        if wcfg.explicit and _moe_dispatch.resolve_placement(
+                wcfg, self.mesh_info) == "inner":
+            target = (DATA_INNER_AXIS,)
         out = []
         for a in (d if isinstance(d, tuple) else (d,)):
-            out.extend((DATA_OUTER_AXIS, DATA_INNER_AXIS)
-                       if a == DATA_AXIS else (a,))
+            out.extend(target if a == DATA_AXIS else (a,))
         return tuple(out) if len(out) > 1 else out[0]
 
     def _sanitize(self, spec: Optional[PartitionSpec], shape):

@@ -48,3 +48,9 @@ def _reset_mesh():
     from deepspeed_tpu.comm import mesh as mesh_mod
 
     mesh_mod._CURRENT_MESH = None
+    # engines install the comm.moe wire selection process-globally
+    # (moe/dispatch.py) — restore the seed default so a MoE engine test
+    # can't leak its dispatch engine into a later direct-layer test
+    from deepspeed_tpu.moe import dispatch as moe_dispatch
+
+    moe_dispatch.set_wire_config(moe_dispatch.MoEWireConfig())

@@ -1,0 +1,442 @@
+"""MoE dispatch + expert-a2a wire bench: dense one-hot vs the fused
+sort-based dispatch, and the explicit quantized all-to-all wire.
+
+Measures the MoE training step through every token-movement mode the
+engine offers (moe/dispatch.py, the `"comm": {"moe": ...}` block):
+
+  dense          the seed GShard path: one-hot [B,S,E,C] dispatch/
+                 combine tensors + O(N·E·C·D) einsums, exchange left
+                 implicit to XLA
+  sorted         fused sort-based dispatch (argsort by expert id,
+                 capacity-bucketed gather/scatter permutes), exchange
+                 still implicit
+  a2a_fp32/bf16/int8/int4
+                 sorted dispatch over the EXPLICIT shard_map all-to-all
+                 wire at each wire dtype (int wires ride the PR-7
+                 blockwise kernels, payload+scales fused into one uint8
+                 buffer per chunk)
+
+Two fabrics, following tools/grad_wire_bench.py:
+
+  --nproc 1  (default) single-process CPU mesh — collectives are memory
+             movement; shows the dispatch-machinery floor.
+  --nproc N  N jax.distributed processes on localhost (gloo/TCP): every
+             cross-process payload pays a real byte-proportional cost —
+             the fabric where the quantized wire's byte win becomes a
+             time win.
+
+--hierarchy adds the factored-mesh lanes (comm.hierarchy + comm.moe):
+
+  hier_inner_bf16   placement "auto" -> experts pinned to data_inner
+                    (replicated across outer groups): the whole
+                    exchange stays on the fast fabric, moe.a2a_inter
+                    pinned at ZERO
+  hier_twohop_int8  placement "data": the global a2a decomposes into an
+                    inner hop (exact fp32) + an outer hop on blockwise
+                    int8 — the slow fabric carries 1/4 the bytes
+
+Every wire lane reports the measured `moe.a2a_bytes`/`moe.a2a_inter`
+counter deltas beside the static A2APlan prediction (byte-exact — the
+same accounting tier-1 pins), plus `a2a_exposed_ms` = the wire lane's
+step time over the local sorted lane (the in-program a2a is consumed by
+the next expert matmul, so ALL of it is exposed today — the number a
+future chunked overlap would shrink), which is also recorded into the
+`moe.a2a_exposed_ms` counter (µs-in-bytes).
+
+Results are recorded through monitor/artifacts.py into
+bench_artifacts/runs/ + manifest (the PR-2 durable-artifact rule).
+
+Usage: python tools/moe_a2a_bench.py [--nproc 2] [--steps 20]
+           [--seq 64] [--experts 8] [--hierarchy]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Optional
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, ".."))
+
+QUANT_BLOCK = 64  # small enough that tiny CPU-lane chunks aren't
+#                   pad-dominated; real deployments keep the 256 default
+
+
+def variants(hierarchy: bool, outer: int):
+    """(name, comm-config) lanes; comm=None is the seed dense path."""
+    lanes = [
+        ("dense", None),
+        ("sorted", {"moe": {"dispatch": "sorted"}}),
+    ]
+    for wire in ("fp32", "bf16", "int8", "int4"):
+        lanes.append((f"a2a_{wire}", {"moe": {
+            "a2a_wire_dtype": wire, "quant_block_size": QUANT_BLOCK}}))
+    if hierarchy:
+        lanes.append(("hier_inner_bf16", {
+            "hierarchy": {"outer": outer},
+            "moe": {"a2a_wire_dtype": "bf16",
+                    "quant_block_size": QUANT_BLOCK}}))
+        lanes.append(("hier_twohop_int8", {
+            "hierarchy": {"outer": outer},
+            "moe": {"a2a_wire_dtype_inner": "fp32",
+                    "a2a_wire_dtype_outer": "int8",
+                    "placement": "data",
+                    "quant_block_size": QUANT_BLOCK}}))
+    return lanes
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def measure_variants(lanes, steps: int, seq: int, experts: int,
+                     layers: int = 2, warmup: int = 3):
+    """Run each lane through the engine; returns {name: entry}.  Shared
+    by the TCP/CPU bench paths and the tier-1 dry-run."""
+    import jax
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT, gpt2_config
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.moe import dispatch as moe_dispatch
+
+    dp = jax.device_count()
+    n_shards = jax.local_device_count()
+    model_cfg = gpt2_config(
+        "nano", num_layers=layers, d_model=64, num_heads=4,
+        num_experts=experts, moe_top_k=2, moe_layer_freq=1,
+        vocab_size=128, max_seq_len=seq, dropout=0.0, embed_dropout=0.0)
+    rng = np.random.RandomState(0)  # identical stream on every process
+    tok = rng.randint(0, 128, (dp, seq + 1)).astype(np.int32)
+    batch = (tok[:, :-1], tok[:, 1:])
+
+    results = {}
+    for name, comm in lanes:
+        cfg = {
+            "train_batch_size": dp,
+            "mesh": {"data": dp},
+            "steps_per_print": 0,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 1e-4, "weight_decay": 0.0}},
+        }
+        if comm is not None:
+            cfg["comm"] = comm
+        engine, *_ = deepspeed_tpu.initialize(
+            model=GPT(model_cfg), dist_init_required=False,
+            config_params=cfg)
+        wcfg = moe_dispatch.get_wire_config()
+        for _ in range(warmup):
+            engine.forward(batch)
+            engine.backward()
+            engine.step()
+        jax.effects_barrier()
+        snap = COUNTERS.snapshot()
+        t = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            loss = engine.forward(batch)
+            engine.backward()
+            engine.step()
+            loss.block_until_ready()
+            t.append(time.perf_counter() - t0)
+        jax.effects_barrier()
+        deltas = COUNTERS.delta_since(snap)
+        entry = {"step_ms": round(float(np.median(t)) * 1e3, 2),
+                 "loss": round(float(loss), 5),
+                 "dispatch": wcfg.dispatch}
+        moe_deltas = {k: v for k, v in deltas.items()
+                      if k.startswith("moe.")}
+        if wcfg.explicit:
+            # the wire engaged iff a2a bytes moved — assert, never infer
+            counted = moe_deltas.get("moe.a2a_bytes", {}).get("bytes", 0)
+            assert counted > 0, f"{name}: explicit a2a wire did not engage"
+            cap = _moe_capacity(model_cfg, seq)
+            plan = moe_dispatch.build_a2a_plan(
+                wcfg, engine.mesh_info, experts, 1, cap, 64)
+            # 4 traversals/step (fwd dispatch+combine + mirrored bwd)
+            # x local shards x MoE layers
+            expected = (plan.bytes_per_traversal * 4 * n_shards
+                        * layers * steps)
+            expected_inter = (plan.inter_bytes_per_traversal * 4
+                              * n_shards * layers * steps)
+            entry.update({
+                "wire": f"{plan.hops[0].wire}" if len(plan.hops) == 1
+                        else "/".join(h.wire for h in plan.hops),
+                "ep": plan.ep,
+                "placement": moe_dispatch.resolve_placement(
+                    wcfg, engine.mesh_info),
+                "a2a_bytes_per_step": expected // steps,
+                "counted_a2a_bytes": counted,
+                "plan_a2a_bytes": expected,
+                "counted_inter_bytes":
+                    moe_deltas.get("moe.a2a_inter", {}).get("bytes", 0),
+                "plan_inter_bytes": expected_inter,
+            })
+            assert counted == expected, \
+                (name, counted, expected, "counter drifted from the plan")
+            assert entry["counted_inter_bytes"] == expected_inter, \
+                (name, entry["counted_inter_bytes"], expected_inter)
+        if moe_deltas.get("moe.dropped_tokens"):
+            d = moe_deltas["moe.dropped_tokens"]
+            entry["dropped_tokens"] = d["bytes"]
+        fr = moe_deltas.get("moe.capacity_frac")
+        if fr and fr["calls"]:
+            entry["capacity_util_pct"] = round(
+                fr["bytes"] / fr["calls"] / 1e4, 1)
+        engine.close_overlap()
+        results[name] = entry
+
+    # exposed a2a time: the wire lane's cost over the local sorted lane
+    # (same dispatch engine, no exchange) — recorded as the counter too
+    base = results.get("sorted")
+    for name, entry in results.items():
+        if "counted_a2a_bytes" in entry and base is not None:
+            exposed = max(0.0, entry["step_ms"] - base["step_ms"])
+            entry["a2a_exposed_ms_per_step"] = round(exposed, 2)
+            COUNTERS.add("moe.a2a_exposed_ms",
+                         int(exposed * 1000) * steps, calls=steps)
+    return results
+
+
+def _moe_capacity(model_cfg, seq: int) -> int:
+    from deepspeed_tpu.moe import MoE
+
+    return MoE(model_cfg.moe_config()).capacity(seq, train=True)
+
+
+def measure_layer(steps: int, seq: int, experts: int, batch: int = 8,
+                  d_model: int = 64, warmup: int = 3):
+    """The dispatch engines HEAD TO HEAD, single-device jit: one MoE
+    layer's forward+backward with everything else (attention, loss,
+    mesh resharding) out of the frame — the O(N·E·C·D) one-hot einsums
+    vs the O(N log N + k·N·D) permutes, nothing else."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.moe import MoE, MoEConfig
+    from deepspeed_tpu.moe import dispatch as moe_dispatch
+
+    cfg = MoEConfig(d_model=d_model, d_ff=4 * d_model,
+                    num_experts=experts, top_k=2, capacity_factor=1.25,
+                    noisy_gate_std=0.0)
+    moe = MoE(cfg)
+    params = moe.init(jax.random.PRNGKey(0))
+    x = jax.random.normal(jax.random.PRNGKey(1), (batch, seq, d_model))
+    out = {}
+    for mode in ("dense", "sorted"):
+        def f(p, xv):
+            with moe_dispatch.moe_wire(dispatch=mode, counters=False):
+                y, a = moe(p, xv, train=True)
+            return jnp.sum(y ** 2) + a
+
+        fn = jax.jit(jax.grad(f, argnums=(0, 1)))
+        jax.block_until_ready(fn(params, x))
+        t = []
+        for _ in range(max(steps, warmup)):
+            t0 = _time.perf_counter()
+            jax.block_until_ready(fn(params, x))
+            t.append(_time.perf_counter() - t0)
+        out[f"layer_{mode}_ms"] = round(
+            float(np.median(t[warmup - 1:])) * 1e3, 2)
+    out["layer_sorted_vs_dense"] = round(
+        out["layer_dense_ms"] / max(out["layer_sorted_ms"], 1e-9), 2)
+    return out
+
+
+def bench(args, nproc: int, proc_id: int):
+    lanes = variants(args.hierarchy, nproc if nproc > 1 else 2)
+    results = measure_variants(lanes, args.steps, args.seq, args.experts)
+    layer = (measure_layer(args.steps, args.seq, args.experts)
+             if proc_id == 0 else {})
+
+    if proc_id == 0:
+        import jax
+
+        base = results["dense"]["step_ms"]
+        for name in results:
+            results[name]["vs_dense"] = round(
+                base / max(results[name]["step_ms"], 1e-9), 2)
+        bf16 = results.get("a2a_bf16", {}).get("a2a_bytes_per_step")
+        int8 = results.get("a2a_int8", {}).get("a2a_bytes_per_step")
+        if bf16 and int8:
+            results["a2a_int8"]["bytes_vs_bf16"] = round(bf16 / int8, 2)
+        print(json.dumps({
+            "metric": ("moe_a2a_2proc_tcp" if nproc > 1
+                       else "moe_a2a_cpu_mesh")
+                      + ("_hier" if args.hierarchy else ""),
+            "platform": "cpu",
+            "world": {"processes": nproc, "devices": jax.device_count()},
+            "steps": args.steps, "seq": args.seq,
+            "experts": args.experts,
+            "value": layer["layer_sorted_vs_dense"],
+            "unit": "x_layer_sorted_vs_dense_onehot",
+            **layer,
+            **results,
+        }), flush=True)
+
+
+def worker(args):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(coordinator_address=args.coord,
+                               num_processes=args.nproc,
+                               process_id=args.proc_id)
+    import deepspeed_tpu  # noqa: F401  (installs the gloo-collectives
+    #                       flag BEFORE the CPU client exists)
+
+    bench(args, args.nproc, args.proc_id)
+
+
+def single_process(args):
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    bench(args, 1, 0)
+
+
+def run_dry(artifact_root: Optional[str] = None, steps: int = 2,
+            seq: int = 32, experts: int = 8):
+    """Tier-1 CPU dry-run (the grad_wire_bench.run_dry pattern): runs
+    the sorted-dispatch and quantized-a2a lanes in-process on the
+    suite's virtual mesh so they can never silently rot — byte-exact
+    counter-vs-plan pins, the bf16-vs-int8 compression ratio, and
+    dense-vs-sorted loss parity all asserted.  Returns the recorded
+    result dict."""
+    lanes = [v for v in variants(hierarchy=True, outer=2)
+             if v[0] in ("dense", "sorted", "a2a_bf16", "a2a_int8",
+                         "hier_inner_bf16", "hier_twohop_int8")]
+    results = measure_variants(lanes, steps, seq, experts, warmup=1)
+    results.update(measure_layer(steps, seq, experts, warmup=1))
+
+    # routing is shared, movement is a permutation: the engines must
+    # agree on the loss to fp tolerance (sorted is typically bitwise —
+    # see tests — but the bench only needs the parity envelope)
+    assert abs(results["dense"]["loss"] - results["sorted"]["loss"]) \
+        < 1e-4, (results["dense"]["loss"], results["sorted"]["loss"])
+    # the quantized wire's raison d'etre: int8 a2a bytes ~2x under bf16
+    ratio = (results["a2a_bf16"]["a2a_bytes_per_step"]
+             / results["a2a_int8"]["a2a_bytes_per_step"])
+    assert ratio >= 1.8, f"int8 wire only {ratio:.2f}x under bf16"
+    # inner placement pins the exchange to the fast fabric
+    assert results["hier_inner_bf16"]["counted_inter_bytes"] == 0, \
+        results["hier_inner_bf16"]
+    assert results["hier_twohop_int8"]["counted_inter_bytes"] > 0, \
+        results["hier_twohop_int8"]
+
+    import jax
+
+    from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+    result = {
+        "metric": "moe_a2a_cpu_mesh_dryrun",
+        "platform": "cpu",
+        "world": {"processes": 1, "devices": jax.device_count()},
+        "steps": steps, "seq": seq, "experts": experts,
+        "value": round(ratio, 2),
+        "unit": "int8_bytes_vs_bf16",
+        **results,
+    }
+    result["artifact"] = record_bench_result(result, root=artifact_root)
+    return result
+
+
+def _record(out: str):
+    """Durable artifact under bench_artifacts/runs/ (PR-2 rule)."""
+    try:
+        line = next(ln for ln in out.splitlines()
+                    if ln.startswith("{") and "metric" in ln)
+        result = json.loads(line)
+        from deepspeed_tpu.monitor.artifacts import record_bench_result
+
+        path = record_bench_result(result)
+        print(f"recorded: {path}", file=sys.stderr)
+    except Exception as e:  # bench output stays usable without the record
+        print(f"artifact recording failed: {e}", file=sys.stderr)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nproc", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--experts", type=int, default=16)
+    ap.add_argument("--hierarchy", action="store_true",
+                    help="add the factored-mesh lanes (inner placement "
+                         "+ the two-hop quantized outer a2a)")
+    ap.add_argument("--dry-run", dest="dry_run", action="store_true",
+                    help="the tier-1 in-process smoke (2 steps, "
+                         "asserts, artifact)")
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--proc-id", dest="proc_id", type=int, default=0)
+    ap.add_argument("--coord", default="")
+    ap.add_argument("--no-record", dest="no_record", action="store_true",
+                    help="skip the durable bench_artifacts/ record (the "
+                         "slow-lane pytest wrapper sets this so CI runs "
+                         "never pollute the committed artifact ledger)")
+    args = ap.parse_args()
+    if args.worker:
+        worker(args)
+        return
+    if args.dry_run:
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(run_dry(), indent=2))
+        return
+    if args.nproc <= 1:
+        import contextlib
+        import io
+
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            single_process(args)
+        out = buf.getvalue()
+        sys.stdout.write(out)
+        if not args.no_record:
+            _record(out)
+        return
+    coord = f"127.0.0.1:{_free_port()}"
+    procs = []
+    for pid in range(args.nproc):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--worker",
+             "--proc-id", str(pid), "--coord", coord,
+             "--nproc", str(args.nproc), "--steps", str(args.steps),
+             "--seq", str(args.seq), "--experts", str(args.experts)]
+            + (["--hierarchy"] if args.hierarchy else []),
+            stdout=subprocess.PIPE if pid == 0 else subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if pid == 0 else subprocess.DEVNULL,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}))
+    out, _ = procs[0].communicate(timeout=3600)
+    for p in procs[1:]:
+        p.wait(timeout=60)
+    out = out.decode()
+    sys.stdout.write(out)
+    if any(p.returncode for p in procs):
+        sys.exit(1)
+    if not args.no_record:
+        _record(out)
+
+
+if __name__ == "__main__":
+    main()

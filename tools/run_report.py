@@ -84,6 +84,15 @@ def selftest() -> int:
             COUNTERS.add("serve.shed", calls=1)
             COUNTERS.add("kv.blocks_in_use", 10, calls=4)
             COUNTERS.add("kv.evictions", calls=3)
+            # MoE wire (moe/dispatch.py): a2a hop bytes + the
+            # slow-fabric subset, exposed µs (ckpt.stall_ms
+            # convention), capacity drops and ppm-in-bytes bucket
+            # occupancy — the "MoE wire" section, never comm byte rows
+            COUNTERS.add("moe.a2a_bytes", 65536, calls=4)
+            COUNTERS.add("moe.a2a_inter", 16384, calls=2)
+            COUNTERS.add("moe.a2a_exposed_ms", 1200, calls=1)
+            COUNTERS.add("moe.dropped_tokens", 5, calls=2)
+            COUNTERS.add("moe.capacity_frac", 750_000, calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -173,7 +182,11 @@ def selftest() -> int:
                        "KV blocks force-reclaimed",
                        "requests shed (wedged decode)",
                        "Serving bench (continuous batching)",
-                       "continuous vs static batching: 1.50x"):
+                       "continuous vs static batching: 1.50x",
+                       "MoE wire (expert all-to-all)",
+                       "a2a wire bytes", "slow-fabric (inter-group) share",
+                       "exposed a2a time", "tokens dropped at capacity",
+                       "mean expert-bucket utilisation | 75.0%"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
@@ -192,6 +205,9 @@ def selftest() -> int:
         assert "`serve.tokens`" not in md and \
             "`kv.blocks_in_use`" not in md, \
             "serve.*/kv.* rows must not leak into the comm table"
+        assert "`moe.a2a_bytes`" not in md and \
+            "`moe.capacity_frac`" not in md, \
+            "moe.* rows must not leak into the comm table"
         # serving.json alone must render without event streams (the
         # serve-bench run-dir shape)
         import shutil as _shutil
