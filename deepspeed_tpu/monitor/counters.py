@@ -103,6 +103,19 @@ Instrumented sites:
   bucket holds; `moe.capacity_frac` — ppm-in-bytes occupancy of the
   [E, C] expert buckets per dispatch (mean utilisation % =
   bytes / calls / 1e4).
+* the self-tuning runtime (`autotune.*`, runtime/autotune/; rendered
+  by monitor/report.py as the "Autotune" section beside the
+  `autotune.jsonl` ledger, excluded from the comm byte table):
+  `autotune.probes` — candidate probes run (bytes = probe wall time in
+  integer MICROSECONDS, the ckpt.stall_ms convention; probe dispatches
+  go through the raw `.fn` programs so they never bump the
+  `grad_wire.*` per-dispatch counters); `autotune.cache_hits` — winner
+  cache hits (a hit applies with ZERO probes); `autotune.rejected` —
+  candidate compositions pruned by the config validators before any
+  probe; `autotune.retunes` — online retunes triggered by sustained
+  regression (step-time or exposed-wire creep); `autotune.swaps` —
+  live config swaps applied through the StepBuilder rebuild (search
+  winners, cached winners and online retune winners all count here).
 """
 
 from __future__ import annotations

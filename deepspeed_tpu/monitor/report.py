@@ -86,18 +86,7 @@ def load_run(run_dir: str) -> Dict[str, Any]:
                 f"events.rank*.jsonl to fall back on")
         raise FileNotFoundError(
             f"no events.rank*.jsonl under {run_dir!r}")
-    restarts: List[Dict[str, Any]] = []
-    rpath = os.path.join(run_dir, "restarts.jsonl")
-    if os.path.exists(rpath):
-        with open(rpath) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    restarts.append(json.loads(line))
-                except json.JSONDecodeError:
-                    continue  # torn tail of a live ledger
+    restarts = _read_jsonl_ledger(os.path.join(run_dir, "restarts.jsonl"))
     watchdog_trip = None
     wpath = os.path.join(run_dir, "watchdog_trip.json")
     if os.path.exists(wpath):
@@ -106,9 +95,32 @@ def load_run(run_dir: str) -> Dict[str, Any]:
                 watchdog_trip = json.load(f)
         except (OSError, json.JSONDecodeError):
             watchdog_trip = None
+    # the autotune ledger (runtime/autotune/runtime.py, rank 0):
+    # search/cache_hit/retune/swap events, rendered as the "Autotune"
+    # section's event table
+    autotune = _read_jsonl_ledger(os.path.join(run_dir, "autotune.jsonl"))
     return {"dir": run_dir, "manifest": manifest, "ranks": ranks,
             "restarts": restarts, "watchdog_trip": watchdog_trip,
-            "serving": serving}
+            "serving": serving, "autotune": autotune}
+
+
+def _read_jsonl_ledger(path: str) -> List[Dict[str, Any]]:
+    """Best-effort append-only ledger reader (restarts.jsonl,
+    autotune.jsonl): blank lines and the torn tail of a live writer are
+    skipped, a missing file is an empty ledger."""
+    rows: List[Dict[str, Any]] = []
+    if not os.path.exists(path):
+        return rows
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail of a live ledger
+    return rows
 
 
 def _mean(xs):
@@ -228,11 +240,14 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # as the "Serving" section below
     # moe.* carries MoE-wire metrics (hop bytes, µs, drop counts, ppm
     # occupancy) and renders as the "MoE wire" section below
+    # autotune.* carries search/retune bookkeeping (probe µs in the
+    # bytes slot, swap/rejection counts) and renders as the "Autotune"
+    # section below
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
                                           "elastic.", "serve.", "kv.",
-                                          "moe."))
+                                          "moe.", "autotune."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -611,6 +626,68 @@ def render_markdown(run: Dict[str, Any]) -> str:
                          f"{frac['bytes'] / frac['calls'] / 1e4:.1f}% "
                          f"(sampled at {frac['calls']:,} dispatches) |")
         lines.append("")
+
+    # the self-tuning runtime (runtime/autotune/): probe/swap counters
+    # + the rank-0 search/retune ledger — its own section, excluded
+    # from the comm byte table like the other bookkeeping counters
+    at_counters = {k: v for k, v in any_comm.items()
+                   if k.startswith("autotune.")}
+    at_ledger = run.get("autotune") or []
+    if at_counters or at_ledger:
+        lines.append("## Autotune")
+        lines.append("")
+        if at_counters:
+            lines.append("| metric | value |")
+            lines.append("|---|---|")
+            probes = at_counters.get("autotune.probes")
+            if probes:
+                total_ms = probes["bytes"] / 1000.0  # µs in the bytes slot
+                lines.append(f"| candidate probes | {probes['calls']:,} "
+                             f"({total_ms:,.1f} ms probing) |")
+            hits = at_counters.get("autotune.cache_hits")
+            if hits:
+                lines.append(f"| winner-cache hits (zero probes) | "
+                             f"{hits['calls']:,} |")
+            rej = at_counters.get("autotune.rejected")
+            if rej:
+                lines.append(f"| candidates pruned by config validators | "
+                             f"{rej['calls']:,} |")
+            ret = at_counters.get("autotune.retunes")
+            if ret:
+                lines.append(f"| online retunes (sustained regression) | "
+                             f"{ret['calls']:,} |")
+            swaps = at_counters.get("autotune.swaps")
+            if swaps:
+                lines.append(f"| live config swaps applied | "
+                             f"{swaps['calls']:,} |")
+            lines.append("")
+        events = [e for e in at_ledger
+                  if e.get("event") in ("search", "cache_hit", "retune",
+                                        "swap")]
+        if events:
+            lines.append("| # | event | step | detail |")
+            lines.append("|---|---|---|---|")
+            for i, e in enumerate(events):
+                ev = e.get("event")
+                if ev == "swap":
+                    detail = (f"-> `{e.get('candidate', '?')}` "
+                              f"({e.get('reason', '?')})")
+                elif ev == "retune":
+                    detail = (f"{e.get('reason', '?')}; "
+                              f"{e.get('probes', 0)} probe(s), "
+                              + ("swapped to "
+                                 f"`{e.get('winner', '?')}`"
+                                 if e.get("swapped")
+                                 else "incumbent stands"))
+                elif ev == "cache_hit":
+                    detail = (f"`{e.get('candidate', '?')}` (fingerprint "
+                              f"{e.get('fingerprint', '?')})")
+                else:
+                    detail = (f"{e.get('probes', 0)} probe(s), baseline "
+                              f"{_fmt(e.get('baseline_ms'))} ms/step")
+                lines.append(f"| {i + 1} | {ev} | {e.get('step', '—')} | "
+                             f"{detail} |")
+            lines.append("")
 
     qwz = any_comm.get("qwz.gather")
     if qwz:

@@ -1,0 +1,90 @@
+"""Sustained-regression detection for the online retune loop.
+
+The detector watches two signals per optimizer step:
+
+* wall ms/step (measured boundary-to-boundary by the runtime)
+* exposed-wire µs/step creep (`grad_wire.exposed_ms` deltas — the
+  overlap wire's non-hidden remainder; a healthy overlapped run keeps
+  this near zero, so creep here flags a degrading exchange before the
+  step time alone would)
+
+A baseline is the median of the first `baseline_steps` observations
+after (re)arming.  A regression is SUSTAINED when `window` consecutive
+observations exceed `threshold` x baseline (or the exposed signal
+exceeds `exposed_threshold_ms` for the window) — a single slow step
+(GC pause, checkpoint, compile) never triggers.  After a retune the
+caller `reset()`s: the detector re-baselines under the new config and
+holds off for `cooldown_steps` so one fault burst cannot chain
+retunes."""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional
+
+
+class RegressionDetector:
+    def __init__(self, window: int = 5, baseline_steps: int = 5,
+                 threshold: float = 1.5,
+                 exposed_threshold_ms: float = 0.0,
+                 cooldown_steps: int = 20):
+        if window < 1 or baseline_steps < 1:
+            raise ValueError("window and baseline_steps must be >= 1, got "
+                             f"{window}/{baseline_steps}")
+        if threshold <= 1.0:
+            raise ValueError(
+                f"threshold must be > 1.0 (a ratio over baseline), got "
+                f"{threshold}")
+        self.window = int(window)
+        self.baseline_steps = int(baseline_steps)
+        self.threshold = float(threshold)
+        self.exposed_threshold_ms = float(exposed_threshold_ms)
+        self.cooldown_steps = int(cooldown_steps)
+        self.reset(cooldown=False)
+
+    def reset(self, cooldown: bool = True) -> None:
+        """Re-arm: forget the baseline (the config just changed), and
+        optionally hold off `cooldown_steps` before observing again."""
+        self._baseline: Optional[float] = None
+        self._base_buf: deque = deque(maxlen=self.baseline_steps)
+        self._hot_ms = 0       # consecutive step-time breaches
+        self._hot_exposed = 0  # consecutive exposed-creep breaches
+        self._cooldown = self.cooldown_steps if cooldown else 0
+
+    @property
+    def baseline_ms(self) -> Optional[float]:
+        return self._baseline
+
+    def observe(self, step_ms: float, exposed_ms: float = 0.0) -> bool:
+        """Feed one step's signals; True = sustained regression (the
+        caller should retune and reset())."""
+        if self._cooldown > 0:
+            self._cooldown -= 1
+            return False
+        if self._baseline is None:
+            self._base_buf.append(float(step_ms))
+            if len(self._base_buf) == self.baseline_steps:
+                ordered = sorted(self._base_buf)
+                self._baseline = ordered[len(ordered) // 2]
+            return False
+        if step_ms > self.threshold * self._baseline:
+            self._hot_ms += 1
+        else:
+            self._hot_ms = 0
+        if self.exposed_threshold_ms > 0.0 and \
+                exposed_ms > self.exposed_threshold_ms:
+            self._hot_exposed += 1
+        else:
+            self._hot_exposed = 0
+        return (self._hot_ms >= self.window
+                or self._hot_exposed >= self.window)
+
+    def describe_trigger(self, step_ms: float, exposed_ms: float) -> str:
+        if self._hot_exposed >= self.window:
+            return (f"exposed wire creep: {exposed_ms:.2f} ms/step > "
+                    f"{self.exposed_threshold_ms:.2f} ms for "
+                    f"{self._hot_exposed} consecutive steps")
+        base = self._baseline or 0.0
+        return (f"step time regression: {step_ms:.1f} ms/step > "
+                f"{self.threshold:.2f} x baseline {base:.1f} ms for "
+                f"{self._hot_ms} consecutive steps")

@@ -1,0 +1,275 @@
+"""Candidate generation over the validated comm/zero config space.
+
+The generator composes knob mutations mechanically (cartesian product
+over gradient reduction, per-level wire dtypes, hierarchy factors,
+overlap, bucket size, quant block) and then runs EVERY composition
+through `config.DeepSpeedCommConfig` — the same validator a user config
+passes at initialize().  Whatever the validator rejects (an int8 inner
+wire on the scatter level, a non-dividing hierarchy factor, a typo'd
+dtype) is pruned before a single probe runs, and counted, so the search
+space can never drift from what the engine actually accepts.
+
+Candidate scopes:
+
+  live    a StepBuilder program rebuild on a RUNNING engine can serve
+          it (wire dtypes, bucket size, overlap on/off, implicit vs
+          bucketed).  The PR-10 mid-run demotion path is the existence
+          proof that live rebuilds are safe and bitwise.
+  engine  needs a fresh engine build — the data-axis factorization IS
+          the mesh layout every array placement derives from
+          (engine.allreduce_gradients documents the same boundary), so
+          hierarchy mutations only probe through an engine factory
+          (tools/autotune_bench.py) and never online.
+
+`safe_numerics`: True when swapping to the candidate preserves the
+repo's bitwise loss contract on this fabric — every wire level fp32
+(implicit psum == bucketed fold == overlap combine, elementwise, pinned
+since PR 3/9; bucket size only re-partitions the same elementwise
+fold).  Compressed wires (bf16/split/int8/int4) change rounding and are
+probe-only by default for the ONLINE retune loop, which pins loss
+parity across its swaps.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+# knob fields a 1-knob neighborhood distance is measured over
+_KNOB_FIELDS = ("gradient_reduction", "wire_dtype", "wire_dtype_inner",
+                "wire_dtype_outer", "hierarchy", "overlap",
+                "reduce_bucket_size", "quant_block_size")
+
+
+class Candidate(NamedTuple):
+    """One point in the legal config space."""
+
+    name: str
+    comm: Dict            # "comm"-block fragment the engine applies
+    stage: int = 0        # ZeRO stage the legality check ran against
+    scope: str = "live"   # "live" | "engine" (see module docstring)
+    safe_numerics: bool = True
+
+    def knobs(self) -> Dict:
+        """Comparable knob view (absent keys normalized) — the
+        neighborhood distance and ledger entries read this."""
+        c = self.comm
+        hier = c.get("hierarchy", "none")
+        if isinstance(hier, dict):
+            hier = hier.get("outer", 1)
+        return {
+            "gradient_reduction": c.get("gradient_reduction", "implicit"),
+            "wire_dtype": c.get("wire_dtype", "fp32"),
+            "wire_dtype_inner": c.get("wire_dtype_inner"),
+            "wire_dtype_outer": c.get("wire_dtype_outer"),
+            "hierarchy": hier,
+            "overlap": c.get("overlap", "none"),
+            "reduce_bucket_size": c.get("reduce_bucket_size"),
+            "quant_block_size": c.get("quant_block_size"),
+        }
+
+    def describe(self) -> str:
+        k = self.knobs()
+        parts = [k["gradient_reduction"]]
+        if k["gradient_reduction"] == "bucketed":
+            if k["hierarchy"] not in ("none", 1):
+                parts.append(f"hier outer={k['hierarchy']} "
+                             f"{k['wire_dtype_inner'] or k['wire_dtype']}/"
+                             f"{k['wire_dtype_outer'] or k['wire_dtype']}")
+            else:
+                parts.append(f"wire {k['wire_dtype']}")
+            if k["reduce_bucket_size"]:
+                parts.append(f"bucket {k['reduce_bucket_size']}")
+        if k["overlap"] not in ("none", None):
+            parts.append("overlap")
+        return f"{self.name}: " + ", ".join(parts)
+
+
+# knobs where None means "inherit the incumbent's value" (probe.
+# apply_candidate setdefaults them) — a wildcard, not a difference
+_OPTIONAL_KNOBS = ("wire_dtype_inner", "wire_dtype_outer",
+                   "reduce_bucket_size", "quant_block_size")
+
+
+def knob_distance(a: Candidate, b: Candidate) -> int:
+    """How many knob fields differ between two candidates.  Optional
+    knobs compare as equal when either side leaves them unspecified
+    (None = inherit)."""
+    ka, kb = a.knobs(), b.knobs()
+    dist = 0
+    for f in _KNOB_FIELDS:
+        if f in _OPTIONAL_KNOBS and (ka[f] is None or kb[f] is None):
+            continue
+        if ka[f] != kb[f]:
+            dist += 1
+    return dist
+
+
+def neighborhood(current: Candidate, candidates: Sequence[Candidate],
+                 radius: int = 1) -> List[Candidate]:
+    """The bounded re-probe set the online retune loop walks: every
+    candidate within `radius` knob mutations of `current` (current
+    itself excluded — the retuner re-probes it separately as the
+    baseline)."""
+    return [c for c in candidates
+            if c.name != current.name
+            and knob_distance(current, c) <= radius]
+
+
+def _is_legal(comm: Dict, stage: int, dp: Optional[int]) -> bool:
+    """Run one composed comm block through the REAL config validator —
+    the pruning the tentpole exists for.  Anything DeepSpeedCommConfig
+    raises on at parse time is illegal here too."""
+    from ..config import DeepSpeedCommConfig
+    from ..zero.config import DeepSpeedZeroConfig
+
+    zc = DeepSpeedZeroConfig({"zero_optimization": {"stage": stage}})
+    try:
+        DeepSpeedCommConfig({"comm": dict(comm)}, zc, world_size=dp)
+    except ValueError:
+        return False
+    return True
+
+
+def _name(reduction: str, wire: str, inner: Optional[str],
+          outer_dtype: Optional[str], hier, overlap: bool,
+          bucket: Optional[int], block: Optional[int]) -> str:
+    if reduction == "implicit":
+        return "implicit" + ("_overlap" if overlap else "")
+    parts = []
+    if hier in ("none", None, 1):
+        parts.append(f"flat_{wire}")
+    else:
+        parts.append(f"hier{hier}_{inner or 'fp32'}_"
+                     f"{outer_dtype or wire}")
+    if bucket:
+        parts.append(f"b{bucket}")
+    if block:
+        parts.append(f"q{block}")
+    if overlap:
+        parts.append("overlap")
+    return "_".join(parts)
+
+
+def _safe(wires: Sequence[Optional[str]]) -> bool:
+    return all(w in (None, "fp32") for w in wires)
+
+
+def generate_candidates(
+        dp: int,
+        stage: int = 0,
+        current_outer: int = 1,
+        wire_dtypes: Sequence[str] = ("fp32", "bf16", "int8"),
+        inner_dtypes: Sequence[Optional[str]] = (None,),
+        outers: Optional[Sequence[int]] = None,
+        overlap: Sequence[bool] = (False, True),
+        include_implicit: bool = True,
+        bucket_sizes: Sequence[int] = (),
+        quant_blocks: Sequence[int] = (),
+) -> Tuple[List[Candidate], int]:
+    """Enumerate the legal candidate set for a dp-wide data axis.
+
+    Returns (candidates, n_rejected) where n_rejected counts the
+    compositions the config validators pruned (the `autotune.rejected`
+    counter).  `outers=None` derives every proper divisor of `dp`;
+    hierarchy factors other than `current_outer` come out scope
+    "engine" (the factorization is the mesh layout — live rebuilds
+    cannot change it).  Structural no-ops are skipped rather than
+    rejected: overlap over the implicit wire would fall back with a
+    log, not probe anything new."""
+    if outers is None:
+        outers = [d for d in range(2, dp) if dp % d == 0]
+    hierarchies: List = ["none"] + [o for o in outers if o > 1]
+
+    seen = set()
+    out: List[Candidate] = []
+    rejected = 0
+
+    def add(reduction, wire, inner, outer_dtype, hier, ov, bucket, block):
+        nonlocal rejected
+        comm: Dict = {"gradient_reduction": reduction}
+        if reduction == "bucketed":
+            comm["wire_dtype"] = wire
+            if hier != "none":
+                comm["hierarchy"] = {"outer": int(hier)}
+                if inner is not None:
+                    comm["wire_dtype_inner"] = inner
+                if outer_dtype is not None:
+                    comm["wire_dtype_outer"] = outer_dtype
+            if bucket is not None:
+                comm["reduce_bucket_size"] = int(bucket)
+            if block is not None:
+                comm["quant_block_size"] = int(block)
+        comm["overlap"] = "on" if ov else "none"
+        name = _name(reduction, wire, inner, outer_dtype, hier, ov,
+                     bucket, block)
+        if name in seen:
+            return
+        seen.add(name)
+        if not _is_legal(comm, stage, dp):
+            rejected += 1
+            return
+        hier_outer = 1 if hier == "none" else int(hier)
+        scope = "live" if hier_outer == int(current_outer) else "engine"
+        out.append(Candidate(
+            name=name, comm=comm, stage=stage, scope=scope,
+            safe_numerics=_safe((wire, inner, outer_dtype))))
+
+    if include_implicit:
+        # the naive default: one psum per leaf, nothing overlapped —
+        # the config every search is expected to beat (or honestly
+        # confirm on fabrics where XLA's in-program psum wins)
+        add("implicit", "fp32", None, None, "none", False, None, None)
+
+    buckets: List[Optional[int]] = [None] + [int(b) for b in bucket_sizes]
+    blocks: List[Optional[int]] = [None] + [int(q) for q in quant_blocks]
+    for wire in wire_dtypes:
+        for hier in hierarchies:
+            inner_set = inner_dtypes if hier != "none" else (None,)
+            outer_set = ([wire] if hier != "none" else [None])
+            for inner in inner_set:
+                for outer_dtype in outer_set:
+                    # on hierarchical candidates the SLOW hop carries
+                    # the compression and the fast hop defaults exact —
+                    # wire_dtype itself stays fp32 there so the flat
+                    # fallback (if hierarchy disengages) is the safe one
+                    flat_wire = "fp32" if hier != "none" else wire
+                    for ov in overlap:
+                        for bucket in buckets:
+                            for block in blocks:
+                                if block is not None and not any(
+                                        w in ("int8", "int4") for w in
+                                        (flat_wire, inner, outer_dtype)):
+                                    continue  # block only moves quant wires
+                                add("bucketed", flat_wire, inner,
+                                    outer_dtype, hier, ov, bucket, block)
+    return out, rejected
+
+
+def current_candidate(engine) -> Candidate:
+    """The candidate describing an engine's CURRENT effective config —
+    the baseline the online retuner re-probes and measures swaps
+    against."""
+    cc = engine._config.comm_config
+    plan = engine.bucket_plan
+    outer = engine.mesh_info.data_outer_size
+    hier = "none" if outer <= 1 else outer
+    comm: Dict = {"gradient_reduction":
+                  "bucketed" if plan is not None else "implicit"}
+    wires: List[Optional[str]] = []
+    if plan is not None:
+        comm["wire_dtype"] = cc.wire_dtype
+        wires.append(cc.wire_dtype)
+        comm["reduce_bucket_size"] = plan.bucket_elems
+        if hier != "none":
+            comm["hierarchy"] = {"outer": outer}
+            comm["wire_dtype_inner"] = cc.wire_dtype_inner
+            comm["wire_dtype_outer"] = cc.wire_dtype_outer
+            wires = [cc.wire_dtype_inner, cc.wire_dtype_outer]
+    ov = engine._overlap_mode is not None
+    comm["overlap"] = "on" if ov else "none"
+    name = _name(comm["gradient_reduction"], comm.get("wire_dtype", "fp32"),
+                 comm.get("wire_dtype_inner"), comm.get("wire_dtype_outer"),
+                 hier, ov, None, None)
+    return Candidate(name=name, comm=comm,
+                     stage=engine._config.zero_optimization_stage,
+                     scope="live", safe_numerics=_safe(wires))

@@ -444,6 +444,184 @@ class DeepSpeedFaultsConfig(DeepSpeedConfigObject):
                 f"> 0, got {self.watchdog_poll_s}")
 
 
+class DeepSpeedAutotuneConfig(DeepSpeedConfigObject):
+    """The self-tuning runtime (runtime/autotune/).
+
+    "autotune": {"enabled": false, "probe_steps": 2, "probe_warmup": 1,
+                 "budget_s": null, "cache_path": null, "ledger_path":
+                 null, "apply_winner": true, "min_improvement": 0.03,
+                 "wire_dtypes": ["fp32","bf16","int8"],
+                 "bucket_sizes": [], "include_overlap": true,
+                 "online": {"enabled": false, "window": 5,
+                            "baseline_steps": 5, "threshold": 1.5,
+                            "exposed_threshold_ms": 0.0,
+                            "cooldown_steps": 20, "check_every": 1,
+                            "radius": 1, "safe_only": true}}
+
+    `enabled` arms the runtime (engine.autotune_search() probes the
+    legal candidate space, winner-cached by (model shape, mesh, fabric)
+    fingerprint); `online.enabled` additionally watches every step
+    boundary for sustained regression and live-retunes a bounded knob
+    neighborhood.  Every knob is validated HERE so a typo fails at
+    config time, not inside a probe."""
+
+    def __init__(self, param_dict):
+        super().__init__()
+        d = param_dict.get(c.AUTOTUNE) or {}
+        known = {c.AUTOTUNE_ENABLED, c.AUTOTUNE_PROBE_STEPS,
+                 c.AUTOTUNE_PROBE_WARMUP, c.AUTOTUNE_BUDGET_S,
+                 c.AUTOTUNE_CACHE_PATH, c.AUTOTUNE_LEDGER_PATH,
+                 c.AUTOTUNE_APPLY_WINNER, c.AUTOTUNE_MIN_IMPROVEMENT,
+                 c.AUTOTUNE_WIRE_DTYPES, c.AUTOTUNE_BUCKET_SIZES,
+                 c.AUTOTUNE_INCLUDE_OVERLAP, c.AUTOTUNE_ONLINE}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"autotune: unknown key(s) {sorted(unknown)}; expected a "
+                f"subset of {sorted(known)}")
+        self.enabled = bool(get_scalar_param(
+            d, c.AUTOTUNE_ENABLED, c.AUTOTUNE_ENABLED_DEFAULT))
+
+        def pos_int(key, default, minimum=1):
+            v = get_scalar_param(d, key, default)
+            if isinstance(v, bool) or not isinstance(v, int) or v < minimum:
+                raise ValueError(
+                    f"autotune.{key} must be an int >= {minimum}, got {v!r}")
+            return int(v)
+
+        self.probe_steps = pos_int(c.AUTOTUNE_PROBE_STEPS,
+                                   c.AUTOTUNE_PROBE_STEPS_DEFAULT)
+        self.probe_warmup = pos_int(c.AUTOTUNE_PROBE_WARMUP,
+                                    c.AUTOTUNE_PROBE_WARMUP_DEFAULT,
+                                    minimum=0)
+        budget = get_scalar_param(d, c.AUTOTUNE_BUDGET_S,
+                                  c.AUTOTUNE_BUDGET_S_DEFAULT)
+        if budget is not None:
+            try:
+                budget = float(budget)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"autotune.{c.AUTOTUNE_BUDGET_S} must be a positive "
+                    f"number of seconds or null, got {budget!r}")
+            if budget <= 0:
+                raise ValueError(
+                    f"autotune.{c.AUTOTUNE_BUDGET_S} must be > 0, "
+                    f"got {budget}")
+        self.budget_s = budget
+        for key, attr in ((c.AUTOTUNE_CACHE_PATH, "cache_path"),
+                          (c.AUTOTUNE_LEDGER_PATH, "ledger_path")):
+            v = get_scalar_param(d, key, None)
+            if v is not None and not isinstance(v, str):
+                raise ValueError(
+                    f"autotune.{key} must be a path string or null, "
+                    f"got {v!r}")
+            setattr(self, attr, v)
+        self.apply_winner = bool(get_scalar_param(
+            d, c.AUTOTUNE_APPLY_WINNER, c.AUTOTUNE_APPLY_WINNER_DEFAULT))
+        mi = get_scalar_param(d, c.AUTOTUNE_MIN_IMPROVEMENT,
+                              c.AUTOTUNE_MIN_IMPROVEMENT_DEFAULT)
+        try:
+            mi = float(mi)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"autotune.{c.AUTOTUNE_MIN_IMPROVEMENT} must be a "
+                f"fraction in [0, 1), got {mi!r}")
+        if not 0.0 <= mi < 1.0:
+            raise ValueError(
+                f"autotune.{c.AUTOTUNE_MIN_IMPROVEMENT} must be a "
+                f"fraction in [0, 1), got {mi}")
+        self.min_improvement = mi
+        from .comm.bucketing import WIRE_MODES
+
+        wires = d.get(c.AUTOTUNE_WIRE_DTYPES,
+                      list(c.AUTOTUNE_WIRE_DTYPES_DEFAULT))
+        if not isinstance(wires, (list, tuple)) or not wires or \
+                any(str(w).lower() not in WIRE_MODES for w in wires):
+            raise ValueError(
+                f"autotune.{c.AUTOTUNE_WIRE_DTYPES} must be a non-empty "
+                f"list drawn from {WIRE_MODES}, got {wires!r}")
+        self.wire_dtypes = tuple(str(w).lower() for w in wires)
+        buckets = d.get(c.AUTOTUNE_BUCKET_SIZES,
+                        list(c.AUTOTUNE_BUCKET_SIZES_DEFAULT))
+        if not isinstance(buckets, (list, tuple)) or any(
+                isinstance(b, bool) or not isinstance(b, int) or b < 1
+                for b in buckets):
+            raise ValueError(
+                f"autotune.{c.AUTOTUNE_BUCKET_SIZES} must be a list of "
+                f"positive element counts, got {buckets!r}")
+        self.bucket_sizes = tuple(int(b) for b in buckets)
+        self.include_overlap = bool(get_scalar_param(
+            d, c.AUTOTUNE_INCLUDE_OVERLAP,
+            c.AUTOTUNE_INCLUDE_OVERLAP_DEFAULT))
+
+        o = d.get(c.AUTOTUNE_ONLINE) or {}
+        known_o = {c.AUTOTUNE_ONLINE_ENABLED, c.AUTOTUNE_ONLINE_WINDOW,
+                   c.AUTOTUNE_ONLINE_BASELINE_STEPS,
+                   c.AUTOTUNE_ONLINE_THRESHOLD,
+                   c.AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS,
+                   c.AUTOTUNE_ONLINE_COOLDOWN_STEPS,
+                   c.AUTOTUNE_ONLINE_CHECK_EVERY, c.AUTOTUNE_ONLINE_RADIUS,
+                   c.AUTOTUNE_ONLINE_SAFE_ONLY}
+        unknown = set(o) - known_o
+        if unknown:
+            raise ValueError(
+                f"autotune.{c.AUTOTUNE_ONLINE}: unknown key(s) "
+                f"{sorted(unknown)}; expected a subset of {sorted(known_o)}")
+
+        def online_int(key, default, minimum=1):
+            v = get_scalar_param(o, key, default)
+            if isinstance(v, bool) or not isinstance(v, int) or v < minimum:
+                raise ValueError(
+                    f"autotune.online.{key} must be an int >= {minimum}, "
+                    f"got {v!r}")
+            return int(v)
+
+        self.online_enabled = bool(get_scalar_param(
+            o, c.AUTOTUNE_ONLINE_ENABLED, c.AUTOTUNE_ONLINE_ENABLED_DEFAULT))
+        self.online_window = online_int(c.AUTOTUNE_ONLINE_WINDOW,
+                                        c.AUTOTUNE_ONLINE_WINDOW_DEFAULT)
+        self.online_baseline_steps = online_int(
+            c.AUTOTUNE_ONLINE_BASELINE_STEPS,
+            c.AUTOTUNE_ONLINE_BASELINE_STEPS_DEFAULT)
+        thr = get_scalar_param(o, c.AUTOTUNE_ONLINE_THRESHOLD,
+                               c.AUTOTUNE_ONLINE_THRESHOLD_DEFAULT)
+        try:
+            thr = float(thr)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"autotune.online.{c.AUTOTUNE_ONLINE_THRESHOLD} must be a "
+                f"ratio > 1.0, got {thr!r}")
+        if thr <= 1.0:
+            raise ValueError(
+                f"autotune.online.{c.AUTOTUNE_ONLINE_THRESHOLD} must be "
+                f"> 1.0 (a ratio over the step-time baseline), got {thr}")
+        self.online_threshold = thr
+        exp = get_scalar_param(o, c.AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS,
+                               c.AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS_DEFAULT)
+        try:
+            exp = float(exp)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"autotune.online.{c.AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS} "
+                f"must be a millisecond count >= 0 (0 disables), got {exp!r}")
+        if exp < 0:
+            raise ValueError(
+                f"autotune.online.{c.AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS} "
+                f"must be >= 0 (0 disables the exposed trigger), got {exp}")
+        self.online_exposed_threshold_ms = exp
+        self.online_cooldown_steps = online_int(
+            c.AUTOTUNE_ONLINE_COOLDOWN_STEPS,
+            c.AUTOTUNE_ONLINE_COOLDOWN_STEPS_DEFAULT, minimum=0)
+        self.online_check_every = online_int(
+            c.AUTOTUNE_ONLINE_CHECK_EVERY,
+            c.AUTOTUNE_ONLINE_CHECK_EVERY_DEFAULT)
+        self.online_radius = online_int(c.AUTOTUNE_ONLINE_RADIUS,
+                                        c.AUTOTUNE_ONLINE_RADIUS_DEFAULT)
+        self.online_safe_only = bool(get_scalar_param(
+            o, c.AUTOTUNE_ONLINE_SAFE_ONLY,
+            c.AUTOTUNE_ONLINE_SAFE_ONLY_DEFAULT))
+
+
 def get_fp16_enabled(param_dict):
     return get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_ENABLED,
                             c.FP16_ENABLED_DEFAULT)
@@ -586,6 +764,10 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         # chaos-ready runtime: fault injection + retry + watchdog
         # (runtime/resilience.py)
         self.faults_config = DeepSpeedFaultsConfig(pd)
+
+        # the self-tuning runtime (runtime/autotune/): fingerprinted
+        # config search + the online retune loop
+        self.autotune_config = DeepSpeedAutotuneConfig(pd)
 
         # pipeline: use_p2p_channels forces the multi-host channel
         # executor even single-process (the driver's virtual-multichip

@@ -325,6 +325,78 @@ SPARSE_NUM_SLIDING_WINDOW_BLOCKS = "num_sliding_window_blocks"
 SPARSE_NUM_SLIDING_WINDOW_BLOCKS_DEFAULT = 3
 
 #############################################
+# Autotune: the self-tuning runtime (runtime/autotune/)
+#
+# "autotune": {
+#   "enabled": false,          # arm the runtime (search on demand)
+#   "probe_steps": 2,          # timed engine steps per candidate probe
+#   "probe_warmup": 1,         # compile/warm steps before timing
+#   "budget_s": null,          # wall budget across one search (null =
+#                              # unbounded; exhausted => skipped probes,
+#                              # and a degraded probe set is never cached)
+#   "cache_path": null,        # fingerprint-keyed winner cache JSON
+#   "ledger_path": null,       # default: <monitor run dir>/autotune.jsonl
+#   "apply_winner": true,      # swap the engine onto the search winner
+#   "min_improvement": 0.03,   # swap only if winner ms/step beats the
+#                              # incumbent by this fraction
+#   "wire_dtypes": [...],      # candidate wire dtypes
+#   "bucket_sizes": [],        # extra reduce_bucket_size candidates
+#   "include_overlap": true,   # include comm.overlap flips
+#   "online": {                # the live retune loop
+#     "enabled": false, "window": 5, "baseline_steps": 5,
+#     "threshold": 1.5,        # sustained ms/step ratio over baseline
+#     "exposed_threshold_ms": 0.0,  # exposed-wire creep trigger (0=off)
+#     "cooldown_steps": 20,    # no re-trigger right after a retune
+#     "check_every": 1,        # rank-consensus cadence (boundaries)
+#     "radius": 1,             # knob-distance of the re-probe set
+#     "safe_only": true        # online swaps keep bitwise loss parity
+#   }
+# }
+#############################################
+AUTOTUNE = "autotune"
+AUTOTUNE_ENABLED = "enabled"
+AUTOTUNE_ENABLED_DEFAULT = False
+AUTOTUNE_PROBE_STEPS = "probe_steps"
+AUTOTUNE_PROBE_STEPS_DEFAULT = 2
+AUTOTUNE_PROBE_WARMUP = "probe_warmup"
+AUTOTUNE_PROBE_WARMUP_DEFAULT = 1
+AUTOTUNE_BUDGET_S = "budget_s"
+AUTOTUNE_BUDGET_S_DEFAULT = None
+AUTOTUNE_CACHE_PATH = "cache_path"
+AUTOTUNE_CACHE_PATH_DEFAULT = None
+AUTOTUNE_LEDGER_PATH = "ledger_path"
+AUTOTUNE_LEDGER_PATH_DEFAULT = None
+AUTOTUNE_APPLY_WINNER = "apply_winner"
+AUTOTUNE_APPLY_WINNER_DEFAULT = True
+AUTOTUNE_MIN_IMPROVEMENT = "min_improvement"
+AUTOTUNE_MIN_IMPROVEMENT_DEFAULT = 0.03
+AUTOTUNE_WIRE_DTYPES = "wire_dtypes"
+AUTOTUNE_WIRE_DTYPES_DEFAULT = ("fp32", "bf16", "int8")
+AUTOTUNE_BUCKET_SIZES = "bucket_sizes"
+AUTOTUNE_BUCKET_SIZES_DEFAULT = ()
+AUTOTUNE_INCLUDE_OVERLAP = "include_overlap"
+AUTOTUNE_INCLUDE_OVERLAP_DEFAULT = True
+AUTOTUNE_ONLINE = "online"
+AUTOTUNE_ONLINE_ENABLED = "enabled"
+AUTOTUNE_ONLINE_ENABLED_DEFAULT = False
+AUTOTUNE_ONLINE_WINDOW = "window"
+AUTOTUNE_ONLINE_WINDOW_DEFAULT = 5
+AUTOTUNE_ONLINE_BASELINE_STEPS = "baseline_steps"
+AUTOTUNE_ONLINE_BASELINE_STEPS_DEFAULT = 5
+AUTOTUNE_ONLINE_THRESHOLD = "threshold"
+AUTOTUNE_ONLINE_THRESHOLD_DEFAULT = 1.5
+AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS = "exposed_threshold_ms"
+AUTOTUNE_ONLINE_EXPOSED_THRESHOLD_MS_DEFAULT = 0.0
+AUTOTUNE_ONLINE_COOLDOWN_STEPS = "cooldown_steps"
+AUTOTUNE_ONLINE_COOLDOWN_STEPS_DEFAULT = 20
+AUTOTUNE_ONLINE_CHECK_EVERY = "check_every"
+AUTOTUNE_ONLINE_CHECK_EVERY_DEFAULT = 1
+AUTOTUNE_ONLINE_RADIUS = "radius"
+AUTOTUNE_ONLINE_RADIUS_DEFAULT = 1
+AUTOTUNE_ONLINE_SAFE_ONLY = "safe_only"
+AUTOTUNE_ONLINE_SAFE_ONLY_DEFAULT = True
+
+#############################################
 # TPU-specific additions (no reference analogue)
 #############################################
 MESH = "mesh"  # {"data": -1, "model": 1, "pipe": 1, "seq": 1}

@@ -292,6 +292,8 @@ class DeepSpeedEngine:
         self._watchdog = self._init_resilience()
         self._register_exchange_watchdog()
         self._init_preemption()
+        self._autotune_batch = None     # last sharded batch (probe replay)
+        self._autotuner = self._init_autotune()
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -390,6 +392,14 @@ class DeepSpeedEngine:
         self._watchdog = self._init_resilience()
         self._init_demotion_state()
         self._init_preemption()
+        self._autotune_batch = None
+        self._autotuner = None  # live probing needs the device step paths
+        if getattr(self._config, "autotune_config", None) is not None and \
+                self._config.autotune_config.enabled:
+            log_dist("autotune requested but ZeRO-Infinity streams the "
+                     "step host-side — the live autotuner does not attach "
+                     "(tune Infinity runs through tools/autotune_bench.py"
+                     "'s engine-factory search)", ranks=[0])
 
     def _init_demotion_state(self):
         """Coordinated-demotion state: set when the exchange flags
@@ -925,6 +935,62 @@ class DeepSpeedEngine:
             metrics["grad_norm"] = gn
         metrics.update(extra)
         rm.step_end(self.global_steps, **metrics)
+
+    def _init_autotune(self):
+        """Attach the self-tuning runtime (runtime/autotune/) when the
+        "autotune" config block enables it: `autotune_search()` probes
+        the legal comm-config space through live StepBuilder rebuilds
+        (winner-cached by (model shape, mesh, fabric) fingerprint), and
+        with `autotune.online.enabled` the step() boundary watches for
+        sustained regression and live-retunes a bounded neighborhood."""
+        ac = getattr(self._config, "autotune_config", None)
+        if ac is None or not ac.enabled:
+            return None
+        # decline at INIT on engines live probing cannot serve (the
+        # EngineProber constructor would raise) — the Infinity-path
+        # contract: a requested autotune never crashes training at an
+        # unpredictable step, it declines loudly up front
+        blockers = []
+        if self._offload is not None:
+            blockers.append("ZeRO-Offload (the step runs host-side)")
+        if self._qwz_overlap is not None or self._qwz_gather is not None:
+            blockers.append("the qwZ stage-3 gather (prep is outside the "
+                            "live-probe surface)")
+        if self.mesh_info.axis_size(PIPE_AXIS) > 1:
+            blockers.append("pipe-parallel stages")
+        if blockers:
+            log_dist("autotune requested but the live tuner does not "
+                     "attach: " + "; ".join(blockers) + " — tune this "
+                     "config through tools/autotune_bench.py's "
+                     "engine-factory search", ranks=[0])
+            return None
+        from .autotune import AutotuneRuntime
+
+        runtime = AutotuneRuntime(self, ac)
+        log_dist(
+            "autotune armed: probe_steps="
+            f"{ac.probe_steps} wire_dtypes={list(ac.wire_dtypes)} "
+            f"online={'on' if ac.online_enabled else 'off'}"
+            + (f" cache={ac.cache_path}" if ac.cache_path else ""),
+            ranks=[0])
+        return runtime
+
+    def autotune_search(self, batch=None, candidates=None, force=False,
+                        cache_path=None):
+        """Run the fingerprinted config search NOW (a step boundary —
+        no pending micro gradients) and apply the winner (unless
+        `autotune.apply_winner` is false).  `batch` seeds the probe
+        batch when no forward has run yet; `force` skips the winner
+        cache.  Returns the outcome dict ({"winner", "cached",
+        "probes", "trace", ...}).  Needs the "autotune" config block
+        enabled."""
+        if self._autotuner is None:
+            raise RuntimeError(
+                "autotune_search needs {'autotune': {'enabled': true}} in "
+                "the config (and a device step path — stage < 3, no "
+                "offload/Infinity)")
+        return self._autotuner.search(batch=batch, candidates=candidates,
+                                      force=force, cache_path=cache_path)
 
     def finalize_monitoring(self):
         """Flush the event stream and write end-of-run summaries.  Under
@@ -1641,6 +1707,7 @@ class DeepSpeedEngine:
         if self.is_gradient_accumulation_boundary():
             self.tput_timer.start()  # times one full global batch
         batch = self._shard_batch(batch)
+        self._autotune_batch = batch  # probe replay (never donated)
         rng = rng if rng is not None else self._next_rng()
         theta = jnp.asarray(
             self.progressive_layer_drop.get_theta()
@@ -1688,6 +1755,7 @@ class DeepSpeedEngine:
             self.tput_timer.start()  # times one full global batch
         self._check_overlap_health()
         batch = self._shard_batch(batch)
+        self._autotune_batch = batch  # probe replay (never donated)
         rng = rng if rng is not None else self._next_rng()
         theta = jnp.asarray(
             self.progressive_layer_drop.get_theta()
@@ -1751,6 +1819,7 @@ class DeepSpeedEngine:
         self._resolve_pending_overflow()
         self.tput_timer.start()
         batch = self._shard_batch(batch)
+        self._autotune_batch = batch  # probe replay (never donated)
         rng = rng if rng is not None else self._next_rng()
         theta = jnp.asarray(
             self.progressive_layer_drop.get_theta()
@@ -1954,6 +2023,10 @@ class DeepSpeedEngine:
         # the only point where a coordinated demotion may rebuild the
         # step programs and where a SIGTERM'd run can checkpoint + exit
         self._finish_demotion()
+        if self._autotuner is not None:
+            # the online retune loop observes (and may rebuild) ONLY at
+            # this clean boundary, like the demotion above
+            self._autotuner.on_step_boundary()
         self._maybe_preempt_checkpoint()
         return out
 
@@ -2317,6 +2390,13 @@ class DeepSpeedEngine:
             rm.step_start(self.global_steps)
         self.tput_timer.start()
         stacked = self._shard_batch_stacked(stacked)
+        if self._autotuner is not None:
+            # probe replay stash: one micro slice (the prober re-stacks
+            # to whatever gas the probed composition needs).  Unlike
+            # the other forward paths' zero-cost reference stash, this
+            # slice is a per-leaf device dispatch — autotuned runs only.
+            self._autotune_batch = jax.tree_util.tree_map(
+                lambda x: x[0], stacked)
         # ONE split dispatch for the whole global batch (a python loop of
         # _next_rng() costs gas separate jax.random.split dispatches):
         # key state folds forward once, per-micro keys peel off the rest

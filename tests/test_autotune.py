@@ -1,0 +1,677 @@
+"""The self-tuning runtime (runtime/autotune/).
+
+Covers the tentpole contracts end to end on the virtual 8-device mesh:
+
+* candidate generation prunes illegal combos through config.py's OWN
+  validators (never a parallel legality model that can drift)
+* live probing via StepBuilder rebuilds is side-effect-free: training
+  continues BITWISE as if the probe never happened, and the incumbent's
+  compiled programs are restored by reference (no recompile)
+* fingerprint cache: same (model, mesh, fabric) hits with ZERO probes;
+  a changed mesh factorization, dtype config or dp world re-probes
+  loudly — a stale winner is never silently reused
+* live swaps between numerics-safe configs keep the loss stream
+  bitwise (implicit == bucketed fp32 == overlapped fp32, the repo's
+  pinned reduction contracts)
+* engine.allreduce_gradients(bucket_size=...) mid-run — including
+  MID-ACCUMULATION under the ACTIVE overlap exchange — rebuilds the
+  overlap layout and stays bitwise with the serial wire (the
+  engine.py "must not drop dispatched micro gradients" invariant,
+  previously untested under overlap)
+* the online retune loop: an injected wire slowdown triggers EXACTLY
+  one retune, the swap lands on the serial wire, loss parity pinned
+  across the swap
+* config validation, counters -> report, and the bench dry-run lane
+"""
+
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.monitor.counters import COUNTERS
+from deepspeed_tpu.runtime.autotune import (Candidate, RegressionDetector,
+                                            SearchDriver, WinnerCache,
+                                            combine_score,
+                                            current_candidate,
+                                            engine_fingerprint,
+                                            fingerprint_diff,
+                                            generate_candidates,
+                                            knob_distance, make_fingerprint,
+                                            neighborhood)
+from deepspeed_tpu.runtime.autotune.probe import (EngineProber,
+                                                  apply_candidate)
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+from simple_model import SimpleModel, random_batches
+
+
+class _Capture(logging.Handler):
+    """The ds logger sets propagate=False, so caplog never sees it —
+    capture via a direct handler (the test_step_overlap pattern)."""
+
+    def __init__(self):
+        super().__init__()
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record.getMessage())
+
+    def __enter__(self):
+        ds_logger.addHandler(self)
+        return self
+
+    def __exit__(self, *exc):
+        ds_logger.removeHandler(self)
+        return False
+
+
+def make_engine(comm=None, autotune=None, gas=1, stage=0, mesh=None,
+                faults=None, precision=None, monitor_dir=None):
+    cfg = {
+        "train_batch_size": 8 * gas,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "mesh": mesh or {"data": 8},
+    }
+    if gas > 1:
+        cfg["train_micro_batch_size_per_gpu"] = \
+            8 // (mesh or {"data": 8})["data"]
+    if comm is not None:
+        cfg["comm"] = comm
+    if autotune is not None:
+        cfg["autotune"] = autotune
+    if faults is not None:
+        cfg["faults"] = faults
+    if precision is not None:
+        cfg[precision] = {"enabled": True}
+    if monitor_dir is not None:
+        cfg["monitor"] = {"enabled": True, "output_path": monitor_dir,
+                          "job_name": "at", "flush_interval": 1}
+    engine, *_ = deepspeed_tpu.initialize(model=SimpleModel(),
+                                          config_params=cfg)
+    return engine
+
+
+def train(engine, n_steps, gas=1, batches=None):
+    batches = batches or list(random_batches(1, batch_size=8))
+    losses = []
+    for _ in range(n_steps):
+        for _m in range(gas):
+            loss = engine.forward(batches[0])
+            engine.backward()
+        engine.step()
+        losses.append(np.float32(float(loss)))
+    return losses
+
+
+# ---------------------------------------------------------------------------
+# candidate space
+# ---------------------------------------------------------------------------
+
+
+def test_generator_prunes_through_config_validators():
+    # the int8 inner wire is config-illegal (per-block scales cannot
+    # ride a psum_scatter) — the generator composes it, the validator
+    # prunes it, and the rejection is counted
+    cands, rejected = generate_candidates(
+        dp=8, wire_dtypes=("fp32", "int8"), inner_dtypes=(None, "int8"))
+    assert rejected > 0
+    assert all(c.comm.get("wire_dtype_inner") != "int8" for c in cands)
+
+
+def test_generator_prunes_non_dividing_hierarchy():
+    cands, rejected = generate_candidates(
+        dp=8, wire_dtypes=("fp32",), outers=(3,))
+    assert rejected > 0  # 3 does not divide 8: check_hierarchy_divides
+    assert all("hier3" not in c.name for c in cands)
+
+
+def test_generator_scopes_and_safety():
+    cands, _ = generate_candidates(dp=8, wire_dtypes=("fp32", "bf16"),
+                                   outers=(2,), current_outer=1)
+    by_name = {c.name: c for c in cands}
+    assert len(by_name) == len(cands), "candidate names must be unique"
+    # the naive default is in the space, live, and numerics-safe
+    assert by_name["implicit"].scope == "live"
+    assert by_name["implicit"].safe_numerics
+    assert by_name["flat_fp32_overlap"].safe_numerics
+    assert not by_name["flat_bf16"].safe_numerics
+    # hierarchy != the mesh's factorization is rebuild-scope
+    assert by_name["hier2_fp32_bf16"].scope == "engine"
+    cands2, _ = generate_candidates(dp=8, wire_dtypes=("fp32",),
+                                    outers=(2,), current_outer=2)
+    by_name2 = {c.name: c for c in cands2}
+    assert by_name2["hier2_fp32_fp32"].scope == "live"
+    assert by_name2["flat_fp32"].scope == "engine"
+
+
+def test_neighborhood_is_one_knob_bounded():
+    cands, _ = generate_candidates(dp=8, wire_dtypes=("fp32", "bf16"))
+    by_name = {c.name: c for c in cands}
+    cur = by_name["flat_fp32_overlap"]
+    names = {c.name for c in neighborhood(cur, cands, radius=1)}
+    assert "flat_fp32" in names          # overlap flip: 1 knob
+    assert "flat_bf16_overlap" in names  # wire flip: 1 knob
+    assert "implicit" not in names       # reduction + overlap: 2 knobs
+    assert knob_distance(cur, by_name["implicit"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# fingerprint + cache
+# ---------------------------------------------------------------------------
+
+
+def test_engine_fingerprint_stable_and_sensitive():
+    e1 = make_engine()
+    e2 = make_engine()
+    fp1, fp2 = engine_fingerprint(e1), engine_fingerprint(e2)
+    assert fp1 == fp2 and fp1["digest"] == fp2["digest"]
+    e3 = make_engine(precision="bf16")  # the dtype config changed
+    fp3 = engine_fingerprint(e3)
+    assert fp3 != fp1
+    assert "dtypes.precision" in fingerprint_diff(fp1, fp3)
+    e4 = make_engine(comm={"gradient_reduction": "bucketed",
+                           "hierarchy": {"outer": 2}})
+    fp4 = engine_fingerprint(e4)  # the mesh factorization changed
+    assert "mesh.data_outer" in fingerprint_diff(fp1, fp4)
+    e5 = make_engine(mesh={"data": 4, "model": 2})  # dp world changed
+    fp5 = engine_fingerprint(e5)
+    diffs = fingerprint_diff(fp1, fp5)
+    assert "mesh.data" in diffs and "mesh.model" in diffs
+
+
+def test_cache_map_roundtrip_and_loud_invalidation(tmp_path):
+    path = str(tmp_path / "cache.json")
+    cache = WinnerCache(path, mode="map")
+    fp = make_fingerprint(mesh={"dp": 8}, fabric={"t": "x"})
+    cache.store(fp, {"name": "flat_fp32"}, [{"candidate": "flat_fp32"}])
+    hit = cache.lookup(fp)
+    assert hit is not None and hit["winner"]["name"] == "flat_fp32"
+    fp2 = make_fingerprint(mesh={"dp": 4}, fabric={"t": "x"})
+    with _Capture() as cap:
+        assert cache.lookup(fp2) is None
+    assert any("re-probing" in m or "probing" in m for m in cap.records), \
+        "a fingerprint miss must be loud"
+    # an unreadable cache is a miss, never a crash or a stale pin
+    with open(path, "w") as f:
+        f.write("{torn json")
+    with _Capture() as cap:
+        assert cache.lookup(fp) is None
+    assert any("unreadable" in m for m in cap.records)
+
+
+def test_cache_single_mode_is_bench_format(tmp_path):
+    path = str(tmp_path / "autotune.json")
+    cache = WinnerCache(path, mode="single")
+    fp = {"candidates": [["small", 8, False]], "seq": 1024,
+          "backend": "cpu"}
+    cache.store(fp, {"size": "small", "micro": 8, "remat": False,
+                     "attn_impl": "auto"}, [{"size": "small"}])
+    raw = json.load(open(path))
+    # the committed bench_artifacts/autotune.json shape, exactly
+    assert set(raw) == {"size", "micro", "remat", "attn_impl", "probes",
+                        "fingerprint"}
+    assert cache.lookup(fp)["micro"] == 8
+    assert cache.lookup({**fp, "seq": 31337}) is None
+
+
+# ---------------------------------------------------------------------------
+# driver + detector
+# ---------------------------------------------------------------------------
+
+
+def test_driver_is_failure_tolerant_and_budgeted():
+    calls = []
+
+    def probe(c):
+        calls.append(c.name)
+        if c.name == "boom":
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+        return {"step_ms": {"a": 10.0, "b": 5.0}[c.name]}
+
+    cands = [Candidate(n, {}) for n in ("a", "boom", "b")]
+    d = SearchDriver(probe)
+    best = d.search(cands)
+    assert best.candidate.name == "b"
+    assert calls == ["a", "boom", "b"], "a failed probe must not stop it"
+    failed = [r for r in d.results if r.error]
+    assert len(failed) == 1 and failed[0].oom
+    assert not d.complete
+    d0 = SearchDriver(probe, budget_s=0.0)
+    assert d0.search(cands) is None
+    assert all(r.skipped == "budget" for r in d0.results)
+
+
+def test_score_prefers_hidden_wire_at_equal_speed():
+    fast_exposed = combine_score({"step_ms": 10.0, "exposed_ms": 5.0})
+    fast_hidden = combine_score({"step_ms": 10.0, "exposed_ms": 0.0})
+    assert fast_hidden > fast_exposed
+    # but raw speed still dominates a modest exposure difference
+    assert combine_score({"step_ms": 5.0, "exposed_ms": 1.0}) > fast_hidden
+
+
+def test_regression_detector():
+    det = RegressionDetector(window=3, baseline_steps=3, threshold=1.5,
+                             cooldown_steps=4)
+    for _ in range(3):
+        assert not det.observe(10.0)
+    assert det.baseline_ms == 10.0
+    assert not det.observe(100.0)  # one GC pause is not a regression
+    assert not det.observe(10.0)
+    triggered = [det.observe(30.0) for _ in range(3)]
+    assert triggered == [False, False, True], "sustained => trigger"
+    det.reset()
+    for _ in range(4):  # cooldown swallows observations
+        assert not det.observe(500.0)
+    # exposed-creep trigger, independent of step time
+    det2 = RegressionDetector(window=2, baseline_steps=1, threshold=2.0,
+                              exposed_threshold_ms=1.0, cooldown_steps=0)
+    det2.observe(10.0)
+    assert not det2.observe(10.0, exposed_ms=5.0)
+    assert det2.observe(10.0, exposed_ms=5.0)
+    assert "exposed wire creep" in det2.describe_trigger(10.0, 5.0)
+
+
+def test_detector_validation():
+    with pytest.raises(ValueError):
+        RegressionDetector(window=0)
+    with pytest.raises(ValueError):
+        RegressionDetector(threshold=1.0)
+
+
+# ---------------------------------------------------------------------------
+# live probing
+# ---------------------------------------------------------------------------
+
+
+def _live(names, dp=8, **kw):
+    cands, _ = generate_candidates(dp=dp, wire_dtypes=("fp32", "bf16"),
+                                   **kw)
+    by_name = {c.name: c for c in cands}
+    return [by_name[n] for n in names]
+
+
+def test_probe_never_perturbs_training():
+    batches = list(random_batches(1, batch_size=8))
+    oracle = train(make_engine(), 6, batches=batches)
+    eng = make_engine()
+    probed = train(eng, 3, batches=batches)
+    fns_before = eng._step_fns
+    plan_before = eng.bucket_plan
+    steps_before = eng.global_steps
+    prober = EngineProber(eng, steps=1, warmup=1)
+    for cand in _live(["flat_fp32", "flat_bf16", "flat_fp32_overlap"]):
+        m = prober.probe(cand)
+        assert m["step_ms"] > 0
+    # the incumbent build came back BY REFERENCE (no recompile) and no
+    # bookkeeping moved
+    assert eng._step_fns is fns_before
+    assert eng.bucket_plan is plan_before
+    assert eng.global_steps == steps_before
+    probed += train(eng, 3, batches=batches)
+    assert probed == oracle, "probing must be invisible to training"
+
+
+def test_probe_rejects_rebuild_scope_candidates():
+    eng = make_engine()
+    train(eng, 1)
+    hier = _live(["hier2_fp32_fp32"], outers=(2,))[0]
+    assert hier.scope == "engine"
+    with pytest.raises(ValueError, match="mesh layout"):
+        apply_candidate(eng, hier)
+
+
+def test_probe_needs_a_batch():
+    eng = make_engine()
+    with pytest.raises(RuntimeError, match="probe batch"):
+        EngineProber(eng)
+
+
+def test_live_swap_parity_across_safe_configs():
+    batches = list(random_batches(1, batch_size=8))
+    implicit_oracle = train(make_engine(), 6, batches=batches)
+    bucketed_oracle = train(
+        make_engine(comm={"gradient_reduction": "bucketed"}), 6,
+        batches=batches)
+    eng = make_engine()
+    losses = train(eng, 3, batches=batches)
+    apply_candidate(eng, _live(["flat_fp32"])[0])
+    assert eng.bucket_plan is not None
+    losses += train(eng, 3, batches=batches)
+    # fp32 wires are reduction-math-identical: implicit == bucketed ==
+    # the mid-run swap between them, bitwise
+    assert implicit_oracle == bucketed_oracle == losses
+
+
+def test_live_swap_engages_and_disengages_overlap():
+    eng = make_engine(gas=2)
+    train(eng, 1, gas=2)
+    apply_candidate(eng, _live(["flat_fp32_overlap"])[0])
+    assert "grads" in eng._step_fns and eng._overlap_mode == "wire"
+    train(eng, 1, gas=2)
+    apply_candidate(eng, _live(["flat_fp32"])[0])
+    assert "grads" not in eng._step_fns and eng._overlap_mode is None
+    train(eng, 1, gas=2)
+    eng.close_overlap()
+
+
+# ---------------------------------------------------------------------------
+# the fingerprinted search + cache invalidation (satellite)
+# ---------------------------------------------------------------------------
+
+_SEARCH_AT = {"enabled": True, "probe_steps": 1, "probe_warmup": 1}
+
+
+def test_search_cache_hit_zero_probes(tmp_path):
+    cache = str(tmp_path / "winners.json")
+    at = dict(_SEARCH_AT, cache_path=cache)
+    cands = _live(["implicit", "flat_fp32"])
+    e1 = make_engine(autotune=at)
+    train(e1, 1)
+    out1 = e1.autotune_search(candidates=cands)
+    assert not out1["cached"] and out1["probes"] == 2
+    # same (model, mesh, fabric): a fresh engine hits with ZERO probes
+    snap = COUNTERS.snapshot()
+    e2 = make_engine(autotune=at)
+    train(e2, 1)
+    out2 = e2.autotune_search()
+    assert out2["cached"] and out2["probes"] == 0
+    assert out2["winner"] == out1["winner"]
+    deltas = COUNTERS.delta_since(snap)
+    assert deltas.get("autotune.cache_hits", {}).get("calls") == 1
+    assert "autotune.probes" not in deltas
+
+
+@pytest.mark.parametrize("change", ["mesh_factorization", "dtype",
+                                    "world_size"])
+def test_search_reprobes_on_changed_fingerprint(tmp_path, change):
+    cache = str(tmp_path / "winners.json")
+    at = dict(_SEARCH_AT, cache_path=cache)
+    e1 = make_engine(autotune=at)
+    train(e1, 1)
+    e1.autotune_search(candidates=_live(["implicit", "flat_fp32"]))
+    if change == "mesh_factorization":
+        e2 = make_engine(autotune=at,
+                         comm={"gradient_reduction": "bucketed",
+                               "hierarchy": {"outer": 2}})
+        cands = _live(["hier2_fp32_fp32"], outers=(2,), current_outer=2)
+    elif change == "dtype":
+        e2 = make_engine(autotune=at, precision="bf16")
+        cands = _live(["implicit"])
+    else:
+        e2 = make_engine(autotune=at, mesh={"data": 4, "model": 2})
+        cands, _ = generate_candidates(dp=4, wire_dtypes=("fp32",),
+                                       overlap=(False,))
+        cands = [c for c in cands if c.name == "implicit"]
+    train(e2, 1, batches=list(random_batches(1, batch_size=8)))
+    with _Capture() as cap:
+        out = e2.autotune_search(candidates=cands)
+    # a stale winner is NEVER silently reused: loud log + real probes
+    assert not out["cached"] and out["probes"] == len(cands)
+    assert any("probing" in m for m in cap.records)
+
+
+def test_search_force_skips_cache(tmp_path):
+    at = dict(_SEARCH_AT, cache_path=str(tmp_path / "w.json"))
+    cands = _live(["implicit", "flat_fp32"])
+    e1 = make_engine(autotune=at)
+    train(e1, 1)
+    e1.autotune_search(candidates=cands)
+    out = e1.autotune_search(candidates=cands, force=True)
+    assert not out["cached"] and out["probes"] == 2
+
+
+def test_search_requires_config_block():
+    eng = make_engine()
+    with pytest.raises(RuntimeError, match="autotune"):
+        eng.autotune_search()
+
+
+# ---------------------------------------------------------------------------
+# allreduce_gradients rebucket under the active overlap (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_midrun_rebucket_under_overlap_stays_bitwise():
+    """The engine.py invariant 'a mid-accumulation retune must not drop
+    already-dispatched micro gradients', exercised under the ACTIVE
+    overlap exchange: micro 1's payload is in flight when the rebucket
+    tears the plan down."""
+    batches = list(random_batches(2, batch_size=8))
+    serial = make_engine(comm={"gradient_reduction": "bucketed"}, gas=2)
+    oracle = []
+    for _ in range(4):
+        for b in batches:
+            loss = serial.forward(b)
+            serial.backward()
+        serial.step()
+        oracle.append(np.float32(float(loss)))
+
+    eng = make_engine(comm={"gradient_reduction": "bucketed",
+                            "overlap": "on"}, gas=2)
+    assert "grads" in eng._step_fns
+    old_plan = eng.bucket_plan
+    losses = []
+    for step in range(4):
+        for i, b in enumerate(batches):
+            loss = eng.forward(b)
+            eng.backward()
+            if step == 1 and i == 0:
+                # MID-ACCUMULATION: micro 1 dispatched, its exchange in
+                # flight — now shrink the buckets
+                assert eng._overlap_pending, "expected an in-flight ticket"
+                eng.allreduce_gradients(bucket_size=64)
+        eng.step()
+        losses.append(np.float32(float(loss)))
+    assert eng.bucket_plan is not old_plan
+    assert eng.bucket_plan.bucket_elems == 64
+    assert eng.bucket_plan.n_buckets > 1, "64-elem cap must split buckets"
+    # the overlap layout was rebuilt to follow the NEW plan (fp32 total
+    # payload bytes are invariant to the partition, so pin the layout
+    # identity, not the byte count) and the wire stayed engaged
+    assert "grads" in eng._step_fns
+    assert eng._overlap_payload_nbytes == eng.bucket_plan.overlap_layout[1]
+    # ...and nothing was dropped: bitwise with the serial wire
+    assert losses == oracle
+    eng.close_overlap()
+
+
+# ---------------------------------------------------------------------------
+# the online retune loop
+# ---------------------------------------------------------------------------
+
+
+def _online_cfg(ledger, slow_steps=None):
+    cfg = {"autotune": {
+        "enabled": True, "probe_steps": 1, "probe_warmup": 1,
+        "ledger_path": ledger, "min_improvement": 0.05,
+        "online": {"enabled": True, "window": 3, "baseline_steps": 3,
+                   "threshold": 1.4, "cooldown_steps": 4,
+                   "check_every": 1, "safe_only": True}}}
+    if slow_steps:
+        cfg["faults"] = {"rules": [{
+            "site": "exchange.send", "kind": "delay_ms", "delay_ms": 60,
+            "steps": list(slow_steps)}]}
+    return cfg
+
+
+def test_online_retune_exactly_once_with_loss_parity(tmp_path):
+    """An injected wire slowdown => exactly one logged online retune,
+    the swap lands on the serial wire, and the loss stream is bitwise
+    the serial oracle's — the acceptance pin, in-process."""
+    batches = list(random_batches(1, batch_size=8))
+    n_steps = 16
+    oracle = train(make_engine(comm={"gradient_reduction": "bucketed"},
+                               gas=2), n_steps, gas=2, batches=batches)
+    ledger = str(tmp_path / "autotune.jsonl")
+    extra = _online_cfg(ledger, slow_steps=range(6, n_steps + 1))
+    snap = COUNTERS.snapshot()
+    eng = make_engine(comm={"gradient_reduction": "bucketed",
+                            "overlap": "on"},
+                      gas=2, autotune=extra["autotune"],
+                      faults=extra["faults"])
+    losses = train(eng, n_steps, gas=2, batches=batches)
+    assert eng._autotuner.retunes == 1, \
+        "exactly one online retune must fire"
+    assert eng._overlap_mode is None, \
+        "the retune must swap off the degraded overlap wire"
+    assert losses == oracle, "loss parity across the swap"
+    deltas = COUNTERS.delta_since(snap)
+    assert deltas["autotune.retunes"]["calls"] == 1
+    assert deltas["autotune.swaps"]["calls"] == 1
+    events = [json.loads(ln) for ln in open(ledger) if ln.strip()]
+    kinds = [e["event"] for e in events]
+    assert kinds.count("retune") == 1 and kinds.count("swap") == 1
+    retune = next(e for e in events if e["event"] == "retune")
+    assert retune["swapped"] and retune["winner"] == "flat_fp32"
+    assert "regression" in retune["reason"]
+    eng.close_overlap()
+
+
+def test_online_quiet_run_never_retunes(tmp_path):
+    ledger = str(tmp_path / "autotune.jsonl")
+    at = _online_cfg(ledger)["autotune"]
+    # a genuinely quiet run must not retune; threshold raised so CI-box
+    # scheduling noise on ~5 ms steps can never read as "sustained"
+    at["online"] = dict(at["online"], threshold=6.0, window=4)
+    eng = make_engine(comm={"gradient_reduction": "bucketed",
+                            "overlap": "on"},
+                      gas=2, autotune=at)
+    train(eng, 12, gas=2)
+    assert eng._autotuner.retunes == 0
+    assert not os.path.exists(ledger)
+    eng.close_overlap()
+
+
+# ---------------------------------------------------------------------------
+# config validation
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("block,match", [
+    ({"autotune": {"probesteps": 2}}, "unknown key"),
+    ({"autotune": {"probe_steps": 0}}, "probe_steps"),
+    ({"autotune": {"budget_s": -1}}, "budget_s"),
+    ({"autotune": {"min_improvement": 1.5}}, "min_improvement"),
+    ({"autotune": {"wire_dtypes": ["fp99"]}}, "wire_dtypes"),
+    ({"autotune": {"bucket_sizes": [0]}}, "bucket_sizes"),
+    ({"autotune": {"cache_path": 7}}, "cache_path"),
+    ({"autotune": {"online": {"treshold": 2}}}, "unknown key"),
+    ({"autotune": {"online": {"threshold": 0.9}}}, "threshold"),
+    ({"autotune": {"online": {"window": 0}}}, "window"),
+    ({"autotune": {"online": {"exposed_threshold_ms": -1}}}, "exposed"),
+])
+def test_config_validation(block, match):
+    from deepspeed_tpu.runtime.config import DeepSpeedAutotuneConfig
+
+    with pytest.raises(ValueError, match=match):
+        DeepSpeedAutotuneConfig(block)
+
+
+def test_config_defaults_off():
+    from deepspeed_tpu.runtime.config import DeepSpeedAutotuneConfig
+
+    cfg = DeepSpeedAutotuneConfig({})
+    assert not cfg.enabled and not cfg.online_enabled
+    eng = make_engine()
+    assert eng._autotuner is None
+
+
+# ---------------------------------------------------------------------------
+# ledger -> report
+# ---------------------------------------------------------------------------
+
+
+def test_search_ledger_renders_in_report(tmp_path):
+    from deepspeed_tpu.monitor.report import load_run, render_markdown
+
+    mdir = str(tmp_path / "mon")
+    eng = make_engine(autotune=dict(_SEARCH_AT), monitor_dir=mdir)
+    train(eng, 2)
+    eng.autotune_search(candidates=_live(["implicit", "flat_fp32"]))
+    train(eng, 1)
+    eng.finalize_monitoring()
+    run_dir = os.path.join(mdir, "at")
+    assert os.path.exists(os.path.join(run_dir, "autotune.jsonl"))
+    run = load_run(run_dir)
+    assert run["autotune"], "the ledger must load with the run"
+    md = render_markdown(run)
+    assert "## Autotune" in md and "candidate probes" in md
+    assert "`autotune.probes`" not in md, \
+        "autotune.* must stay out of the comm byte table"
+
+
+# ---------------------------------------------------------------------------
+# bench dry-run lane
+# ---------------------------------------------------------------------------
+
+
+def _import_tool(name):
+    import importlib
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "tools"))
+    try:
+        return importlib.import_module(name)
+    finally:
+        sys.path.pop(0)
+
+
+def test_autotune_bench_run_dry(tmp_path):
+    bench = _import_tool("autotune_bench")
+    result = bench.run_dry(str(tmp_path), seed=0)
+    syn = result["synthetic"]
+    # deterministic winner for the fixed seed, from the compressed-
+    # overlapped-hierarchical corner the surface (and the hardware)
+    # favors; pinned == the surface argmin
+    cands, _ = generate_candidates(
+        dp=8, stage=0, wire_dtypes=("fp32", "bf16", "int8", "int4"),
+        inner_dtypes=(None, "int8"))
+    expected = min(cands,
+                   key=lambda c: bench.synthetic_cost_ms(c, seed=0)).name
+    assert syn["winner"] == expected
+    assert "overlap" in syn["winner"] and "hier" in syn["winner"]
+    assert syn["rejected"] > 0
+    assert result["engine"]["cached_second_search"] is True
+    assert os.path.exists(os.path.join(
+        str(tmp_path), os.path.basename(result["artifact"])))
+
+
+@pytest.mark.slow
+def test_autotune_bench_2proc_tcp(tmp_path):
+    """The acceptance lane over REAL processes (gloo/TCP): the search
+    starting from the naive default must land within 10% of the
+    hand-tuned round-13 recipe (asserted inside the bench on every
+    rank), and the injected wire slowdown must trigger exactly one
+    online retune with bitwise loss parity.  The driver re-checks the
+    headline numbers from the printed table."""
+    import subprocess
+    import sys
+
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "autotune_bench.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, tool, "--nproc", "2", "--steps", "3",
+         "--seq", "32", "--no-record"],
+        capture_output=True, text=True, timeout=2400,
+        cwd=str(tmp_path), env=env)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    line = next(ln for ln in out.stdout.splitlines()
+                if ln.startswith("{") and "metric" in ln)
+    r = json.loads(line)
+    assert r["metric"] == "autotune_2proc_tcp"
+    assert r["search"]["winner_vs_hand_tuned"] <= 1.10
+    assert r["search"]["speedup_vs_naive"] >= 1.0
+    assert r["retune"]["retunes"] == 1
+    assert r["retune"]["swapped_to_serial"] is True
+    assert r["retune"]["loss_bitwise_vs_serial_oracle"] is True

@@ -93,6 +93,14 @@ def selftest() -> int:
             COUNTERS.add("moe.a2a_exposed_ms", 1200, calls=1)
             COUNTERS.add("moe.dropped_tokens", 5, calls=2)
             COUNTERS.add("moe.capacity_frac", 750_000, calls=1)
+            # the self-tuning runtime (runtime/autotune/): probe µs in
+            # the bytes slot, cache/swap/retune counts — rendered as
+            # the "Autotune" section, never comm byte rows
+            COUNTERS.add("autotune.probes", 420_000, calls=3)
+            COUNTERS.add("autotune.cache_hits", calls=1)
+            COUNTERS.add("autotune.rejected", calls=2)
+            COUNTERS.add("autotune.retunes", calls=1)
+            COUNTERS.add("autotune.swaps", calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -132,6 +140,26 @@ def selftest() -> int:
                 "dead_ranks": [], "backoff_s": 5.0,
                 "from_world": 3, "to_world": 4, "transition": "regrow",
                 "incarnation": 3,
+            }) + "\n")
+        # an autotune ledger beside the event streams (runtime/
+        # autotune/runtime.py) renders as the "Autotune" event table
+        with open(os.path.join(root, "selftest", "autotune.jsonl"),
+                  "w") as f:
+            f.write(_json.dumps({
+                "t": 0.0, "event": "search", "step": 1, "probes": 3,
+                "baseline_ms": 12.5, "fingerprint": "abcd1234",
+            }) + "\n")
+            f.write(_json.dumps({
+                "t": 1.0, "event": "retune", "step": 2,
+                "reason": "step time regression: 30.0 ms/step > 1.50 x "
+                          "baseline 12.5 ms",
+                "incumbent": "flat_fp32_overlap", "probes": 2,
+                "swapped": True, "winner": "flat_fp32",
+            }) + "\n")
+            f.write(_json.dumps({
+                "t": 1.5, "event": "swap", "step": 2,
+                "candidate": "flat_fp32",
+                "reason": "online retune: exposed wire creep",
             }) + "\n")
         # a serving-bench lane table (tools/serve_bench.py serving.json)
         # renders as the "Serving bench" table beside the training
@@ -186,7 +214,14 @@ def selftest() -> int:
                        "MoE wire (expert all-to-all)",
                        "a2a wire bytes", "slow-fabric (inter-group) share",
                        "exposed a2a time", "tokens dropped at capacity",
-                       "mean expert-bucket utilisation | 75.0%"):
+                       "mean expert-bucket utilisation | 75.0%",
+                       "## Autotune", "candidate probes",
+                       "winner-cache hits (zero probes)",
+                       "candidates pruned by config validators",
+                       "online retunes (sustained regression)",
+                       "live config swaps applied",
+                       "swapped to `flat_fp32`",
+                       "online retune: exposed wire creep"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
@@ -208,6 +243,9 @@ def selftest() -> int:
         assert "`moe.a2a_bytes`" not in md and \
             "`moe.capacity_frac`" not in md, \
             "moe.* rows must not leak into the comm table"
+        assert "`autotune.probes`" not in md and \
+            "`autotune.swaps`" not in md, \
+            "autotune.* rows must not leak into the comm table"
         # serving.json alone must render without event streams (the
         # serve-bench run-dir shape)
         import shutil as _shutil
