@@ -40,8 +40,13 @@ def _block_with_cache(p, cfg, x, ck, cv, pos):
     L = ck.shape[1]
     h = layer_norm(x, p["ln1"], cfg.layer_norm_eps)
     q, k, v = _split_qkv(h, p["attn"]["qkv"], B, T, H, Dh)
-    ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+    # cast to the cache dtype on write (identity when they agree): a
+    # bf16 cache under fp32 params stores rounded K/V, mirroring the
+    # serving engine's kv_dtype="bf16" dense store bit-for-bit
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, pos, 0, 0))
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         ck.astype(jnp.float32)) * (Dh ** -0.5)
     q_idx = pos + jnp.arange(T)[:, None]
@@ -85,11 +90,13 @@ def _init_caches(model: GPT, B, L, dtype):
     return [(z(), z()) for _ in range(cfg.num_layers)]
 
 
-@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8))
+@partial(jax.jit, static_argnums=(0, 3, 5, 6, 7, 8, 9))
 def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
-                  cache_len, top_k, top_p):
+                  cache_len, top_k, top_p, cache_dtype=None):
     B, T = prompt.shape
-    caches = _init_caches(model, B, cache_len, params["wte"].dtype)
+    caches = _init_caches(
+        model, B, cache_len,
+        params["wte"].dtype if cache_dtype is None else cache_dtype)
     logits, caches = _forward_cached(model, params, prompt, caches, 0)
 
     flat, treedef = jax.tree_util.tree_flatten(caches)
@@ -147,12 +154,15 @@ def _generate_jit(model, params, prompt, max_new_tokens, rng, temperature,
 def generate(model: GPT, params, prompt, max_new_tokens: int,
              temperature: float = 0.0, rng: Optional[jax.Array] = None,
              cache_len: Optional[int] = None, top_k: int = 0,
-             top_p: float = 1.0):
+             top_p: float = 1.0, cache_dtype=None):
     """Generate continuations. prompt [B, T] int32; returns
     [B, max_new_tokens]. temperature 0 = greedy; otherwise categorical
     sampling with `rng`, optionally truncated to the top_k highest
     logits and/or the top_p nucleus (HF-style semantics: k first, then
-    p). The model's dropout must be 0 (inference)."""
+    p). The model's dropout must be 0 (inference).  `cache_dtype`
+    overrides the KV cache's storage dtype (default: the param dtype);
+    a bf16 cache under fp32 params is the oracle for the serving
+    engine's kv_dtype="bf16" parity pin."""
     cfg = model.config
     if cfg.num_experts > 1 or cfg.pipeline_stages > 1:
         raise NotImplementedError(
@@ -173,6 +183,9 @@ def generate(model: GPT, params, prompt, max_new_tokens: int,
     if top_k < 0 or not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_k must be >= 0 and 0 < top_p <= 1, got "
                          f"{top_k}, {top_p}")
+    if cache_dtype is not None:
+        # canonicalize to a hashable np.dtype for the static argnum
+        cache_dtype = jnp.zeros((), cache_dtype).dtype
     return _generate_jit(model, params, jnp.asarray(prompt),
                          int(max_new_tokens), rng, float(temperature),
-                         int(L), int(top_k), float(top_p))
+                         int(L), int(top_k), float(top_p), cache_dtype)

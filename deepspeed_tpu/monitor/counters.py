@@ -78,7 +78,19 @@ Instrumented sites:
   paged-KV occupancy sampled once per engine step (mean =
   bytes/calls); `kv.evictions` — KV blocks FORCIBLY reclaimed from
   shed/errored requests (natural completion frees blocks without
-  counting here — a healthy run keeps this at zero).
+  counting here — a healthy run keeps this at zero).  Speculative
+  decoding (rendered as the section's "Speculative decoding" rows):
+  `serve.draft_tokens` — draft candidates proposed to the verify
+  program (calls); `serve.accepted_tokens`
+  — drafts accepted AND emitted (calls; a draft accepted by verify but
+  cut by max_new/EOS does not count — the counter is the exact number
+  of extra tokens speculation bought, so accepted/decode_steps is the
+  bonus tokens-per-step and accepted/draft is the acceptance rate);
+  `kv.dequant_ms` — µs-in-bytes (the ckpt.stall_ms convention): wall
+  time of decode-family dispatches against a QUANTIZED kv cache (XLA
+  fuses the row dequant into the attention gather, so the cost is only
+  isolable by A/B against a dense lane — serve_bench does exactly
+  that); zero when kv_dtype is dense.
 * the MoE wire (`moe.*`, moe/dispatch.py sorted dispatch + explicit
   expert all-to-all; rendered by monitor/report.py as the "MoE wire"
   section, excluded from the comm byte table).  Recorded per EXECUTION

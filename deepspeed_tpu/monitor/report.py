@@ -359,6 +359,31 @@ def render_markdown(run: Dict[str, Any]) -> str:
         if shed:
             lines.append(f"| requests shed (wedged decode) | "
                          f"{shed['calls']:,} |")
+        # speculative decoding (serve.draft_tokens/accepted_tokens,
+        # kv.dequant_ms) — rendered as sub-rows of the same table
+        drafts = serve_counters.get("serve.draft_tokens")
+        acc = serve_counters.get("serve.accepted_tokens")
+        dq = serve_counters.get("kv.dequant_ms")
+        if drafts or acc or dq:
+            lines.append("| **Speculative decoding** | |")
+            if drafts:
+                rate = (f" ({acc['calls'] / drafts['calls']:.0%} accepted)"
+                        if acc and drafts["calls"] else "")
+                lines.append(f"| draft tokens proposed | "
+                             f"{drafts['calls']:,}{rate} |")
+            if acc:
+                per = ""
+                if dec and dec["calls"]:
+                    per = (f" (+{acc['calls'] / dec['calls']:.2f} bonus "
+                           f"tokens/step)")
+                lines.append(f"| draft tokens accepted | "
+                             f"{acc['calls']:,}{per} |")
+            if dq and dq["calls"]:
+                total_ms = dq["bytes"] / 1000.0  # stored as integer µs
+                lines.append(f"| quantized-KV decode dispatch | "
+                             f"{total_ms:,.1f} ms total over "
+                             f"{dq['calls']:,} dispatches "
+                             f"({total_ms / dq['calls']:.2f} ms each) |")
         lines.append("")
 
     # serving-bench lane table (serving.json from tools/serve_bench.py)
@@ -392,6 +417,18 @@ def render_markdown(run: Dict[str, Any]) -> str:
                 f"{_fmt(kvb.get('mean'))} / {_fmt(kvb.get('peak'), 0)} "
                 f"(cap {_fmt(kvb.get('capacity'), 0)}) | "
                 f"{lane.get('shed', 0)} |")
+        spec_lanes = {n: l for n, l in sv["lanes"].items()
+                      if l.get("accepted_per_step") is not None}
+        if spec_lanes:
+            lines.append("")
+            lines.append("Speculative decoding lanes (extra accepted "
+                         "draft tokens per decode step):")
+            for name in sorted(spec_lanes):
+                lane = spec_lanes[name]
+                lines.append(f"- {name}: "
+                             f"+{lane['accepted_per_step']:.2f} tok/step "
+                             f"(kv {lane.get('kv_dtype', 'dense')}, "
+                             f"draft {lane.get('draft_len', 0)})")
         cont = sv["lanes"].get("continuous")
         stat = sv["lanes"].get("static")
         if cont and stat and cont.get("tokens_per_sec") and \

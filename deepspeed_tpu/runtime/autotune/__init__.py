@@ -41,12 +41,14 @@ excluded from the comm byte table and rendered as the report's
 from .cache import WinnerCache
 from .driver import ProbeResult, SearchDriver, combine_score
 from .fingerprint import (engine_fingerprint, fingerprint_diff,
-                          make_fingerprint)
+                          make_fingerprint, serve_fingerprint)
 from .online import RegressionDetector
 from .probe import EngineProber
 from .runtime import AutotuneRuntime
-from .space import (Candidate, current_candidate, generate_candidates,
-                    knob_distance, neighborhood)
+from .space import (Candidate, current_candidate,
+                    current_serve_candidate, generate_candidates,
+                    generate_serve_candidates, knob_distance,
+                    neighborhood)
 
 __all__ = [
     "AutotuneRuntime",
@@ -58,10 +60,13 @@ __all__ = [
     "WinnerCache",
     "combine_score",
     "current_candidate",
+    "current_serve_candidate",
     "engine_fingerprint",
     "fingerprint_diff",
     "generate_candidates",
+    "generate_serve_candidates",
     "knob_distance",
     "make_fingerprint",
     "neighborhood",
+    "serve_fingerprint",
 ]

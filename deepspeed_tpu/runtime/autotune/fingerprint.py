@@ -58,6 +58,36 @@ def _model_section(params) -> Dict[str, Any]:
             "shapes": shape_hash.hexdigest()[:16]}
 
 
+def serve_fingerprint(engine) -> Dict[str, Any]:
+    """Fingerprint a live ServeEngine for the serve-scope winner cache:
+    model shape, serving geometry (pool/block/batch sizing), the KV
+    storage + speculation knobs being probed, and the fabric.  Same
+    contract as `engine_fingerprint`: a cached serve winner is only
+    trustworthy for the exact (model, geometry, fabric) it was lapped
+    on — a different block size or device kind re-probes loudly."""
+    import jax
+
+    c = engine.config
+    devices = jax.devices()
+    return make_fingerprint(
+        model=_model_section(engine.params),
+        geometry={"block_size": c.block_size,
+                  "num_blocks": c.num_blocks,
+                  "max_batch": c.max_batch,
+                  "prefill_chunk": c.prefill_chunk,
+                  "max_seq_len": engine.max_seq_len,
+                  "admission": c.admission},
+        serving={"kv_dtype": engine.kv.quant_wire or
+                 (str(c.kv_dtype) if c.kv_dtype is not None else "dense"),
+                 "draft_len": int(c.draft_len),
+                 "spec_ngram": int(c.spec_ngram),
+                 "quantized_weights": c.quant_mode},
+        fabric={"backend": jax.default_backend(),
+                "device_kind": devices[0].device_kind if devices else "?",
+                "devices": len(devices)},
+    )
+
+
 def engine_fingerprint(engine) -> Dict[str, Any]:
     """Fingerprint a live engine: model shape (leaf shapes/dtypes),
     batch geometry, precision/stage (the dtype config), the mesh layout

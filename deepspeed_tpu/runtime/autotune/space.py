@@ -20,6 +20,13 @@ Candidate scopes:
           (engine.allreduce_gradients documents the same boundary), so
           hierarchy mutations only probe through an engine factory
           (tools/autotune_bench.py) and never online.
+  serve   inference-side knobs (KV cache storage dtype, speculative
+          draft length) for a ServeEngine.  The `comm` field carries a
+          "serving"-block fragment instead, validated through the REAL
+          `DeepSpeedServingConfig` by `generate_serve_candidates`; every
+          serve candidate needs a fresh ServeEngine (the KV pool layout
+          and the verify program are compile-time), so tools/serve_bench
+          is the probe harness, never the online loop.
 
 `safe_numerics`: True when swapping to the candidate preserves the
 repo's bitwise loss contract on this fabric — every wire level fp32
@@ -39,20 +46,32 @@ _KNOB_FIELDS = ("gradient_reduction", "wire_dtype", "wire_dtype_inner",
                 "wire_dtype_outer", "hierarchy", "overlap",
                 "reduce_bucket_size", "quant_block_size")
 
+# the serve scope's knob fields (Candidate.comm carries a "serving"
+# fragment there; see generate_serve_candidates)
+_SERVE_KNOB_FIELDS = ("kv_dtype", "draft_len")
+
 
 class Candidate(NamedTuple):
     """One point in the legal config space."""
 
     name: str
     comm: Dict            # "comm"-block fragment the engine applies
+    #                       ("serving" fragment when scope == "serve")
     stage: int = 0        # ZeRO stage the legality check ran against
-    scope: str = "live"   # "live" | "engine" (see module docstring)
+    scope: str = "live"   # "live" | "engine" | "serve" (module docstring)
     safe_numerics: bool = True
 
     def knobs(self) -> Dict:
         """Comparable knob view (absent keys normalized) — the
         neighborhood distance and ledger entries read this."""
         c = self.comm
+        if self.scope == "serve":
+            spec = c.get("speculative") or {}
+            return {
+                "kv_dtype": c.get("kv_dtype") or "dense",
+                "draft_len": (int(spec.get("draft_len", 0))
+                              if spec.get("enabled") else 0),
+            }
         hier = c.get("hierarchy", "none")
         if isinstance(hier, dict):
             hier = hier.get("outer", 1)
@@ -69,6 +88,11 @@ class Candidate(NamedTuple):
 
     def describe(self) -> str:
         k = self.knobs()
+        if self.scope == "serve":
+            parts = [f"kv {k['kv_dtype']}"]
+            if k["draft_len"]:
+                parts.append(f"spec draft {k['draft_len']}")
+            return f"{self.name}: " + ", ".join(parts)
         parts = [k["gradient_reduction"]]
         if k["gradient_reduction"] == "bucketed":
             if k["hierarchy"] not in ("none", 1):
@@ -94,7 +118,12 @@ def knob_distance(a: Candidate, b: Candidate) -> int:
     """How many knob fields differ between two candidates.  Optional
     knobs compare as equal when either side leaves them unspecified
     (None = inherit)."""
+    if (a.scope == "serve") != (b.scope == "serve"):
+        # train-side and serve-side candidates live in disjoint spaces
+        return len(_KNOB_FIELDS) + len(_SERVE_KNOB_FIELDS)
     ka, kb = a.knobs(), b.knobs()
+    if a.scope == "serve":
+        return sum(1 for f in _SERVE_KNOB_FIELDS if ka[f] != kb[f])
     dist = 0
     for f in _KNOB_FIELDS:
         if f in _OPTIONAL_KNOBS and (ka[f] is None or kb[f] is None):
@@ -243,6 +272,74 @@ def generate_candidates(
                                 add("bucketed", flat_wire, inner,
                                     outer_dtype, hier, ov, bucket, block)
     return out, rejected
+
+
+def _serve_fragment(kv_dtype, draft_len: int) -> Dict:
+    """The "serving"-block fragment a (kv_dtype, draft_len) point maps
+    to — the exact dict a user would write under "serving" in their
+    config, so validating it validates the real surface."""
+    frag: Dict = {"kv_dtype": kv_dtype}
+    if draft_len > 0:
+        frag["speculative"] = {"enabled": True,
+                               "draft_len": int(draft_len)}
+    else:
+        frag["speculative"] = {"enabled": False}
+    return frag
+
+
+def generate_serve_candidates(
+        head_dim: int,
+        kv_dtypes: Sequence[Optional[str]] = (None, "bf16", "int8",
+                                              "int4"),
+        draft_lens: Sequence[int] = (0, 2, 4),
+) -> Tuple[List[Candidate], int]:
+    """Enumerate the serve-scope candidate set: the cartesian product
+    of KV storage modes and speculative draft lengths, each composition
+    run through the REAL `DeepSpeedServingConfig` validator (same
+    pruning contract as the comm space: a typo'd dtype or a negative
+    draft_len is rejected and counted, never probed).  `head_dim` gates
+    int4 — the packed nibble payload needs an even head_dim, so int4
+    points are pruned (and counted rejected) on odd-head_dim models,
+    mirroring PagedKVCache's own constructor check.
+
+    `safe_numerics` is True only for kv_dtype None/"fp32" (bit-exact
+    vs `generate()`); draft_len alone never flips it — speculation is
+    token-identical at matched kv_dtype by construction, it changes
+    WHEN tokens arrive, never WHICH."""
+    from ..config import DeepSpeedServingConfig
+
+    out: List[Candidate] = []
+    rejected = 0
+    for kv in kv_dtypes:
+        for draft in draft_lens:
+            if kv == "int4" and int(head_dim) % 2 != 0:
+                rejected += 1
+                continue
+            frag = _serve_fragment(kv, int(draft))
+            try:
+                DeepSpeedServingConfig({"serving": frag})
+            except ValueError:
+                rejected += 1
+                continue
+            name = f"serve_{kv or 'dense'}_d{int(draft)}"
+            out.append(Candidate(
+                name=name, comm=frag, scope="serve",
+                safe_numerics=kv in (None, "fp32", "float32")))
+    return out, rejected
+
+
+def current_serve_candidate(engine) -> Candidate:
+    """The serve candidate describing a live ServeEngine's config —
+    the baseline a serve-scope sweep measures lanes against."""
+    c = engine.config
+    kv = engine.kv.quant_wire  # "int8"/"int4" or None (dense)
+    if kv is None and c.kv_dtype is not None:
+        kv = str(c.kv_dtype)
+    frag = _serve_fragment(kv, int(c.draft_len))
+    return Candidate(
+        name=f"serve_{kv or 'dense'}_d{int(c.draft_len)}",
+        comm=frag, scope="serve",
+        safe_numerics=kv in (None, "fp32", "float32"))
 
 
 def current_candidate(engine) -> Candidate:

@@ -12,6 +12,11 @@ This module owns the jittable kernels both ride:
 * `dequantize_blockwise`  the inverse; accepts arbitrary leading batch
   dims (gathered payloads arrive as [world, nblocks, ...]) and slices
   the block padding back off.
+* `quantize_rows` / `dequantize_rows`  the row-wise variant the paged
+  KV cache stores blocks through (serving/kv_cache.py): one fp16 scale
+  per trailing-axis row, no padding — a scatter of N rows into a block
+  pool stays row-local, which is what keeps quantized KV writes as
+  cheap as dense ones.
 * `payload_bytes` / `padded_elems`  EXACT wire-byte accounting
   (payload + scales), consumed by BucketPlan and the qwZ gather so the
   `grad_wire.*` / `qwz.*` counters prove the compression instead of
@@ -174,6 +179,63 @@ def dequantize_blockwise(payload, scales, wire: str, n_elems: int):
     vals = jnp.where(codes == marker, jnp.float32(jnp.nan), vals)
     flat = vals.reshape(vals.shape[:-2] + (-1,))
     return flat[..., :n_elems]
+
+
+def quantize_rows(x, wire: str = "int8"):
+    """Row-wise variant for the serving KV cache: quantize the TRAILING
+    axis of `x` [..., D] with ONE fp16 scale per leading-index row —
+    (codes int8 [..., D] | packed uint8 [..., D // 2], scales fp16
+    [...]).  No padding: the row IS the block, so a scatter of N rows
+    into a larger pool stays row-local (payload.at[idx] + scales.at[idx]
+    touch exactly the written rows, never a neighbour's scale).
+
+    Same range semantics as `quantize_blockwise` (subnormal flush before
+    the amax, the -qmax-1 marker for non-finites, the fp16-rounded scale
+    doubling as the quantization scale so encode/decode agree
+    bit-for-bit).  "int4" packs two codes per byte low-nibble-first and
+    requires an even trailing axis.
+    """
+    q = qmax(wire)
+    marker = -q - 1
+    d = x.shape[-1]
+    if q != 127 and d % 2:
+        raise ValueError(
+            f"int4 row quantization needs an even trailing axis "
+            f"(two codes per byte), got {d}")
+    f32 = _flush_subnormals(x.astype(jnp.float32))
+    finite = jnp.isfinite(f32)
+    amax = jnp.max(jnp.where(finite, jnp.abs(f32), 0.0), axis=-1)
+    scales = (amax / q).astype(jnp.float16)
+    eff = scales.astype(jnp.float32)[..., None]
+    inv = jnp.where((eff > 0) & jnp.isfinite(eff), 1.0 / eff, 0.0)
+    codes = jnp.clip(jnp.round(f32 * inv), -q, q).astype(jnp.int8)
+    codes = jnp.where(finite, codes, jnp.int8(marker))
+    if q == 127:
+        return codes, scales
+    u = codes.astype(jnp.uint8) & jnp.uint8(0x0F)
+    packed = u[..., 0::2] | (u[..., 1::2] << 4)
+    return packed, scales
+
+
+def dequantize_rows(payload, scales, wire: str):
+    """Inverse of `quantize_rows`: (payload [..., D | D // 2], scales
+    [...]) -> fp32 [..., D].  Marker codes reconstruct as NaN (the
+    blockwise contract); an all-zero row round-trips exactly (scale 0,
+    codes 0)."""
+    q = qmax(wire)
+    marker = -q - 1
+    if q == 127:
+        codes = payload.astype(jnp.int8)
+    else:
+        lo = (payload & jnp.uint8(0x0F)).astype(jnp.int8)
+        hi = ((payload >> 4) & jnp.uint8(0x0F)).astype(jnp.int8)
+        lo = jnp.where(lo > 7, lo - 16, lo)
+        hi = jnp.where(hi > 7, hi - 16, hi)
+        codes = jnp.stack([lo, hi], axis=-1).reshape(
+            payload.shape[:-1] + (payload.shape[-1] * 2,))
+    vals = codes.astype(jnp.float32) * \
+        scales.astype(jnp.float32)[..., None]
+    return jnp.where(codes == marker, jnp.float32(jnp.nan), vals)
 
 
 def pack_wire(payload, scales):

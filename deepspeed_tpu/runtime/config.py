@@ -622,6 +622,89 @@ class DeepSpeedAutotuneConfig(DeepSpeedConfigObject):
             c.AUTOTUNE_ONLINE_SAFE_ONLY_DEFAULT))
 
 
+# accepted serving.kv_dtype spellings; must stay a superset of what
+# serving.kv_cache.resolve_kv_dtype() resolves (kept local so the
+# training-side config never imports the jax-heavy serving package)
+SERVING_KV_DTYPES = ("bf16", "bfloat16", "fp16", "float16", "fp32",
+                     "float32", "int8", "int4")
+
+
+class DeepSpeedServingConfig(DeepSpeedConfigObject):
+    """Inference-side knobs (deepspeed_tpu.serving).
+
+    "serving": {"kv_dtype": null,
+                "speculative": {"enabled": false, "draft_len": 4,
+                                "ngram": 3}}
+
+    `kv_dtype` selects the paged KV cache's storage mode: null stores
+    at the param dtype; "bf16"/"fp16"/"fp32" store dense at that dtype;
+    "int8"/"int4" store per-(row, head) quantized payload + fp16 scale
+    pairs (runtime/comm/quant.py row kernels).  `speculative.enabled`
+    arms self-speculative n-gram decoding: `draft_len` candidate tokens
+    drafted host-side per verify step by an `ngram`-suffix match over
+    the request's own context (no extra model).  Every knob is
+    validated HERE so a typo fails at config time, not mid-serve; the
+    autotuner's "serve" scope re-validates its candidate fragments
+    through this class so the search space can never propose an
+    illegal config."""
+
+    def __init__(self, param_dict):
+        super().__init__()
+        d = param_dict.get(c.SERVING) or {}
+        known = {c.SERVING_KV_DTYPE, c.SERVING_SPECULATIVE}
+        unknown = set(d) - known
+        if unknown:
+            raise ValueError(
+                f"serving: unknown key(s) {sorted(unknown)}; expected a "
+                f"subset of {sorted(known)}")
+        kv = get_scalar_param(d, c.SERVING_KV_DTYPE,
+                              c.SERVING_KV_DTYPE_DEFAULT)
+        if kv is not None:
+            if not isinstance(kv, str) or \
+                    kv.lower() not in SERVING_KV_DTYPES:
+                raise ValueError(
+                    f"serving.{c.SERVING_KV_DTYPE} must be null or one of "
+                    f"{SERVING_KV_DTYPES}, got {kv!r}")
+            kv = kv.lower()
+        self.kv_dtype = kv
+
+        s = d.get(c.SERVING_SPECULATIVE) or {}
+        known_s = {c.SERVING_SPEC_ENABLED, c.SERVING_SPEC_DRAFT_LEN,
+                   c.SERVING_SPEC_NGRAM}
+        unknown = set(s) - known_s
+        if unknown:
+            raise ValueError(
+                f"serving.{c.SERVING_SPECULATIVE}: unknown key(s) "
+                f"{sorted(unknown)}; expected a subset of {sorted(known_s)}")
+        self.spec_enabled = bool(get_scalar_param(
+            s, c.SERVING_SPEC_ENABLED, c.SERVING_SPEC_ENABLED_DEFAULT))
+
+        def spec_int(key, default, minimum=1):
+            v = get_scalar_param(s, key, default)
+            if isinstance(v, bool) or not isinstance(v, int) or v < minimum:
+                raise ValueError(
+                    f"serving.speculative.{key} must be an int >= "
+                    f"{minimum}, got {v!r}")
+            return int(v)
+
+        self.spec_draft_len = spec_int(c.SERVING_SPEC_DRAFT_LEN,
+                                       c.SERVING_SPEC_DRAFT_LEN_DEFAULT)
+        self.spec_ngram = spec_int(c.SERVING_SPEC_NGRAM,
+                                   c.SERVING_SPEC_NGRAM_DEFAULT)
+
+    def to_serve_kwargs(self):
+        """The ServeConfig fragment this block selects: feed as
+        `ServeConfig(**cfg.serving_config.to_serve_kwargs(), ...)`.
+        Disabled speculation maps to draft_len=0 (the engine's plain
+        decode path), not a missing key, so the serve-scope autotuner
+        can diff candidate fragments field-for-field."""
+        return {
+            "kv_dtype": self.kv_dtype,
+            "draft_len": self.spec_draft_len if self.spec_enabled else 0,
+            "spec_ngram": self.spec_ngram,
+        }
+
+
 def get_fp16_enabled(param_dict):
     return get_scalar_param(param_dict.get(c.FP16, {}), c.FP16_ENABLED,
                             c.FP16_ENABLED_DEFAULT)
@@ -768,6 +851,11 @@ class DeepSpeedConfig(DeepSpeedConfigObject):
         # the self-tuning runtime (runtime/autotune/): fingerprinted
         # config search + the online retune loop
         self.autotune_config = DeepSpeedAutotuneConfig(pd)
+
+        # inference-side knobs (deepspeed_tpu.serving): KV cache storage
+        # dtype + self-speculative decoding — the autotuner's "serve"
+        # scope searches this block
+        self.serving_config = DeepSpeedServingConfig(pd)
 
         # pipeline: use_p2p_channels forces the multi-host channel
         # executor even single-process (the driver's virtual-multichip

@@ -397,6 +397,30 @@ AUTOTUNE_ONLINE_SAFE_ONLY = "safe_only"
 AUTOTUNE_ONLINE_SAFE_ONLY_DEFAULT = True
 
 #############################################
+# Serving (deepspeed_tpu.serving) — inference-side knobs the autotuner's
+# "serve" scope searches over. No reference analogue (the reference
+# inference engine arrived in later versions).
+# "serving": {
+#   "kv_dtype": null,          # null = param dtype | "bf16"|"int8"|"int4"
+#   "speculative": {
+#     "enabled": false,        # arm self-speculative n-gram decoding
+#     "draft_len": 4,          # candidate tokens per verify step
+#     "ngram": 3               # suffix-match length of the host drafter
+#   }
+# }
+#############################################
+SERVING = "serving"
+SERVING_KV_DTYPE = "kv_dtype"
+SERVING_KV_DTYPE_DEFAULT = None
+SERVING_SPECULATIVE = "speculative"
+SERVING_SPEC_ENABLED = "enabled"
+SERVING_SPEC_ENABLED_DEFAULT = False
+SERVING_SPEC_DRAFT_LEN = "draft_len"
+SERVING_SPEC_DRAFT_LEN_DEFAULT = 4
+SERVING_SPEC_NGRAM = "ngram"
+SERVING_SPEC_NGRAM_DEFAULT = 3
+
+#############################################
 # TPU-specific additions (no reference analogue)
 #############################################
 MESH = "mesh"  # {"data": -1, "model": 1, "pipe": 1, "seq": 1}

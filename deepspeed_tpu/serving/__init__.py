@@ -9,16 +9,18 @@ docs/tutorials/serving.md.
 """
 
 from .engine import ServeConfig, ServeEngine, ServeWorker
-from .kv_cache import TRASH_BLOCK, PagedKVCache
-from .programs import (ServeProgramBuilder, ServeSchedule,
+from .kv_cache import (KV_QUANT_WIRES, TRASH_BLOCK, PagedKVCache,
+                       kv_block_bytes, resolve_kv_dtype)
+from .programs import (KV_MODES, ServeProgramBuilder, ServeSchedule,
                        dequantize_params, quantize_params, sample_token)
 from .scheduler import (ADMISSION_POLICIES, ERROR, FINISHED, PREFILL,
                         RUNNING, WAITING, Request, Scheduler)
 
 __all__ = [
     "ServeConfig", "ServeEngine", "ServeWorker", "PagedKVCache",
-    "TRASH_BLOCK", "ServeProgramBuilder", "ServeSchedule", "sample_token",
-    "quantize_params", "dequantize_params", "Request", "Scheduler",
-    "ADMISSION_POLICIES", "WAITING", "PREFILL", "RUNNING", "FINISHED",
-    "ERROR",
+    "TRASH_BLOCK", "KV_QUANT_WIRES", "KV_MODES", "kv_block_bytes",
+    "resolve_kv_dtype", "ServeProgramBuilder", "ServeSchedule",
+    "sample_token", "quantize_params", "dequantize_params", "Request",
+    "Scheduler", "ADMISSION_POLICIES", "WAITING", "PREFILL", "RUNNING",
+    "FINISHED", "ERROR",
 ]

@@ -29,6 +29,21 @@ Counters (monitor/counters.py "Serving" section): `serve.requests`
 `serve.prefill_chunks` (bytes = prompt tokens prefetched),
 `serve.ttft_ms` (µs in the bytes slot, the ckpt.stall_ms convention),
 `serve.shed`, plus `kv.blocks_in_use` / `kv.evictions` from the cache.
+Speculative decoding adds `serve.draft_tokens` (candidates proposed),
+`serve.accepted_tokens` (drafts accepted AND emitted — the
+acceptance-rate numerator; accepted/decode_steps is the extra
+tokens/step speculation bought), and `kv.dequant_ms` (µs-in-bytes:
+decode-family dispatch wall time against a QUANTIZED cache).
+
+Speculative decoding (`draft_len > 0`): each decode step becomes a
+verify step — a host-side n-gram drafter proposes up to `draft_len`
+candidates per slot from the request's own emitted tokens, the batched
+`verify` program scores all draft_len+1 positions through the paged
+cache in one dispatch, and the engine emits the longest matching
+prefix plus the target's own next token.  Because verify samples with
+the same position-keyed RNG rule as decode, output is token-identical
+to the non-speculative engine at matched kv_dtype (and to `generate()`
+at dense KV) — speculation changes WHEN tokens arrive, never WHICH.
 """
 
 from __future__ import annotations
@@ -66,7 +81,10 @@ class ServeConfig:
     admission: str = "continuous"     # "continuous" | "static"
     max_prefill_chunks_per_step: int = 1
     quantized_weights: Any = False    # False | "int8" | "int4"
-    kv_dtype: Any = None              # default: model param_dtype
+    kv_dtype: Any = None              # None (param dtype) | "bf16" |
+    #                                   "int8" | "int4" | dtype-like
+    draft_len: int = 0                # speculative candidates per step
+    spec_ngram: int = 3               # suffix n-gram the drafter matches
 
     def __post_init__(self):
         for name in ("block_size", "max_batch", "prefill_chunk",
@@ -88,6 +106,16 @@ class ServeConfig:
             raise ValueError(
                 f"serving quantized_weights must be False, 'int8' or "
                 f"'int4', got {q!r}")
+        if self.kv_dtype is not None:
+            from .kv_cache import resolve_kv_dtype
+
+            resolve_kv_dtype(self.kv_dtype)  # raises on typos, loudly
+        if int(self.draft_len) < 0:
+            raise ValueError(
+                f"serving draft_len must be >= 0, got {self.draft_len}")
+        if int(self.spec_ngram) < 1:
+            raise ValueError(
+                f"serving spec_ngram must be >= 1, got {self.spec_ngram}")
 
     @property
     def quant_mode(self) -> str:
@@ -122,13 +150,17 @@ class ServeEngine:
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim, num_blocks=c.num_blocks,
             block_size=c.block_size, table_width=table_width,
-            dtype=(c.kv_dtype or cfg.param_dtype), mesh_info=mesh_info)
+            dtype=(cfg.param_dtype if c.kv_dtype is None else c.kv_dtype),
+            mesh_info=mesh_info)
         self.scheduler = Scheduler(self.kv, c.max_batch,
-                                   admission=c.admission, clock=clock)
+                                   admission=c.admission, clock=clock,
+                                   draft_len=int(c.draft_len))
         schedule = ServeSchedule(
             max_batch=c.max_batch, prefill_chunk=c.prefill_chunk,
             block_size=c.block_size, num_blocks=c.num_blocks,
-            table_width=table_width, quantized=c.quant_mode)
+            table_width=table_width, quantized=c.quant_mode,
+            kv_dtype=(self.kv.quant_wire or "dense"),
+            draft_len=int(c.draft_len))
         if programs is None:
             programs = ServeProgramBuilder(model, schedule).build()
         elif programs["schedule"].program_key() != schedule.program_key():
@@ -152,6 +184,7 @@ class ServeEngine:
         self._seeds = np.zeros((R,), np.uint32)
         self.steps = 0
         self.peak_blocks_in_use = 0
+        self.peak_resident = 0        # max concurrent block-holding reqs
         self._shed_reason: Optional[str] = None
         self._watchdog = None
         self._worker: Optional["ServeWorker"] = None
@@ -271,6 +304,8 @@ class ServeEngine:
             self.kv.sample_occupancy()
             self.peak_blocks_in_use = max(self.peak_blocks_in_use,
                                           self.kv.blocks_in_use)
+            self.peak_resident = max(self.peak_resident,
+                                     len(self.scheduler.occupied()))
         return did
 
     def has_work(self) -> bool:
@@ -340,6 +375,10 @@ class ServeEngine:
         self._seeds[slot] = np.uint32(req.seed)
 
     def _decode_step(self, running: List[Request]) -> None:
+        if int(self.config.draft_len) > 0:
+            self._verify_step(running)
+            return
+        t0 = time.perf_counter()
         toks, caches = self.programs["decode"](
             self.params, self.kv.caches, jnp.asarray(self._tokens),
             jnp.asarray(self._positions), jnp.asarray(self._active),
@@ -347,6 +386,7 @@ class ServeEngine:
             jnp.asarray(self._topks), jnp.asarray(self._seeds))
         self.kv.caches = caches
         toks = np.asarray(toks)
+        self._record_dequant(t0)
         now = self.clock()
         COUNTERS.add("serve.decode_steps", nbytes=len(running))
         for req in running:
@@ -363,6 +403,128 @@ class ServeEngine:
             else:
                 self._tokens[slot] = tok
                 self._positions[slot] += 1
+
+    def _record_dequant(self, t0: float) -> None:
+        """`kv.dequant_ms` (µs-in-bytes): wall time of decode-family
+        dispatches against a QUANTIZED cache — the in-program
+        dequantize is XLA-fused into the attention gather, so the
+        honest measurement is the whole dispatch; A/B against the
+        dense-kv lane of the same bench isolates the dequant cost."""
+        if self.kv.quant_wire:
+            COUNTERS.add("kv.dequant_ms",
+                         nbytes=int((time.perf_counter() - t0) * 1e6))
+
+    # -- speculative decoding -----------------------------------------
+
+    def _propose_draft(self, req: Request) -> List[int]:
+        """Self-speculative n-gram draft, host-side, no extra model:
+        find the most recent EARLIER occurrence of the request's last
+        `spec_ngram` tokens in its own prompt + output and propose the
+        continuation that followed it (falling back to repeating the
+        last token).  Clamped so drafts never run past max_new_tokens
+        or the request's ALLOCATED cache rows — the verify program
+        writes candidate K/V at positions P+1..P+k, and every one of
+        those rows must be backed by a real block."""
+        c = self.config
+        P = int(self._positions[req.slot])
+        alloc_rows = len(self.kv.blocks_of(req.rid)) * self.kv.block_size
+        k = min(int(c.draft_len),
+                req.max_new_tokens - len(req.out) - 1,
+                alloc_rows - 1 - P)
+        if k <= 0:
+            return []
+        ctx = req.prompt + req.out
+        n = min(int(c.spec_ngram), len(ctx))
+        suffix = ctx[-n:]
+        # Prefer the LATEST earlier occurrence whose continuation is a
+        # full k tokens.  Once greedy output settles into a short cycle
+        # (the common repetitive-suffix case), the nearest match sits
+        # only cycle-length before the tail, so its continuation is
+        # truncated by end-of-context and the draft collapses to ~1
+        # token even at 100% acceptance.  An earlier full-window match
+        # carries the same cycle with k tokens of runway.  If every
+        # match is tail-truncated, keep the longest continuation seen.
+        best: List[int] = []
+        for j in range(len(ctx) - n - 1, -1, -1):
+            if ctx[j:j + n] == suffix:
+                d = ctx[j + n:j + n + k]
+                if len(d) >= k:
+                    return [int(t) for t in d]
+                if len(d) > len(best):
+                    best = [int(t) for t in d]
+        if best:
+            return best
+        return [int(ctx[-1])] * k
+
+    def _verify_step(self, running: List[Request]) -> None:
+        """One speculative step for every running slot: propose up to
+        draft_len candidates, score all draft_len+1 positions in ONE
+        batched target forward, accept the longest matching prefix and
+        emit the target's own sample as the bonus/correction token.
+
+        Greedy pinning: verify samples every position with the same
+        position-keyed RNG rule as sequential decode, so the emitted
+        stream is token-identical to the non-speculative engine (and,
+        at dense KV, to `generate()`) no matter how many drafts hit.
+        Rollback is a host-side rewind: rejected rows' K/V stay stale
+        in the cache but their positions are >= the rewound front, so
+        they are re-written (same scatter rows) before any later
+        query's causal mask can attend them — no scatter undo."""
+        R = self.config.max_batch
+        k = int(self.config.draft_len)
+        drafts = np.zeros((R, k), np.int32)
+        n_draft = np.zeros((R,), np.int32)
+        for req in running:
+            d = self._propose_draft(req)
+            n_draft[req.slot] = len(d)
+            if d:
+                drafts[req.slot, :len(d)] = d
+                COUNTERS.add("serve.draft_tokens", calls=len(d))
+        tokens = np.concatenate([self._tokens[:, None], drafts], axis=1)
+        t0 = time.perf_counter()
+        toks, caches = self.programs["verify"](
+            self.params, self.kv.caches, jnp.asarray(tokens),
+            jnp.asarray(self._positions), jnp.asarray(n_draft),
+            jnp.asarray(self._active), jnp.asarray(self._tables),
+            jnp.asarray(self._temps), jnp.asarray(self._topks),
+            jnp.asarray(self._seeds))
+        self.kv.caches = caches
+        toks = np.asarray(toks)                     # [R, draft_len + 1]
+        self._record_dequant(t0)
+        now = self.clock()
+        COUNTERS.add("serve.decode_steps", nbytes=len(running))
+        for req in running:
+            slot = req.slot
+            nd = int(n_draft[slot])
+            # accept while draft i matches the target's sample for the
+            # same position; the first sample past the matching prefix
+            # is the bonus (nd == m) or correction (draft rejected)
+            m = 0
+            while m < nd and int(drafts[slot, m]) == int(toks[slot, m]):
+                m += 1
+            emitted = 0
+            finished = False
+            for i in range(m + 1):
+                tok = int(toks[slot, i])
+                req.out.append(tok)
+                req.token_times.append(now)
+                req.cached_len += 1
+                emitted += 1
+                COUNTERS.add("serve.tokens")
+                if self._is_finished(req, tok):
+                    finished = True
+                    break
+            if emitted > 1:
+                # emitted - 1 DRAFT tokens were accepted and used (the
+                # final emitted token is always the target's own)
+                COUNTERS.add("serve.accepted_tokens", calls=emitted - 1)
+            if finished:
+                self._finish(req)
+                self._active[slot] = False
+                self._tables[slot] = TRASH_BLOCK
+            else:
+                self._tokens[slot] = int(toks[slot, emitted - 1])
+                self._positions[slot] += emitted
 
     def _is_finished(self, req: Request, last_tok: int) -> bool:
         if req.eos_token is not None and last_tok == req.eos_token:

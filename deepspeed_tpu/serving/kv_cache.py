@@ -23,6 +23,19 @@ sharded over the `model` axis (the same Megatron TP layout as the
 weights), so each TP rank holds its heads' share of every block and the
 gather/scatter stay local to the row dimension.
 
+Quantized storage (`dtype="int8" | "int4"`): each K/V entry becomes a
+(payload, scales) pair — int8/uint8 codes `[rows, H, Dh | Dh/2]` plus
+one fp16 scale per (row, head) through the PR-7 row kernels
+(runtime/comm/quant.py `quantize_rows`).  The scale granularity is one
+row, FINER than one cache block, so a decode scatter-write touches
+exactly its own rows' payload and scales (block-local, no
+read-modify-write of a shared block scale) and the TP head split
+shards scales `[rows, H]` alongside the payload.  The programs
+dequantize gathered rows to fp32 in-program (serving/programs.py) —
+at matched kv_dtype both the speculative and the plain decode path
+read identical quantized rows, which is what keeps the spec-decode
+parity pin exact even at int4.
+
 Block 0 is the reserved TRASH block: the allocator never hands it out,
 block tables are padded with it, and inactive decode slots write to it —
 so the jitted programs need no branches for "this slot/table entry is
@@ -48,6 +61,49 @@ import jax.numpy as jnp
 from ..monitor.counters import COUNTERS
 
 TRASH_BLOCK = 0
+
+# quantized storage modes (PR-7 kernels, runtime/comm/quant.py) — the
+# cache stores (payload, scales) per K/V entry instead of a dense array
+KV_QUANT_WIRES = ("int8", "int4")
+
+# accepted string spellings for dense kv dtypes
+_KV_DTYPE_ALIASES = {
+    "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+    "fp16": jnp.float16, "float16": jnp.float16,
+    "fp32": jnp.float32, "float32": jnp.float32,
+}
+
+
+def resolve_kv_dtype(dtype):
+    """Normalize a kv_dtype spec -> ("dense", jnp dtype) or
+    ("int8" | "int4", None).  Accepts quant-wire strings, dense dtype
+    name strings ("bf16", "float32", ...), or dtype-likes."""
+    if isinstance(dtype, str):
+        name = dtype.lower()
+        if name in KV_QUANT_WIRES:
+            return name, None
+        if name in _KV_DTYPE_ALIASES:
+            return "dense", _KV_DTYPE_ALIASES[name]
+        raise ValueError(
+            f"kv_dtype {dtype!r} not understood; use one of "
+            f"{sorted(_KV_DTYPE_ALIASES)} or {KV_QUANT_WIRES}")
+    return "dense", dtype
+
+
+def kv_block_bytes(num_layers: int, num_heads: int, head_dim: int,
+                   block_size: int, kv_dtype) -> int:
+    """Device bytes ONE block costs across all layers (K and V) — the
+    equal-pool-bytes sizing rule serve_bench's resident-sessions lanes
+    ride: int8 stores head_dim payload bytes + 2 scale bytes per
+    (row, head), int4 halves the payload."""
+    mode, dense = resolve_kv_dtype(kv_dtype)
+    if mode == "dense":
+        per_row = num_heads * head_dim * jnp.dtype(dense).itemsize
+    elif mode == "int8":
+        per_row = num_heads * (head_dim + 2)
+    else:  # int4: two codes per byte + the fp16 scale
+        per_row = num_heads * (head_dim // 2 + 2)
+    return 2 * num_layers * block_size * per_row
 
 
 class PagedKVCache:
@@ -77,7 +133,16 @@ class PagedKVCache:
         self.block_size = int(block_size)
         self.table_width = int(table_width)
         self.dtype = dtype
+        mode, dense_dtype = resolve_kv_dtype(dtype)
+        # "int8"/"int4" when blocks are stored quantized, else None
+        self.quant_wire = mode if mode in KV_QUANT_WIRES else None
+        self.dense_dtype = dense_dtype
+        if self.quant_wire == "int4" and self.head_dim % 2:
+            raise ValueError(
+                f"int4 KV packs two codes per byte and needs an even "
+                f"head_dim, got {self.head_dim}")
         self._sharding = self._kv_sharding(mesh_info)
+        self._scale_sharding = self._scale_kv_sharding(mesh_info)
         self.caches = self._init_caches()
         # block 0 reserved as trash; LIFO free list so the fragmentation
         # tests exercise immediate reuse of just-freed blocks
@@ -106,19 +171,48 @@ class PagedKVCache:
             return None
         return mesh_info.sharding(None, MODEL_AXIS, None)
 
+    def _scale_kv_sharding(self, mesh_info):
+        """Scales are [rows, H] — same head split as the payload."""
+        if self._sharding is None:
+            return None
+        from ..comm.mesh import MODEL_AXIS
+
+        return mesh_info.sharding(None, MODEL_AXIS)
+
     def _init_caches(self):
-        shape = (self.num_blocks * self.block_size, self.num_heads,
-                 self.head_dim)
-        z = lambda: jnp.zeros(shape, self.dtype)
-        if self._sharding is not None:
-            z_s = lambda: jax.device_put(jnp.zeros(shape, self.dtype),
-                                         self._sharding)
-            return [(z_s(), z_s()) for _ in range(self.num_layers)]
-        return [(z(), z()) for _ in range(self.num_layers)]
+        rows = self.num_blocks * self.block_size
+        if self.quant_wire is None:
+            shape = (rows, self.num_heads, self.head_dim)
+
+            def mk():
+                z = jnp.zeros(shape, self.dense_dtype)
+                return (z if self._sharding is None
+                        else jax.device_put(z, self._sharding))
+        else:
+            width = (self.head_dim if self.quant_wire == "int8"
+                     else self.head_dim // 2)
+            pdt = jnp.int8 if self.quant_wire == "int8" else jnp.uint8
+
+            def mk():
+                # zero payload + zero scale dequantizes to exact zero,
+                # matching the dense cache's zero init
+                payload = jnp.zeros((rows, self.num_heads, width), pdt)
+                scales = jnp.zeros((rows, self.num_heads), jnp.float16)
+                if self._sharding is not None:
+                    payload = jax.device_put(payload, self._sharding)
+                    scales = jax.device_put(scales, self._scale_sharding)
+                return (payload, scales)
+
+        return [(mk(), mk()) for _ in range(self.num_layers)]
 
     def nbytes(self) -> int:
-        return sum(int(k.size) * k.dtype.itemsize + int(v.size) *
-                   v.dtype.itemsize for k, v in self.caches)
+        return sum(int(a.size) * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(self.caches))
+
+    def bytes_per_block(self) -> int:
+        """Device bytes one block costs across all layers (K and V)."""
+        return kv_block_bytes(self.num_layers, self.num_heads,
+                              self.head_dim, self.block_size, self.dtype)
 
     # -- allocator ----------------------------------------------------
 
@@ -180,9 +274,11 @@ class PagedKVCache:
         COUNTERS.add("kv.blocks_in_use", nbytes=self.blocks_in_use)
 
     def describe(self) -> str:
+        mode = (self.quant_wire if self.quant_wire
+                else jnp.dtype(self.dense_dtype).name)
         return (f"PagedKVCache(layers={self.num_layers}, "
                 f"blocks={self.num_blocks} x {self.block_size} tok, "
                 f"table_width={self.table_width}, heads={self.num_heads}, "
-                f"head_dim={self.head_dim}, "
+                f"head_dim={self.head_dim}, kv={mode}, "
                 f"sharded={self._sharding is not None}, "
                 f"{self.nbytes() / (1 << 20):.2f} MiB)")

@@ -19,6 +19,18 @@ fixed-shape programs built once from a declarative `ServeSchedule`
   RNG), which is the batching-invariance contract tier-1 pins: a
   request's tokens do not depend on WHICH other requests share the
   batch, so joining mid-flight is token-identical to decoding alone.
+* **verify** — the speculative-decoding forward: decode at
+  `draft_len + 1` tokens per slot, scoring a slot's drafted candidates
+  in ONE dispatch.  Position-keyed sampling at every row makes the
+  accepted prefix bit-identical to sequential decode — the engine's
+  accept/reject loop (`engine._verify_step`) rides this.
+
+KV storage (`ServeSchedule.kv_dtype`): "dense" keeps K/V rows at the
+cache arrays' dtype (param dtype or an explicit bf16 cache);
+"int8"/"int4" store (payload, per-(row, head) fp16 scale) pairs via
+runtime/comm/quant.py's row kernels and dequantize gathered rows to
+fp32 in-program.  The surrounding attention math is shared, so parity
+contracts hold at matched kv_dtype.
 
 The attention math deliberately mirrors models/generation.py
 `_block_with_cache` op for op (fp32 scores, the same einsum strings,
@@ -52,6 +64,12 @@ from ..utils.logging import logger
 
 QUANT_MODES = ("none", "int8", "int4")
 
+# how the cache stores K/V: "dense" = at the cache arrays' own dtype
+# (the dtype is a runtime property of the arrays, not program
+# structure), "int8"/"int4" = (payload, scales) rows quantized through
+# runtime/comm/quant.py and dequantized in-program at every gather
+KV_MODES = ("dense", "int8", "int4")
+
 
 class ServeSchedule(NamedTuple):
     """Declarative description of the serving program pair (the
@@ -64,14 +82,19 @@ class ServeSchedule(NamedTuple):
     table_width: int
     quantized: str = "none"        # "none" | "int8" | "int4"
     quant_block: int = 256
+    kv_dtype: str = "dense"        # "dense" | "int8" | "int4"
+    draft_len: int = 0             # speculative candidates per verify
 
     def describe(self) -> str:
         cap = self.table_width * self.block_size
         q = "" if self.quantized == "none" else f", qwZ={self.quantized}"
+        kv = "" if self.kv_dtype == "dense" else f", kv={self.kv_dtype}"
+        spec = "" if not self.draft_len else \
+            f", spec draft {self.draft_len}"
         return (f"serve schedule: decode[{self.max_batch} slots] + "
                 f"prefill[chunk {self.prefill_chunk}], paged KV "
                 f"{self.num_blocks} x {self.block_size} tok "
-                f"(per-request cap {cap}){q}")
+                f"(per-request cap {cap}){q}{kv}{spec}")
 
     def program_key(self):
         """The fields the COMPILED programs actually depend on.
@@ -119,7 +142,36 @@ def _gather_rows(table, block_size):
             jnp.arange(block_size)[None, :]).reshape(-1)
 
 
-def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos):
+def _kv_write(c, idx, val, kv_mode):
+    """Scatter `val` [N, H, Dh] into cache entry `c` at flat rows
+    `idx`.  Dense: a plain row scatter at the cache's own dtype.
+    Quantized: the rows are quantized through the PR-7 row kernels and
+    BOTH the payload and the per-(row, head) scales scatter at the same
+    indices — the write never touches another row's scale."""
+    if kv_mode == "dense":
+        return c.at[idx].set(val.astype(c.dtype))
+    from ..runtime.comm.quant import quantize_rows
+
+    payload, scales = c
+    codes, s = quantize_rows(val.astype(jnp.float32), kv_mode)
+    return (payload.at[idx].set(codes), scales.at[idx].set(s))
+
+
+def _kv_read(c, rows, kv_mode):
+    """Gather cache rows `rows` [B, L] -> [B, L, H, Dh].  Dense reads
+    come back at the cache dtype (the downstream casts mirror
+    generation._block_with_cache); quantized reads dequantize the
+    gathered rows to fp32 in-program."""
+    if kv_mode == "dense":
+        return c[rows]
+    from ..runtime.comm.quant import dequantize_rows
+
+    payload, scales = c
+    return dequantize_rows(payload[rows], scales[rows], kv_mode)
+
+
+def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos,
+                 kv_mode="dense"):
     """One decoder block over x [B, T, D] with paged KV.
 
     `write_idx` [B*T] flat cache rows this chunk's K/V land in, `rows`
@@ -127,7 +179,11 @@ def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos):
     table), `q_pos` [B, T] absolute positions of x's tokens.  Op-for-op
     the math of generation._block_with_cache; only the cache addressing
     differs (scatter/gather through the table instead of
-    dynamic_update_slice on a contiguous cache).
+    dynamic_update_slice on a contiguous cache).  `kv_mode` picks the
+    storage codec: "dense" stores rows at the cache arrays' dtype,
+    "int8"/"int4" stores (payload, scales) pairs dequantized at the
+    gather — the surrounding math is identical either way, so parity
+    pins hold AT MATCHED kv_mode.
     """
     B, T, D = x.shape
     H, Dh = cfg.num_heads, cfg.head_dim
@@ -137,10 +193,10 @@ def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos):
     q, k, v = jnp.split(qkv, 3, axis=-1)
     shape = lambda t: t.reshape(B, T, H, Dh)
     q, k, v = shape(q), shape(k), shape(v)
-    ck = ck.at[write_idx].set(k.reshape(B * T, H, Dh))
-    cv = cv.at[write_idx].set(v.reshape(B * T, H, Dh))
-    keys = ck[rows]      # [B, L, H, Dh]
-    vals = cv[rows]
+    ck = _kv_write(ck, write_idx, k.reshape(B * T, H, Dh), kv_mode)
+    cv = _kv_write(cv, write_idx, v.reshape(B * T, H, Dh), kv_mode)
+    keys = _kv_read(ck, rows, kv_mode)      # [B, L, H, Dh]
+    vals = _kv_read(cv, rows, kv_mode)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         keys.astype(jnp.float32)) * (Dh ** -0.5)
     L = rows.shape[1]
@@ -244,6 +300,18 @@ class ServeProgramBuilder:
             raise ValueError(
                 f"serving quantized_weights must be one of {QUANT_MODES}, "
                 f"got {schedule.quantized!r}")
+        if schedule.kv_dtype not in KV_MODES:
+            raise ValueError(
+                f"serving schedule kv_dtype must be one of {KV_MODES}, "
+                f"got {schedule.kv_dtype!r}")
+        if schedule.kv_dtype == "int4" and cfg.head_dim % 2:
+            raise ValueError(
+                f"int4 KV packs two codes per byte and needs an even "
+                f"head_dim, got {cfg.head_dim}")
+        if int(schedule.draft_len) < 0:
+            raise ValueError(
+                f"serving draft_len must be >= 0, got "
+                f"{schedule.draft_len}")
         self.model = model
         self.schedule = schedule
 
@@ -252,6 +320,7 @@ class ServeProgramBuilder:
         return {"schedule": self.schedule,
                 "prefill": self._build_prefill(),
                 "decode": self._build_decode(),
+                "verify": self._build_verify(),
                 "prepare_params": self._prepare_params}
 
     def _prepare_params(self, params):
@@ -309,7 +378,8 @@ class ServeProgramBuilder:
             new_caches = []
             for bp, (ck, cv) in zip(params["blocks"], caches):
                 x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
-                                         rows, q_pos)
+                                         rows, q_pos,
+                                         kv_mode=s.kv_dtype)
                 new_caches.append((ck, cv))
             x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
             last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
@@ -349,7 +419,8 @@ class ServeProgramBuilder:
             new_caches = []
             for bp, (ck, cv) in zip(params["blocks"], caches):
                 x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
-                                         rows, q_pos)
+                                         rows, q_pos,
+                                         kv_mode=s.kv_dtype)
                 new_caches.append((ck, cv))
             x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
             logits = _proj_logits(cfg, params, x[:, -1, :])  # [R, V]
@@ -359,3 +430,73 @@ class ServeProgramBuilder:
             return toks, new_caches
 
         return decode
+
+    def _build_verify(self):
+        """The speculative batched forward: decode's math at T =
+        draft_len + 1 tokens per slot instead of one.  Row i of a slot
+        holds its (i-1)-th DRAFT candidate (row 0 the last committed
+        token); the program writes all candidate K/V through the table,
+        attends causally (row i sees rows <= i plus everything cached)
+        and samples the target token at EVERY position with the same
+        `_row_key(seed, position + 1)` rule decode uses — so
+        `toks[r, i]` is bit-identical to what `draft_len` sequential
+        decode steps would have produced given the same prefix, which
+        is the whole accept/reject correctness argument.  Rejected
+        rows need no undo: the engine simply rewinds its position and
+        the stale rows are re-written (same scatter indices) before
+        any later query's causal mask can reach them."""
+        cfg = self.model.config
+        s = self.schedule
+        bs, W = s.block_size, s.table_width
+        T = int(s.draft_len) + 1
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def verify(params, caches, tokens, positions, n_draft, active,
+                   tables, temperatures, top_ks, seeds):
+            """tokens [R, T] = column 0 each slot's last committed
+            token, columns 1..draft_len its drafted candidates (pad
+            past n_draft[r] ignored); positions [R] = the committed
+            token's write position.  Returns (target samples [R, T],
+            caches): toks[r, i] is the token the target emits at
+            absolute position positions[r] + 1 + i given the prefix
+            through column i."""
+            params = self._maybe_dequant(params)
+            R = tokens.shape[0]
+            abs_pos = positions[:, None] + jnp.arange(T)[None, :]
+            # per-row gather with a clip, the prefill rule: pad rows
+            # past the wpe table clamp (their writes land in trash and
+            # their samples are discarded by the engine)
+            wpe_rows = params["wpe"][
+                jnp.clip(abs_pos, 0, params["wpe"].shape[0] - 1)]
+            x = params["wte"][tokens] + wpe_rows          # [R, T, D]
+            blk_i = abs_pos // bs
+            valid = (active[:, None] &
+                     (jnp.arange(T)[None, :] <= n_draft[:, None]) &
+                     (blk_i < W))
+            blk = jnp.take_along_axis(tables,
+                                      jnp.clip(blk_i, 0, W - 1), axis=1)
+            # rows past a slot's drafts (and inactive slots) write to
+            # the trash block, the decode convention
+            write_idx = jnp.where(valid, blk * bs + abs_pos % bs,
+                                  0).reshape(R * T)
+            rows = (tables[:, :, None] * bs +
+                    jnp.arange(bs)[None, None, :]).reshape(R, -1)
+            q_pos = abs_pos
+            new_caches = []
+            for bp, (ck, cv) in zip(params["blocks"], caches):
+                x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
+                                         rows, q_pos,
+                                         kv_mode=s.kv_dtype)
+                new_caches.append((ck, cv))
+            x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
+            logits = _proj_logits(
+                cfg, params,
+                x.reshape(R * T, -1)).reshape(R, T, -1)   # [R, T, V]
+            keys = jax.vmap(jax.vmap(_row_key, in_axes=(None, 0)))(
+                seeds, abs_pos + 1)
+            toks = jax.vmap(jax.vmap(
+                sample_token, in_axes=(0, None, None, 0)))(
+                logits, temperatures, top_ks, keys)
+            return toks, new_caches
+
+        return verify

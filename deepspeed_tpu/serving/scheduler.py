@@ -4,8 +4,15 @@ KV cache.
 The scheduling loop the engine drives once per `step()`:
 
 1. **admit** — move waiting requests into free decode slots whenever
-   the free list can cover their whole KV budget
-   (ceil((prompt + max_new) / block_size) blocks).  Admission policy:
+   the free list can cover their whole KV budget:
+   ceil((prompt + max_new + draft_len) / block_size) blocks, clamped to
+   the table width.  The `draft_len` tail matters under speculative
+   decoding: a verify step writes up to `draft_len` candidate K/V rows
+   PAST the committed length, and without the reservation those rows
+   would spill into the trash-padded tail of the block table — an
+   accepted draft's K/V silently living in the trash block, corrupting
+   every later attention read (the off-by-draft starvation
+   tests/test_spec_decode.py pins).  Admission policy:
 
    * `"continuous"` (the subsystem's reason to exist): a request joins
      the RUNNING batch at ANY decode step, and a finished request frees
@@ -88,16 +95,19 @@ class Scheduler:
 
     def __init__(self, kv: PagedKVCache, max_batch: int,
                  admission: str = "continuous",
-                 clock=time.monotonic):
+                 clock=time.monotonic, draft_len: int = 0):
         if admission not in ADMISSION_POLICIES:
             raise ValueError(
                 f"admission must be one of {ADMISSION_POLICIES}, got "
                 f"{admission!r}")
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        if int(draft_len) < 0:
+            raise ValueError(f"draft_len must be >= 0, got {draft_len}")
         self.kv = kv
         self.max_batch = int(max_batch)
         self.admission = admission
+        self.draft_len = int(draft_len)
         self.clock = clock
         self.slots: List[Optional[Request]] = [None] * self.max_batch
         self._waiting: List[Request] = []
@@ -118,14 +128,28 @@ class Scheduler:
                 f"max_new {req.max_new_tokens} exceeds the engine's "
                 f"{self.kv.table_width * self.kv.block_size}-token "
                 f"per-request capacity")
-        if needed > self.kv.capacity_blocks:
+        reserved = self.blocks_reserved(req)
+        if reserved > self.kv.capacity_blocks:
             raise ValueError(
-                f"request needs {needed} KV blocks but the cache only "
-                f"has {self.kv.capacity_blocks}")
+                f"request needs {reserved} KV blocks (incl. the "
+                f"{self.draft_len}-token speculative tail) but the cache "
+                f"only has {self.kv.capacity_blocks}")
         with self._lock:
             self._waiting.append(req)
             self.requests.append(req)
         return req
+
+    def blocks_reserved(self, req: Request) -> int:
+        """The request's whole-life block budget INCLUDING the
+        speculative tail: verify writes up to `draft_len` candidate
+        rows past the committed length, so those rows must be backed
+        by real blocks (never the trash-padded table tail) or an
+        accepted draft's K/V would be silently lost.  Clamped to the
+        table width — the engine clamps per-step draft proposals to
+        the allocated rows, so the cap is never overrun."""
+        tokens = min(len(req.prompt) + req.max_new_tokens + self.draft_len,
+                     self.kv.table_width * self.kv.block_size)
+        return self.kv.blocks_needed(tokens)
 
     # -- engine-thread scheduling -------------------------------------
 
@@ -142,8 +166,7 @@ class Scheduler:
                 if not free_slots:
                     break
                 req = self._waiting[0]
-                needed = self.kv.blocks_needed(
-                    len(req.prompt) + req.max_new_tokens)
+                needed = self.blocks_reserved(req)
                 table = self.kv.alloc(req.rid, needed)
                 if table is None:
                     break  # FIFO: never starve the head of the queue

@@ -84,6 +84,13 @@ def selftest() -> int:
             COUNTERS.add("serve.shed", calls=1)
             COUNTERS.add("kv.blocks_in_use", 10, calls=4)
             COUNTERS.add("kv.evictions", calls=3)
+            # speculative decoding over a quantized cache: proposed vs
+            # accepted drafts + decode dispatch wall µs against the
+            # quantized store (kv.dequant_ms is µs-in-bytes) — rendered
+            # as the Serving section's "Speculative decoding" rows
+            COUNTERS.add("serve.draft_tokens", calls=8)
+            COUNTERS.add("serve.accepted_tokens", calls=6)
+            COUNTERS.add("kv.dequant_ms", 90_000, calls=3)
             # MoE wire (moe/dispatch.py): a2a hop bytes + the
             # slow-fabric subset, exposed µs (ckpt.stall_ms
             # convention), capacity drops and ppm-in-bytes bucket
@@ -173,12 +180,15 @@ def selftest() -> int:
                 "itl_ms": {"p50": 2.0, "p99": 6.0},
                 "kv_blocks": {"mean": 9.5, "peak": 14, "capacity": 31},
                 "shed": 0}
+            spec_lane = dict(lane(165.0, 35.0), accepted_per_step=1.8,
+                             kv_dtype="int8", draft_len=4)
             _json.dump({"schema_version": 1, "n_requests": 8,
                         "rate_hz": 4.0,
                         "model": {"layers": 2, "d_model": 32, "heads": 4,
                                   "vocab": 64},
                         "lanes": {"continuous": lane(120.0, 40.0),
-                                  "static": lane(80.0, 90.0)}}, f)
+                                  "static": lane(80.0, 90.0),
+                                  "spec_int8_d4": spec_lane}}, f)
         run = load_run(os.path.join(root, "selftest"))
         bad = [err for events in run["ranks"].values()
                for e in events for err in validate_event(e)]
@@ -209,7 +219,14 @@ def selftest() -> int:
                        "mean KV blocks in use",
                        "KV blocks force-reclaimed",
                        "requests shed (wedged decode)",
+                       "**Speculative decoding**",
+                       "draft tokens proposed | 24 (75% accepted)",
+                       "draft tokens accepted | 18 (+2.00 bonus "
+                       "tokens/step)",
+                       "quantized-KV decode dispatch",
                        "Serving bench (continuous batching)",
+                       "Speculative decoding lanes",
+                       "spec_int8_d4: +1.80 tok/step (kv int8, draft 4)",
                        "continuous vs static batching: 1.50x",
                        "MoE wire (expert all-to-all)",
                        "a2a wire bytes", "slow-fabric (inter-group) share",
@@ -238,7 +255,10 @@ def selftest() -> int:
             "`elastic.regrows`" not in md, \
             "elastic.* rows must not leak into the comm table"
         assert "`serve.tokens`" not in md and \
-            "`kv.blocks_in_use`" not in md, \
+            "`kv.blocks_in_use`" not in md and \
+            "`serve.draft_tokens`" not in md and \
+            "`serve.accepted_tokens`" not in md and \
+            "`kv.dequant_ms`" not in md, \
             "serve.*/kv.* rows must not leak into the comm table"
         assert "`moe.a2a_bytes`" not in md and \
             "`moe.capacity_frac`" not in md, \
