@@ -17,7 +17,11 @@ utils/tensorboard, profiling/flops_profiler) into a single pipeline:
 """
 
 from .config import MONITOR, DeepSpeedMonitorConfig  # noqa: F401
-from .counters import COUNTERS, CounterRegistry, tree_bytes  # noqa: F401
+from .counters import (COUNTERS, US_IN_BYTES_COUNTERS,  # noqa: F401
+                       CounterRegistry, tree_bytes)
 from .monitor import (SCHEMA_VERSION, RunMonitor,  # noqa: F401
                       device_memory_stats)
 from .spans import Span, SpanSet, TraceWindow  # noqa: F401
+from .tracing import (TRACE_SCHEMA_VERSION, ServingSLO,  # noqa: F401
+                      TraceRecorder, percentile_nearest_rank,
+                      read_trace_file)

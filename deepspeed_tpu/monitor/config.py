@@ -22,8 +22,26 @@ JSON schema:
             "start_step": -1,           # -1: disabled
             "num_steps": 1,
             "output_dir": ""            # default: <run_dir>/profile
+        },
+        "tracing": {                    # monitor/tracing.py TraceRecorder
+            "enabled": false,           # off by default: zero files,
+                                        # zero threads when disabled
+            "buffer_events": 2048,      # flight-recorder ring capacity
+            "max_file_bytes": 16777216, # per-rank trace file byte bound
+            "sample_rate": 1.0,         # fraction of steps/requests
+                                        # traced (seeded, deterministic)
+            "seed": 0,
+            "flush_interval_s": 0.5,    # background writer cadence
+            "slo": {                    # serving SLO window (ServingSLO)
+                "window_s": 10.0,
+                "emit_interval_s": 2.0
+            }
         }
     }
+
+Unlike the tolerant top-level monitor keys (which predate the strict
+convention), the `tracing` block validates like the serving/autotune
+blocks: unknown keys and out-of-range values raise at config time.
 """
 
 from ..runtime.config_utils import DeepSpeedConfigObject, get_scalar_param
@@ -42,6 +60,54 @@ MONITOR_PROFILER = "profiler"
 MONITOR_PROFILER_START_STEP = "start_step"
 MONITOR_PROFILER_NUM_STEPS = "num_steps"
 MONITOR_PROFILER_OUTPUT_DIR = "output_dir"
+MONITOR_TRACING = "tracing"
+MONITOR_TRACING_ENABLED = "enabled"
+MONITOR_TRACING_BUFFER_EVENTS = "buffer_events"
+MONITOR_TRACING_MAX_FILE_BYTES = "max_file_bytes"
+MONITOR_TRACING_SAMPLE_RATE = "sample_rate"
+MONITOR_TRACING_SEED = "seed"
+MONITOR_TRACING_FLUSH_INTERVAL_S = "flush_interval_s"
+MONITOR_TRACING_SLO = "slo"
+MONITOR_TRACING_SLO_WINDOW_S = "window_s"
+MONITOR_TRACING_SLO_EMIT_INTERVAL_S = "emit_interval_s"
+
+MONITOR_TRACING_ENABLED_DEFAULT = False
+MONITOR_TRACING_BUFFER_EVENTS_DEFAULT = 2048
+MONITOR_TRACING_MAX_FILE_BYTES_DEFAULT = 16 << 20
+MONITOR_TRACING_SAMPLE_RATE_DEFAULT = 1.0
+MONITOR_TRACING_SEED_DEFAULT = 0
+MONITOR_TRACING_FLUSH_INTERVAL_S_DEFAULT = 0.5
+MONITOR_TRACING_SLO_WINDOW_S_DEFAULT = 10.0
+MONITOR_TRACING_SLO_EMIT_INTERVAL_S_DEFAULT = 2.0
+
+_TRACING_VALID_KEYS = frozenset((
+    MONITOR_TRACING_ENABLED, MONITOR_TRACING_BUFFER_EVENTS,
+    MONITOR_TRACING_MAX_FILE_BYTES, MONITOR_TRACING_SAMPLE_RATE,
+    MONITOR_TRACING_SEED, MONITOR_TRACING_FLUSH_INTERVAL_S,
+    MONITOR_TRACING_SLO))
+_TRACING_SLO_VALID_KEYS = frozenset((
+    MONITOR_TRACING_SLO_WINDOW_S, MONITOR_TRACING_SLO_EMIT_INTERVAL_S))
+
+
+def _tracing_int(d, key, default, lo):
+    v = d.get(key, default)
+    if isinstance(v, bool) or not isinstance(v, int):
+        raise ValueError(
+            f"monitor.tracing.{key} must be an int, got {v!r}")
+    if v < lo:
+        raise ValueError(f"monitor.tracing.{key} must be >= {lo}, got {v}")
+    return v
+
+
+def _tracing_float(d, key, default, lo, hi=None, prefix="monitor.tracing"):
+    v = d.get(key, default)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise ValueError(f"{prefix}.{key} must be a number, got {v!r}")
+    v = float(v)
+    if v <= lo or (hi is not None and v > hi):
+        bound = f"in ({lo}, {hi}]" if hi is not None else f"> {lo}"
+        raise ValueError(f"{prefix}.{key} must be {bound}, got {v}")
+    return v
 
 
 class DeepSpeedMonitorConfig(DeepSpeedConfigObject):
@@ -69,3 +135,56 @@ class DeepSpeedMonitorConfig(DeepSpeedConfigObject):
             prof, MONITOR_PROFILER_NUM_STEPS, 1))
         self.profiler_output_dir = get_scalar_param(
             prof, MONITOR_PROFILER_OUTPUT_DIR, "")
+        self._parse_tracing(d)
+
+    def _parse_tracing(self, d):
+        tr = d.get(MONITOR_TRACING, {}) or {}
+        if not isinstance(tr, dict):
+            raise ValueError(
+                f"monitor.tracing must be an object, got {tr!r}")
+        unknown = set(tr) - _TRACING_VALID_KEYS
+        if unknown:
+            raise ValueError(
+                f"monitor.tracing: unknown key(s) {sorted(unknown)}; "
+                f"valid keys: {sorted(_TRACING_VALID_KEYS)}")
+        enabled = tr.get(MONITOR_TRACING_ENABLED,
+                         MONITOR_TRACING_ENABLED_DEFAULT)
+        if not isinstance(enabled, bool):
+            raise ValueError("monitor.tracing.enabled must be a bool, "
+                             f"got {enabled!r}")
+        self.tracing_enabled = enabled
+        if enabled and not self.enabled:
+            raise ValueError(
+                "monitor.tracing.enabled requires monitor.enabled: the "
+                "trace files land in the monitor run dir")
+        self.tracing_buffer_events = _tracing_int(
+            tr, MONITOR_TRACING_BUFFER_EVENTS,
+            MONITOR_TRACING_BUFFER_EVENTS_DEFAULT, 16)
+        self.tracing_max_file_bytes = _tracing_int(
+            tr, MONITOR_TRACING_MAX_FILE_BYTES,
+            MONITOR_TRACING_MAX_FILE_BYTES_DEFAULT, 4096)
+        self.tracing_sample_rate = _tracing_float(
+            tr, MONITOR_TRACING_SAMPLE_RATE,
+            MONITOR_TRACING_SAMPLE_RATE_DEFAULT, 0.0, 1.0)
+        self.tracing_seed = _tracing_int(
+            tr, MONITOR_TRACING_SEED, MONITOR_TRACING_SEED_DEFAULT, 0)
+        self.tracing_flush_interval_s = _tracing_float(
+            tr, MONITOR_TRACING_FLUSH_INTERVAL_S,
+            MONITOR_TRACING_FLUSH_INTERVAL_S_DEFAULT, 0.0)
+        slo = tr.get(MONITOR_TRACING_SLO, {}) or {}
+        if not isinstance(slo, dict):
+            raise ValueError(
+                f"monitor.tracing.slo must be an object, got {slo!r}")
+        unknown = set(slo) - _TRACING_SLO_VALID_KEYS
+        if unknown:
+            raise ValueError(
+                f"monitor.tracing.slo: unknown key(s) {sorted(unknown)}; "
+                f"valid keys: {sorted(_TRACING_SLO_VALID_KEYS)}")
+        self.tracing_slo_window_s = _tracing_float(
+            slo, MONITOR_TRACING_SLO_WINDOW_S,
+            MONITOR_TRACING_SLO_WINDOW_S_DEFAULT, 0.0,
+            prefix="monitor.tracing.slo")
+        self.tracing_slo_emit_interval_s = _tracing_float(
+            slo, MONITOR_TRACING_SLO_EMIT_INTERVAL_S,
+            MONITOR_TRACING_SLO_EMIT_INTERVAL_S_DEFAULT, 0.0,
+            prefix="monitor.tracing.slo")

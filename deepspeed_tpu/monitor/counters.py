@@ -128,6 +128,14 @@ Instrumented sites:
   regression (step-time or exposed-wire creep); `autotune.swaps` —
   live config swaps applied through the StepBuilder rebuild (search
   winners, cached winners and online retune winners all count here).
+* trace/SLO telemetry (`trace.*` / `slo.*`, monitor/tracing.py;
+  rendered by monitor/report.py as the "Tracing" rows of the Serving
+  SLO section, excluded from the comm byte table): `trace.events` —
+  span events flushed to the rank-local trace file (bytes = JSONL
+  bytes written, bounded by `max_file_bytes`); `trace.dropped` —
+  events the byte cap rejected (the ring buffer still holds them for
+  the watchdog flight recorder); `slo.windows` — periodic `slo`
+  monitor events emitted by the ServingSLO sliding window.
 """
 
 from __future__ import annotations
@@ -196,3 +204,19 @@ class CounterRegistry:
 
 # THE process-global registry every instrumented site writes to.
 COUNTERS = CounterRegistry()
+
+# Counters whose bytes slot carries integer MICROSECONDS (the
+# ckpt.stall_ms convention) instead of real bytes.  The counter/doc
+# lint test (tests/test_tracing.py) cross-checks this registry against
+# docs/tutorials/monitoring.md so every µs-in-bytes counter stays
+# flagged as such wherever it is documented.
+US_IN_BYTES_COUNTERS = frozenset((
+    "input.host_wait_ms",
+    "ckpt.stall_ms",
+    "fault.recovered_ms",
+    "grad_wire.exposed_ms",
+    "serve.ttft_ms",
+    "kv.dequant_ms",
+    "moe.a2a_exposed_ms",
+    "autotune.probes",
+))

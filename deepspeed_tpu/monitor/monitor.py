@@ -36,6 +36,7 @@ from ..utils.logging import log_dist, logger
 from .config import DeepSpeedMonitorConfig
 from .counters import COUNTERS
 from .spans import Span, SpanSet, TraceWindow
+from .tracing import TraceRecorder
 
 SCHEMA_VERSION = 1
 
@@ -118,6 +119,23 @@ class RunMonitor:
         self.trace_window = TraceWindow(self.config.profiler_start_step,
                                         self.config.profiler_num_steps,
                                         prof_dir)
+        # span tracing (monitor/tracing.py): constructed ONLY when
+        # enabled — a disabled run creates zero trace files and zero
+        # threads.  With >1 process the recorder's init allgather (the
+        # clock-skew sync) is collective, like close().
+        self.tracer = None
+        if getattr(self.config, "tracing_enabled", False):
+            wire = None
+            if self.world > 1 or self._hostwire_endpoint is not None:
+                wire = self._wire()
+            self.tracer = TraceRecorder(
+                self.run_dir, rank=self.rank, world=self.world,
+                buffer_events=self.config.tracing_buffer_events,
+                max_file_bytes=self.config.tracing_max_file_bytes,
+                sample_rate=self.config.tracing_sample_rate,
+                seed=self.config.tracing_seed,
+                flush_interval_s=self.config.tracing_flush_interval_s,
+                wire=wire)
         if self.rank == 0:
             self._write_manifest(manifest_extra or {})
 
@@ -311,6 +329,8 @@ class RunMonitor:
             return
         self._closed = True
         self.trace_window.close()
+        if self.tracer is not None:
+            self.tracer.close()
         summary = self._local_summary()
         merged = [summary]
         if self.world > 1 or self._hostwire_endpoint is not None:

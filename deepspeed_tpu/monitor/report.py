@@ -32,6 +32,9 @@ def validate_event(event: Dict[str, Any]) -> List[str]:
     if isinstance(event.get("v"), int) and event["v"] > SCHEMA_VERSION:
         errs.append(f"schema version {event['v']} is newer than reader "
                     f"({SCHEMA_VERSION})")
+    if event.get("type") == "slo" and not isinstance(event.get("slo"),
+                                                     dict):
+        errs.append("slo event missing its 'slo' snapshot object")
     return errs
 
 
@@ -243,11 +246,15 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # autotune.* carries search/retune bookkeeping (probe µs in the
     # bytes slot, swap/rejection counts) and renders as the "Autotune"
     # section below
+    # trace.*/slo.* carry trace-recorder bookkeeping (JSONL bytes,
+    # drop counts, SLO window counts), not wire bytes — rendered as
+    # the "Serving SLO" section's Tracing rows below
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
                                           "elastic.", "serve.", "kv.",
-                                          "moe.", "autotune."))
+                                          "moe.", "autotune.", "trace.",
+                                          "slo."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -384,6 +391,68 @@ def render_markdown(run: Dict[str, Any]) -> str:
                              f"{total_ms:,.1f} ms total over "
                              f"{dq['calls']:,} dispatches "
                              f"({total_ms / dq['calls']:.2f} ms each) |")
+        lines.append("")
+
+    # live SLO telemetry: monitor.tracing.ServingSLO windows land in
+    # the event stream as type="slo" events; trace.*/slo.* counters
+    # (excluded from the comm byte table above) ride along as the
+    # Tracing rows
+    slo_events = [e for rank in sorted(run["ranks"])
+                  for e in run["ranks"][rank]
+                  if e.get("type") == "slo"
+                  and isinstance(e.get("slo"), dict)]
+    trace_counters = {k: v for k, v in any_comm.items()
+                      if k.startswith(("trace.", "slo."))}
+    if slo_events or trace_counters:
+        lines.append("## Serving SLO")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        if slo_events:
+            last = slo_events[-1]["slo"]
+            ttft = last.get("ttft_ms") or {}
+            p99s = [(e["slo"].get("ttft_ms") or {}).get("p99")
+                    for e in slo_events]
+            p99s = [p for p in p99s if p is not None]
+            lines.append(f"| SLO windows emitted | {len(slo_events):,} "
+                         f"({last.get('window_s', '?')} s sliding) |")
+            lines.append(f"| last window: requests | "
+                         f"{last.get('requests', 0):,} |")
+            if ttft.get("p50") is not None:
+                lines.append(f"| last window: TTFT p50/p99 | "
+                             f"{_fmt(ttft.get('p50'))} / "
+                             f"{_fmt(ttft.get('p99'))} ms "
+                             f"(n={ttft.get('n', 0)}) |")
+            if last.get("tok_per_s") is not None:
+                lines.append(f"| last window: decode throughput | "
+                             f"{_fmt(last['tok_per_s'])} tokens/s |")
+            if last.get("queue_depth_mean") is not None:
+                lines.append(f"| last window: mean admission queue "
+                             f"depth | {_fmt(last['queue_depth_mean'])} |")
+            if last.get("accept_rate") is not None:
+                lines.append(f"| last window: draft accept rate | "
+                             f"{100.0 * last['accept_rate']:.1f}% "
+                             f"({last.get('drafted', 0):,} drafted) |")
+            if last.get("shed"):
+                lines.append(f"| last window: requests shed | "
+                             f"{last['shed']:,} |")
+            if p99s:
+                lines.append(f"| worst window TTFT p99 | "
+                             f"{_fmt(max(p99s))} ms |")
+        if trace_counters:
+            lines.append("| **Tracing** | |")
+            tev = trace_counters.get("trace.events")
+            if tev:
+                lines.append(f"| trace events recorded | {tev['calls']:,} "
+                             f"({_fmt_bytes(tev['bytes'])} JSONL) |")
+            tdr = trace_counters.get("trace.dropped")
+            if tdr:
+                lines.append(f"| trace events dropped (byte cap) | "
+                             f"{tdr['calls']:,} |")
+            wnd = trace_counters.get("slo.windows")
+            if wnd:
+                lines.append(f"| SLO windows aggregated | "
+                             f"{wnd['calls']:,} |")
         lines.append("")
 
     # serving-bench lane table (serving.json from tools/serve_bench.py)

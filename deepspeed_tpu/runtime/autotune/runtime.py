@@ -235,7 +235,20 @@ class AutotuneRuntime:
         0's clock through the consensus wire."""
         budget = self.config.budget_s if self._consensus.world <= 1 \
             else None
-        return SearchDriver(prober.probe, budget_s=budget)
+
+        def probe(cand):
+            # trace timeline: each candidate probe is an `autotune`
+            # span, so probe time reads as probing instead of an
+            # anonymous slow step.  NOT gated on the engine's per-step
+            # sampling — probes are rare and always worth a span.
+            tr = getattr(self.engine, "_tracer", None)
+            if tr is None:
+                return prober.probe(cand)
+            with tr.span("autotune.probe", "autotune", cand=cand.name,
+                         step=self.engine.global_steps):
+                return prober.probe(cand)
+
+        return SearchDriver(probe, budget_s=budget)
 
     def _search(self, driver: SearchDriver, cands) -> Optional[Any]:
         if self._consensus.world <= 1:
@@ -334,6 +347,10 @@ class AutotuneRuntime:
         eng = self.engine
         COUNTERS.add("autotune.retunes", calls=1)
         self.retunes += 1
+        tr = getattr(eng, "_tracer", None)
+        if tr is not None:
+            tr.instant("autotune.retune", "autotune", reason=reason,
+                       step=eng.global_steps)
         incumbent = current_candidate(eng)
         cands = self.candidates(live_only=True,
                                 safe_only=self.config.online_safe_only)

@@ -587,13 +587,19 @@ class PrefetchLoader:
             prev.close()
 
 
-def timed_next(data_iter):
+def timed_next(data_iter, tracer=None, step=None):
     """next(data_iter) with the host-blocked wall time recorded as
     `input.host_wait_ms` (stored in integer microseconds; the report
     renders ms).  Every engine-side pull from a host iterator goes
-    through here so prefetch-on/off lanes measure the same thing."""
+    through here so prefetch-on/off lanes measure the same thing.
+    `tracer` (a monitor/tracing.py TraceRecorder, already gated by the
+    engine's per-step sampling) additionally lands the same wait as an
+    `input_wait` span on the trace timeline."""
     t0 = time.perf_counter()
     batch = next(data_iter)
-    COUNTERS.add("input.host_wait_ms",
-                 int((time.perf_counter() - t0) * 1e6))
+    dt_us = int((time.perf_counter() - t0) * 1e6)
+    COUNTERS.add("input.host_wait_ms", dt_us)
+    if tracer is not None:
+        tracer.add_complete("input_wait", "input", dur_us=dt_us,
+                            **({} if step is None else {"step": step}))
     return batch

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from collections import deque
 from typing import Any, Dict, Optional
 
@@ -732,6 +733,8 @@ class DeepSpeedEngine:
         multi-host heartbeats (monitor/monitor.py).  The TensorBoard
         monitor (if configured) becomes one sink beside the stream."""
         mc = getattr(self._config, "monitor_config", None)
+        self._tracer = None
+        self._trace_on = False
         if mc is None or not mc.enabled:
             return None
         from ..monitor import RunMonitor
@@ -745,8 +748,29 @@ class DeepSpeedEngine:
             "zero_stage": self._config.zero_optimization_stage,
             "model": type(self.module).__name__,
         }
-        return RunMonitor(mc, tensorboard=self.monitor,
-                          manifest_extra=extra)
+        rm = RunMonitor(mc, tensorboard=self.monitor,
+                        manifest_extra=extra)
+        # span tracing (monitor/tracing.py): the engine caches the
+        # recorder and a per-step sampling gate, resampled at every
+        # optimizer boundary so a whole global batch traces (or not)
+        # as a unit
+        self._tracer = rm.tracer
+        if rm.tracer is not None:
+            self._trace_on = rm.tracer.sampled(self.global_steps + 1)
+        return rm
+
+    def _dispatch_tracer(self):
+        """The gate every training trace site goes through: the
+        recorder only when tracing is enabled AND the in-flight step is
+        sampled.  One attribute read on the untraced path; no site ever
+        synchronizes a device value, so traced and untraced runs stay
+        bitwise identical."""
+        tr = getattr(self, "_tracer", None)
+        return tr if (tr is not None and self._trace_on) else None
+
+    def _timed_next(self, data_iter):
+        return timed_next(data_iter, tracer=self._dispatch_tracer(),
+                          step=self.global_steps + 1)
 
     def _init_resilience(self):
         """Install the chaos-runtime pieces from the "faults" config
@@ -771,11 +795,17 @@ class DeepSpeedEngine:
                    if self.run_monitor is not None else None)
         snap_dir = fc.watchdog_snapshot_dir or run_dir or \
             os.path.join(os.getcwd(), "dstpu_watchdog")
-        return resilience.StepWatchdog(
+        wd = resilience.StepWatchdog(
             fc.watchdog_deadline_s, snap_dir,
             escalate_dir=run_dir or snap_dir,
             poll_s=fc.watchdog_poll_s, rank=comm.get_rank(),
             first_beat_mult=fc.watchdog_first_beat_mult)
+        tr = getattr(self, "_tracer", None)
+        if tr is not None:
+            # flight recorder: a trip snapshot ships the last N trace
+            # events, so a wedged step carries its own timeline
+            wd.set_flight_recorder(tr.last_events)
+        return wd
 
     def _init_preemption(self):
         """Honor the supervisor's "SIGTERM = save-if-possible" contract
@@ -1310,6 +1340,11 @@ class DeepSpeedEngine:
             pending.pop(0)
             self._retire_ticket(ticket)
         COUNTERS.add("grad_wire.exposed_ms", int(exposed_us), calls=1)
+        tr = self._dispatch_tracer()
+        if tr is not None:
+            tr.add_complete("wire_exposed", "wire",
+                            dur_us=int(exposed_us),
+                            step=self.global_steps + 1)
         self._check_overlap_health()
 
     def _retire_ticket(self, ticket):
@@ -2013,6 +2048,10 @@ class DeepSpeedEngine:
         resilience.step_boundary(self.global_steps)
         if self._watchdog is not None:
             self._watchdog.beat(self.global_steps)
+            tr = self._dispatch_tracer()
+            if tr is not None:
+                tr.instant("watchdog_beat", "watchdog",
+                           step=self.global_steps)
         if self._offload is not None:
             out = self._offload_step()
         elif getattr(self, "_pending_full", None) is not None:
@@ -2028,6 +2067,10 @@ class DeepSpeedEngine:
             # this clean boundary, like the demotion above
             self._autotuner.on_step_boundary()
         self._maybe_preempt_checkpoint()
+        tr = getattr(self, "_tracer", None)
+        if tr is not None:
+            # resample the trace gate for the next global batch
+            self._trace_on = tr.sampled(self.global_steps + 1)
         return out
 
     def _boundary_step(self):
@@ -2278,7 +2321,7 @@ class DeepSpeedEngine:
                 return np.stack([np.asarray(l) for l in leaves])
 
             def fetch():
-                micro = [timed_next(data_iter) for _ in range(gas)]
+                micro = [self._timed_next(data_iter) for _ in range(gas)]
                 try:
                     stacked = jax.tree_util.tree_map(_stack, *micro)
                 except (ValueError, TypeError):
@@ -2294,7 +2337,7 @@ class DeepSpeedEngine:
                 return (tag, payload)
         else:
             def fetch():
-                return timed_next(data_iter)
+                return self._timed_next(data_iter)
 
             place = self._shard_batch
         feed = _DeviceFeed(data_iter, fetch, place, scan=scan,
@@ -2335,7 +2378,8 @@ class DeepSpeedEngine:
             return loss
         losses = []
         for _ in range(self.gradient_accumulation_steps()):
-            batch = feed.next() if feed is not None else timed_next(data_iter)
+            batch = (feed.next() if feed is not None
+                     else self._timed_next(data_iter))
             losses.append(self.forward(batch))
             self.backward()
             if feed is not None:
@@ -2372,7 +2416,8 @@ class DeepSpeedEngine:
                 return self._last_loss
             stacked = payload  # already device-placed by the feed
         else:
-            micro_batches = [timed_next(data_iter) for _ in range(gas)]
+            micro_batches = [self._timed_next(data_iter)
+                             for _ in range(gas)]
             try:
                 stacked = jax.tree_util.tree_map(
                     lambda *leaves: jnp.stack(
@@ -3046,6 +3091,7 @@ class DeepSpeedEngine:
             model_state, optim_state = self._async_ckpt_snapshot(
                 (model_state, optim_state))
         snap = COUNTERS.snapshot()
+        t0_save = time.perf_counter()
         ckpt_io.save_checkpoint_state(
             save_dir, tag, model_state, optim_state, save_latest=save_latest,
             async_save=async_save, meta=self._checkpoint_meta(),
@@ -3053,6 +3099,12 @@ class DeepSpeedEngine:
                                       "checkpoint_commit_timeout_ms",
                                       ckpt_io.COMMIT_TIMEOUT_MS),
             device_leaves_are_snapshots=async_save)
+        tr = self._dispatch_tracer()
+        if tr is not None:
+            tr.add_complete(
+                "ckpt_stall", "ckpt",
+                dur_us=int((time.perf_counter() - t0_save) * 1e6),
+                tag=str(tag), step=self.global_steps)
         if self.run_monitor is not None:
             delta = COUNTERS.delta_since(snap)
             self.run_monitor.emit("ckpt", {

@@ -612,6 +612,7 @@ class StepWatchdog:
         self._tripped = False
         self._trips = 0
         self._thread_groups: Dict[str, Callable[[], list]] = {}
+        self._flight_recorder: Optional[Callable[[], list]] = None
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop,
                                         name="dstpu-watchdog", daemon=True)
@@ -648,6 +649,25 @@ class StepWatchdog:
         dead object (and its buffers) for the rest of the process."""
         with self._lock:
             self._thread_groups.pop(group, None)
+
+    def set_flight_recorder(self, tail_fn) -> None:
+        """Register a trace-tail provider (`tail_fn()` -> the newest
+        trace events, e.g. monitor/tracing.py TraceRecorder.last_events)
+        — the trip snapshot then ships a `trace_tail` timeline of what
+        the wedged step was doing.  Like register_threads, a raising
+        provider is reported, never propagated."""
+        with self._lock:
+            self._flight_recorder = tail_fn
+
+    def _flight_recorder_tail(self) -> Optional[list]:
+        with self._lock:
+            fn = self._flight_recorder
+        if fn is None:
+            return None
+        try:
+            return list(fn())
+        except Exception as e:
+            return [{"error": f"{type(e).__name__}: {e}"}]
 
     def _thread_group_report(self) -> Dict[str, Any]:
         with self._lock:
@@ -725,6 +745,9 @@ class StepWatchdog:
             "stacks": _all_stacks(),
             "thread_groups": self._thread_group_report(),
         }
+        tail = self._flight_recorder_tail()
+        if tail is not None:
+            snapshot["trace_tail"] = tail
         snap_path = os.path.join(
             self.snapshot_dir,
             f"watchdog_snapshot.rank{self.rank:05d}.{n}.json")

@@ -77,18 +77,31 @@ class CountedFn:
     calling it records exactly the wire/qwZ bytes one dispatch moves
     (the engine's monitor picks the deltas up per step).  `.fn` is the
     raw jitted callable for AOT analysis (flops profiling) — analysis
-    traces must not bump dispatch counters."""
+    traces must not bump dispatch counters (and must not land trace
+    spans either, for the same reason).
 
-    __slots__ = ("fn", "_account")
+    `trace`: an optional zero-arg callable returning (recorder, step)
+    when the in-flight step is sampled, else None — each dispatch then
+    lands as a `dispatch.<name>` span on the trace timeline.  Dispatch
+    wall only (programs run async): the span bounds the host-side
+    enqueue, not device execution."""
 
-    def __init__(self, fn, account=None):
+    __slots__ = ("fn", "_account", "_trace", "_name")
+
+    def __init__(self, fn, account=None, trace=None, name=None):
         self.fn = fn
         self._account = account
+        self._trace = trace
+        self._name = name
 
     def __call__(self, *args):
         if self._account is not None:
             self._account()
-        return self.fn(*args)
+        tr = self._trace() if self._trace is not None else None
+        if tr is None:
+            return self.fn(*args)
+        with tr[0].span(f"dispatch.{self._name}", "train", step=tr[1]):
+            return self.fn(*args)
 
 
 class StepBuilder:
@@ -137,12 +150,21 @@ class StepBuilder:
                      calls=gather.collectives_per_gather * events)
 
     def _counted(self, fn, plan=None, wire_events=0, qwz=None,
-                 qwz_events=0):
+                 qwz_events=0, name=None):
+        eng = self.engine
+        trace = None
+        if name is not None:
+            # Step fns are built before _init_run_monitor attaches the
+            # tracer, so the gate has to live inside the closure.
+            def trace():
+                tr = getattr(eng, "_dispatch_tracer", None)
+                tr = tr() if tr is not None else None
+                return None if tr is None else (tr, eng.global_steps + 1)
         if not wire_events and not qwz_events:
-            return CountedFn(fn)
+            return CountedFn(fn, trace=trace, name=name)
         account = lambda: (self._account_wire(plan, wire_events),
                            self._account_qwz(qwz, qwz_events))
-        return CountedFn(fn, account)
+        return CountedFn(fn, account, trace=trace, name=name)
 
     # -- schedule resolution ------------------------------------------
 
@@ -476,36 +498,38 @@ class StepBuilder:
 
         fns = {}
         donate_apply = jax.jit(apply_step, donate_argnums=(0, 1, 2, 3))
-        fns["apply"] = self._counted(donate_apply)
+        fns["apply"] = self._counted(donate_apply, name="apply")
         # lr=None (optimizer-default) is a static arg value: jit treats
         # None as an empty pytree, giving that case its own single trace
 
         if schedule.overlap_wire:
             grads_fn, combine_fn = build_overlap_fns()
             fns["grads"] = self._counted(grads_fn, plan=wire_plan,
-                                         wire_events=1)
-            fns["combine"] = self._counted(combine_fn)
+                                         wire_events=1, name="grads")
+            fns["combine"] = self._counted(combine_fn, name="combine")
             log_dist(self._describe(schedule), ranks=[0])
             return fns
 
         donate_micro = jax.jit(micro_step, donate_argnums=(1,))
         fns["micro"] = self._counted(donate_micro, plan=wire_plan,
                                      wire_events=1, qwz=qwz_int,
-                                     qwz_events=1)
+                                     qwz_events=1, name="micro")
         if schedule.composition == "onebit":
-            fns["full"] = self._counted(eng._build_onebit_step(cast))
+            fns["full"] = self._counted(eng._build_onebit_step(cast),
+                                        name="full")
         elif schedule.composition == "fused":
             # scaler state (arg 2) is NOT donated: it stays readable
             # between the fused forward and step(), so engine.loss_scale
             # keeps reference pre-update semantics until the boundary
             fns["full"] = self._counted(
                 jax.jit(full_step, donate_argnums=(0, 1)),
-                plan=wire_plan, wire_events=1, qwz=qwz_int, qwz_events=1)
+                plan=wire_plan, wire_events=1, qwz=qwz_int, qwz_events=1,
+                name="full")
         elif schedule.composition == "scan":
             fns["full_scan"] = self._counted(
                 jax.jit(scan_batch_step, donate_argnums=(0, 1)),
                 plan=wire_plan, wire_events=gas, qwz=qwz_int,
-                qwz_events=1)
+                qwz_events=1, name="full_scan")
         log_dist(self._describe(schedule), ranks=[0])
         return fns
 

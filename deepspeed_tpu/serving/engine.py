@@ -189,6 +189,8 @@ class ServeEngine:
         self._watchdog = None
         self._worker: Optional["ServeWorker"] = None
         self._wake = threading.Event()
+        self._tracer = None               # monitor.tracing.TraceRecorder
+        self._slo = None                  # monitor.tracing.ServingSLO
 
     # -- placement ----------------------------------------------------
 
@@ -244,6 +246,37 @@ class ServeEngine:
         self._wake.set()
         return req
 
+    # -- tracing / SLO telemetry --------------------------------------
+
+    def attach_tracing(self, tracer=None, slo=None) -> None:
+        """Attach a `monitor.tracing.TraceRecorder` and/or a
+        `monitor.tracing.ServingSLO` aggregator.  The tracer records
+        the per-request lifecycle (`queue_wait` at admission,
+        `prefill_chunk` spans, a `first_token` instant, per-step
+        `decode_step`/`verify_step` spans with batch occupancy and
+        draft accept counts, `finish`/`shed` instants — all cat
+        "serve"); request-scoped events are sampled per rid, step
+        spans per engine step, so a loaded engine stays within the
+        recorder's byte budget.  The SLO aggregator is fed UNSAMPLED
+        (TTFT, tokens, queue depth, accept rate, sheds) and ticked at
+        every step boundary so its windows never have sampling holes.
+        When a watchdog is attached (before or after this call) the
+        tracer's tail doubles as its trip-snapshot flight recorder."""
+        self._tracer = tracer
+        self._slo = slo
+        self.scheduler.tracer = tracer
+        if slo is not None and getattr(slo, "tracer", None) is None:
+            slo.tracer = tracer
+        if tracer is not None and self._watchdog is not None:
+            self._watchdog.set_flight_recorder(tracer.last_events)
+
+    def _req_tracer(self, req: Request):
+        """The tracer, iff this request's rid is sampled in."""
+        tr = self._tracer
+        if tr is not None and tr.sampled(f"rid:{req.rid}"):
+            return tr
+        return None
+
     # -- shedding (watchdog escalation target) ------------------------
 
     def request_shed(self, reason: str = "watchdog trip") -> None:
@@ -267,6 +300,11 @@ class ServeEngine:
                 self._tables[slot] = TRASH_BLOCK
         if victims:
             COUNTERS.add("serve.shed", calls=len(victims))
+            if self._slo is not None:
+                self._slo.observe_shed(len(victims))
+            if self._tracer is not None:
+                self._tracer.instant("shed", "serve", n=len(victims),
+                                     reason=reason)
             logger.error(
                 f"serving: SHED {len(victims)} in-flight request(s) "
                 f"({reason}); {self.kv.blocks_in_use} blocks still held, "
@@ -284,6 +322,10 @@ class ServeEngine:
             self._watchdog.beat(self.steps)
         fault_point("serve.admit")
         self.scheduler.admit()
+        if self._slo is not None:
+            # depth AFTER admission = backlog the cache/slots could not
+            # absorb this step, the saturation signal SLO windows want
+            self._slo.observe_queue_depth(self.scheduler.n_waiting)
         did = False
         for req in self.scheduler.prefilling()[
                 :self.config.max_prefill_chunks_per_step]:
@@ -306,6 +348,8 @@ class ServeEngine:
                                           self.kv.blocks_in_use)
             self.peak_resident = max(self.peak_resident,
                                      len(self.scheduler.occupied()))
+            if self._slo is not None:
+                self._slo.tick()
         return did
 
     def has_work(self) -> bool:
@@ -339,6 +383,9 @@ class ServeEngine:
         C = self.config.prefill_chunk
         chunk = req.prompt[req.prefill_pos:req.prefill_pos + C]
         n_valid = len(chunk)
+        pos0 = req.prefill_pos
+        tr = self._req_tracer(req)
+        tus0 = tr.now_us() if tr is not None else 0
         tokens = np.zeros((1, C), np.int32)
         tokens[0, :n_valid] = chunk
         tok, _logits, caches = self.programs["prefill"](
@@ -350,6 +397,10 @@ class ServeEngine:
         req.prefill_pos += n_valid
         req.cached_len = req.prefill_pos
         COUNTERS.add("serve.prefill_chunks", nbytes=n_valid)
+        if tr is not None:
+            tr.add_complete("prefill_chunk", "serve", ts_us=tus0,
+                            dur_us=tr.now_us() - tus0, rid=req.rid,
+                            pos=pos0, n=n_valid)
         if req.prefill_pos < len(req.prompt):
             return
         # final chunk: the program sampled the request's FIRST token
@@ -360,6 +411,11 @@ class ServeEngine:
         req.out.append(first)
         COUNTERS.add("serve.tokens")
         COUNTERS.add("serve.ttft_ms", nbytes=int(req.ttft_s * 1e6))
+        if self._slo is not None:
+            self._slo.observe_ttft(req.ttft_s)
+        if tr is not None:
+            tr.instant("first_token", "serve", rid=req.rid,
+                       ttft_ms=round(req.ttft_s * 1e3, 3))
         if self._is_finished(req, first):
             self._finish(req)
             return
@@ -378,6 +434,8 @@ class ServeEngine:
         if int(self.config.draft_len) > 0:
             self._verify_step(running)
             return
+        tr = self._step_tracer()
+        tus0 = tr.now_us() if tr is not None else 0
         t0 = time.perf_counter()
         toks, caches = self.programs["decode"](
             self.params, self.kv.caches, jnp.asarray(self._tokens),
@@ -403,6 +461,20 @@ class ServeEngine:
             else:
                 self._tokens[slot] = tok
                 self._positions[slot] += 1
+        if self._slo is not None:
+            self._slo.observe_tokens(len(running))
+        if tr is not None:
+            tr.add_complete("decode_step", "serve", ts_us=tus0,
+                            dur_us=tr.now_us() - tus0, step=self.steps,
+                            batch=len(running))
+
+    def _step_tracer(self):
+        """The tracer, iff this engine step's index is sampled in
+        (decode/verify spans are per-step, not per-request)."""
+        tr = self._tracer
+        if tr is not None and tr.sampled(f"step:{self.steps}"):
+            return tr
+        return None
 
     def _record_dequant(self, t0: float) -> None:
         """`kv.dequant_ms` (µs-in-bytes): wall time of decode-family
@@ -472,6 +544,8 @@ class ServeEngine:
         query's causal mask can attend them — no scatter undo."""
         R = self.config.max_batch
         k = int(self.config.draft_len)
+        tr = self._step_tracer()
+        tus0 = tr.now_us() if tr is not None else 0
         drafts = np.zeros((R, k), np.int32)
         n_draft = np.zeros((R,), np.int32)
         for req in running:
@@ -493,6 +567,8 @@ class ServeEngine:
         self._record_dequant(t0)
         now = self.clock()
         COUNTERS.add("serve.decode_steps", nbytes=len(running))
+        tot_emitted = 0
+        tot_accepted = 0
         for req in running:
             slot = req.slot
             nd = int(n_draft[slot])
@@ -518,6 +594,8 @@ class ServeEngine:
                 # emitted - 1 DRAFT tokens were accepted and used (the
                 # final emitted token is always the target's own)
                 COUNTERS.add("serve.accepted_tokens", calls=emitted - 1)
+                tot_accepted += emitted - 1
+            tot_emitted += emitted
             if finished:
                 self._finish(req)
                 self._active[slot] = False
@@ -525,6 +603,15 @@ class ServeEngine:
             else:
                 self._tokens[slot] = int(toks[slot, emitted - 1])
                 self._positions[slot] += emitted
+        if self._slo is not None:
+            self._slo.observe_tokens(tot_emitted)
+            self._slo.observe_accept(tot_accepted, int(n_draft.sum()))
+        if tr is not None:
+            tr.add_complete("verify_step", "serve", ts_us=tus0,
+                            dur_us=tr.now_us() - tus0, step=self.steps,
+                            batch=len(running),
+                            drafted=int(n_draft.sum()),
+                            accepted=tot_accepted)
 
     def _is_finished(self, req: Request, last_tok: int) -> bool:
         if req.eos_token is not None and last_tok == req.eos_token:
@@ -533,6 +620,10 @@ class ServeEngine:
 
     def _finish(self, req: Request) -> None:
         COUNTERS.add("serve.requests", nbytes=len(req.out))
+        tr = self._req_tracer(req)
+        if tr is not None:
+            tr.instant("finish", "serve", rid=req.rid,
+                       tokens=len(req.out))
         self.scheduler.finish(req, FINISHED)
 
     # -- watchdog / worker integration ---------------------------------
@@ -550,6 +641,8 @@ class ServeEngine:
         quiet periods or only arm the watchdog while work is in
         flight."""
         self._watchdog = watchdog
+        if self._tracer is not None:
+            watchdog.set_flight_recorder(self._tracer.last_events)
         watchdog.register_threads(
             "serving",
             lambda: [t for t in (self._worker,)

@@ -114,6 +114,10 @@ class Scheduler:
         self._lock = threading.Lock()
         self._rid = itertools.count()
         self.requests: List[Request] = []
+        # monitor.tracing.TraceRecorder (or None) — set by
+        # ServeEngine.attach_tracing; admit() emits one `queue_wait`
+        # complete event per sampled admitted request.
+        self.tracer = None
 
     # -- submission (any thread) --------------------------------------
 
@@ -176,6 +180,20 @@ class Scheduler:
                 req.state = PREFILL
                 self.slots[req.slot] = req
                 admitted.append(req)
+        tr = self.tracer
+        if tr is not None and admitted:
+            # Queue wait is measured on the SCHEDULER clock (injectable
+            # for tests) and back-dated onto the tracer clock so the
+            # span ends at the admission instant.
+            now = self.clock()
+            for req in admitted:
+                if not tr.sampled(f"rid:{req.rid}"):
+                    continue
+                dur_us = max(0, int((now - req.t_submit) * 1e6))
+                tr.add_complete("queue_wait", "serve",
+                                ts_us=tr.now_us() - dur_us,
+                                dur_us=dur_us, rid=req.rid,
+                                prompt=len(req.prompt))
         return admitted
 
     def prefilling(self) -> List[Request]:

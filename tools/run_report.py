@@ -108,6 +108,12 @@ def selftest() -> int:
             COUNTERS.add("autotune.rejected", calls=2)
             COUNTERS.add("autotune.retunes", calls=1)
             COUNTERS.add("autotune.swaps", calls=1)
+            # trace recorder bookkeeping (monitor/tracing.py): event/
+            # byte tallies + SLO window count — rendered as the
+            # "Serving SLO" section's Tracing rows, never comm byte rows
+            COUNTERS.add("trace.events", 2048, calls=12)
+            COUNTERS.add("trace.dropped", calls=1)
+            COUNTERS.add("slo.windows", calls=1)
             sp = mon.span("forward")
             sp.close()
             mon.step_end(step, loss=4.0 / step, lr=1e-3, loss_scale=1.0,
@@ -115,6 +121,16 @@ def selftest() -> int:
                          pipe={"occupancy": [
                              {"stage": 0, "ticks": 9, "compute_ticks": 8,
                               "bubble_frac": 0.1111}]})
+        # live SLO windows (monitor.tracing.ServingSLO snapshots) land
+        # in the event stream as type="slo" events and render as the
+        # "Serving SLO" section; the report keeps the LAST window plus
+        # the worst p99 seen across windows
+        for p99 in (41.5, 55.0):
+            mon.emit("slo", {"slo": {
+                "window_s": 10.0, "requests": 6,
+                "ttft_ms": {"p50": 21.0, "p99": p99, "n": 6},
+                "tok_per_s": 180.0, "queue_depth_mean": 1.5,
+                "accept_rate": 0.75, "drafted": 16, "shed": 1}})
         mon.close()
         # a supervisor restart ledger beside the event streams
         # (elasticity/supervisor.py) renders as the "Restarts" section
@@ -238,7 +254,19 @@ def selftest() -> int:
                        "online retunes (sustained regression)",
                        "live config swaps applied",
                        "swapped to `flat_fp32`",
-                       "online retune: exposed wire creep"):
+                       "online retune: exposed wire creep",
+                       "## Serving SLO", "SLO windows emitted | 2",
+                       "last window: TTFT p50/p99 | 21.00 / 55.00 ms "
+                       "(n=6)",
+                       "last window: decode throughput | 180.00 tokens/s",
+                       "last window: mean admission queue depth | 1.50",
+                       "last window: draft accept rate | 75.0% "
+                       "(16 drafted)",
+                       "last window: requests shed | 1",
+                       "worst window TTFT p99 | 55.00 ms",
+                       "**Tracing**", "trace events recorded | 36",
+                       "trace events dropped (byte cap) | 3",
+                       "SLO windows aggregated | 3"):
             assert needle in md, f"{needle!r} missing from report"
         assert "`input.host_wait_ms`" not in md, \
             "input.* rows must not leak into the comm table"
@@ -266,6 +294,10 @@ def selftest() -> int:
         assert "`autotune.probes`" not in md and \
             "`autotune.swaps`" not in md, \
             "autotune.* rows must not leak into the comm table"
+        assert "`trace.events`" not in md and \
+            "`trace.dropped`" not in md and \
+            "`slo.windows`" not in md, \
+            "trace.*/slo.* rows must not leak into the comm table"
         # serving.json alone must render without event streams (the
         # serve-bench run-dir shape)
         import shutil as _shutil

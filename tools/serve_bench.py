@@ -44,8 +44,16 @@ Campaigns:
   wedged batch, the remaining requests complete with oracle-identical
   outputs.
 
-Usage: python tools/serve_bench.py [--dry-run] [--spec] [--requests 48]
-           [--rate 24.0] [--seed 0] [--no-record]
+Usage: python tools/serve_bench.py [--dry-run] [--spec] [--trace]
+           [--requests 48] [--rate 24.0] [--seed 0] [--no-record]
+
+`--trace` (docs/tutorials/tracing.md) attaches a
+monitor.tracing.TraceRecorder + ServingSLO to the continuous lane: the
+per-request timeline (queue_wait -> prefill chunks -> first_token ->
+decode steps -> finish) lands as trace.rank00000.jsonl beside
+serving.json, SLO windows as events.rank00000.jsonl, and the run dir
+becomes input for both tools/run_report.py (the "Serving SLO" section)
+and tools/trace_report.py (the merged Perfetto timeline).
 """
 
 from __future__ import annotations
@@ -135,14 +143,18 @@ def _nano_model(vocab=128, max_seq=128, layers=2, d_model=64, heads=4):
 
 
 def run_lane(model, params, serve_cfg, timeline, programs=None,
-             watchdog=None):
-    """Replay `timeline` against one engine; returns (metrics, engine)."""
+             watchdog=None, tracing=None):
+    """Replay `timeline` against one engine; returns (metrics, engine).
+    `tracing` is an optional (TraceRecorder, ServingSLO) pair attached
+    via engine.attach_tracing — the --trace lane."""
     from deepspeed_tpu.monitor.counters import COUNTERS
     from deepspeed_tpu.serving import ServeEngine, ServeWorker
 
     eng = ServeEngine(model, params, serve_cfg, programs=programs)
     if watchdog is not None:
         eng.attach_watchdog(watchdog)
+    if tracing is not None:
+        eng.attach_tracing(tracer=tracing[0], slo=tracing[1])
     worker = ServeWorker(eng)
     snap = COUNTERS.snapshot()
     worker.start()
@@ -219,8 +231,18 @@ def run_lane(model, params, serve_cfg, timeline, programs=None,
 
 
 def run_campaign(n_requests=48, rate_hz=24.0, seed=0, record=True,
-                 dry=False):
-    """The two-lane comparison; returns the result dict."""
+                 dry=False, trace=False):
+    """The two-lane comparison; returns the result dict.
+
+    `trace=True` runs the CONTINUOUS lane with a TraceRecorder +
+    ServingSLO attached (monitor/tracing.py): the per-request timeline
+    lands in trace.rank00000.jsonl and the SLO windows in
+    events.rank00000.jsonl beside serving.json, so
+    `tools/run_report.py <run_dir>` renders a "Serving SLO" section
+    whose window-covering-the-lane p50/p99 TTFT reproduces this
+    bench's own nearest-rank numbers, and
+    `tools/trace_report.py <run_dir>` merges the request timeline into
+    Chrome/Perfetto JSON."""
     import jax
 
     from deepspeed_tpu.serving import ServeConfig
@@ -256,12 +278,46 @@ def run_campaign(n_requests=48, rate_hz=24.0, seed=0, record=True,
     programs = warm.programs
     del warm
 
+    trace_tmp, slo_events, slo_final = None, [], None
     lanes = {}
     for adm in ("continuous", "static"):
+        tracing = None
+        if trace and adm == "continuous":
+            import tempfile
+
+            from deepspeed_tpu.monitor.tracing import (ServingSLO,
+                                                       TraceRecorder)
+
+            trace_tmp = tempfile.mkdtemp(prefix="serve_trace_")
+            rec = TraceRecorder(trace_tmp, flush_interval_s=0.2)
+            # window wide enough to cover the whole lane: the final
+            # forced snapshot then aggregates EVERY request, so its
+            # nearest-rank p50/p99 must equal the bench's own
+            slo = ServingSLO(
+                emit=lambda snap: slo_events.append(
+                    {"v": 1, "type": "slo", "rank": 0,
+                     "t": time.time(), "slo": snap}),
+                window_s=1e6, emit_interval_s=0.25, tracer=rec)
+            tracing = (rec, slo)
         print(f"--- lane: {adm} batching ({n_requests} requests, "
               f"Poisson {rate_hz:.1f}/s) ---")
         metrics, _eng = run_lane(model, params, mk_cfg(adm), timeline,
-                                 programs=programs)
+                                 programs=programs, tracing=tracing)
+        if tracing is not None:
+            slo_final = tracing[1].force()
+            slo_events.append({"v": 1, "type": "slo", "rank": 0,
+                               "t": time.time(), "slo": slo_final})
+            tracing[0].close()
+            metrics["slo"] = slo_final
+            # the SLO window covered the lane, so its nearest-rank
+            # percentiles must reproduce the bench's — pinned here so
+            # the traced artifact can never disagree with its own table
+            for q in ("p50", "p99"):
+                bench_q, slo_q = metrics["ttft_ms"][q], \
+                    slo_final["ttft_ms"][q]
+                assert bench_q is None or \
+                    abs(slo_q - bench_q) < 0.005 + 1e-9, \
+                    (q, bench_q, slo_q)
         lanes[adm] = metrics
         print(f"    {metrics['completed']}/{metrics['requests']} done, "
               f"{metrics['tokens']} tok in {metrics['makespan_s']}s = "
@@ -296,8 +352,38 @@ def run_campaign(n_requests=48, rate_hz=24.0, seed=0, record=True,
         result["artifact"], result["run_dir"] = record_serving(result)
         print(f"artifact: {result['artifact']}")
         print(f"report:   python tools/run_report.py {result['run_dir']}")
+        if trace_tmp is not None:
+            run_dir = os.path.join(os.path.dirname(HERE),
+                                   result["run_dir"])
+            _install_trace(trace_tmp, slo_events, run_dir)
+            trace_tmp = run_dir
+            print(f"trace:    python tools/trace_report.py "
+                  f"{result['run_dir']}")
+    if trace_tmp is not None:
+        # recorded: the run dir now holds the trace; unrecorded (the
+        # tier-1 dry lane): the raw temp dir — run_dry asserts on it
+        # and cleans up
+        result["trace"] = {"dir": trace_tmp, "slo_events": slo_events,
+                           "slo": slo_final}
     result["outputs"] = outputs  # post-record: oracle material only
     return result
+
+
+def _install_trace(trace_tmp, slo_events, run_dir):
+    """Move the traced lane's files into the recorded run dir:
+    trace.rank*.jsonl (for tools/trace_report.py) + an
+    events.rank00000.jsonl of slo events (for run_report's "Serving
+    SLO" section)."""
+    import glob
+    import shutil
+
+    os.makedirs(run_dir, exist_ok=True)
+    for path in glob.glob(os.path.join(trace_tmp, "trace.rank*.jsonl")):
+        shutil.move(path, os.path.join(run_dir, os.path.basename(path)))
+    with open(os.path.join(run_dir, "events.rank00000.jsonl"), "w") as f:
+        for ev in slo_events:
+            f.write(json.dumps(ev) + "\n")
+    shutil.rmtree(trace_tmp, ignore_errors=True)
 
 
 def _print_lane(name, m):
@@ -500,8 +586,13 @@ def record_serving(result):
 def run_dry(record=False):
     """Tier-1 CPU miniature (tests/test_serving.py): both lanes finish
     every request, metrics are well-formed; no perf assertion — the
-    point is that the lane cannot rot."""
-    result = run_campaign(record=record, dry=True)
+    point is that the lane cannot rot.  Runs the continuous lane
+    TRACED so the per-request timeline (queue_wait -> prefill_chunk ->
+    decode_step) and the SLO-window/bench percentile agreement are
+    tier-1 pinned too."""
+    import shutil
+
+    result = run_campaign(record=record, dry=True, trace=True)
     for name, lane in result["lanes"].items():
         assert lane["completed"] == lane["requests"], (name, lane)
         assert lane["errored"] == 0, (name, lane)
@@ -511,6 +602,27 @@ def run_dry(record=False):
     assert result["lanes"]["continuous"]["tokens"] == \
         result["lanes"]["static"]["tokens"], \
         "both lanes decode the same timeline: token totals must agree"
+    # the traced lane parsed: every request's lifecycle spans are there
+    tr = result["trace"]
+    try:
+        from deepspeed_tpu.monitor.tracing import read_trace_file
+
+        segments, summary = read_trace_file(
+            os.path.join(tr["dir"], "trace.rank00000.jsonl"))
+        events = [e for _meta, evs in segments for e in evs]
+        names = {e["name"] for e in events}
+        for want in ("queue_wait", "prefill_chunk", "decode_step",
+                     "first_token", "finish"):
+            assert want in names, (want, sorted(names))
+        n_req = result["lanes"]["continuous"]["requests"]
+        assert sum(1 for e in events if e["name"] == "queue_wait") \
+            == n_req, "every request admits exactly once"
+        assert summary is not None and summary["dropped"] == 0, summary
+        assert tr["slo"]["requests"] == n_req, tr["slo"]
+        assert tr["slo_events"], "no slo windows emitted"
+    finally:
+        if not record:
+            shutil.rmtree(tr["dir"], ignore_errors=True)
     return result
 
 
@@ -646,6 +758,10 @@ def main() -> int:
                     "lanes measure a saturated single-slot queue)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-record", action="store_true")
+    ap.add_argument("--trace", action="store_true",
+                    help="attach a TraceRecorder + ServingSLO to the "
+                    "continuous lane: per-request timeline + SLO "
+                    "windows land beside serving.json in the run dir")
     args = ap.parse_args()
     if args.dry_run and args.spec:
         run_dry_spec(record=not args.no_record)
@@ -667,7 +783,8 @@ def main() -> int:
         return 0
     result = run_campaign(n_requests=args.requests,
                           rate_hz=args.rate or 24.0,
-                          seed=args.seed, record=not args.no_record)
+                          seed=args.seed, record=not args.no_record,
+                          trace=args.trace)
     cont = result["lanes"]["continuous"]
     stat = result["lanes"]["static"]
     print(f"\ncontinuous vs static: "
