@@ -48,6 +48,26 @@ def op_report(out=sys.stdout):
     print("-" * 74, file=out)
 
 
+def kernel_report(out=sys.stdout):
+    """The Pallas kernel registry's probe table (deepspeed_tpu/kernels):
+    each registered hot-loop op, whether its Pallas path would engage
+    on this fabric, and the registry's reason when it declines — the
+    op_builder table's runtime-kernel sibling."""
+    from .kernels import probe_report
+
+    max_dots = 23
+    print("-" * 74, file=out)
+    print("kernel op" + "." * (max_dots - len("kernel op")) +
+          " impl | reason", file=out)
+    print("-" * 74, file=out)
+    for name, verdict, reason in probe_report():
+        status = SUCCESS if verdict == "pallas" else NO
+        tail = verdict if verdict == "pallas" else f"{verdict}: {reason}"
+        print(f"{name}{'.' * (max_dots - len(name))} {status:>18} | "
+              f"{tail}", file=out)
+    print("-" * 74, file=out)
+
+
 def _probe_devices(timeout_s: int = 60):
     """Device inventory via a subprocess with a hard timeout: a status
     report must never hang, and accelerator-plugin backend init CAN hang
@@ -109,6 +129,7 @@ def debug_report(out=sys.stdout):
 
 def main(out=sys.stdout):
     op_report(out=out)
+    kernel_report(out=out)
     debug_report(out=out)
 
 

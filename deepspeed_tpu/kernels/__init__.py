@@ -1,0 +1,20 @@
+"""deepspeed_tpu.kernels — the Pallas hot-loop op registry.
+
+One kernel-selection mechanism for the whole repo (registry.py):
+op_builder-style probed Pallas implementations with their original jnp
+expressions kept as pinned correctness oracles.  See
+docs/tutorials/kernels.md.
+"""
+
+from .registry import (KERNEL_IMPLS, KERNEL_OPS, KernelConfig,
+                       clear_winners, dispatch, get_kernel,
+                       get_kernel_config, kernel_config,
+                       parse_kernels_config, probe_report, record_winner,
+                       resolve_impl, set_kernel_config, winner_for)
+
+__all__ = [
+    "KERNEL_IMPLS", "KERNEL_OPS", "KernelConfig", "clear_winners",
+    "dispatch", "get_kernel", "get_kernel_config", "kernel_config",
+    "parse_kernels_config", "probe_report", "record_winner",
+    "resolve_impl", "set_kernel_config", "winner_for",
+]

@@ -1,0 +1,147 @@
+"""Pallas sort-based MoE dispatch/combine (op 3, moe/dispatch.py).
+
+The jnp oracles move tokens with a scatter-add (`sorted_dispatch_ref`)
+and a gated gather (`sorted_combine_ref`).  On TPU the scatter lowers
+to a serialized HBM update stream; these kernels re-express both
+directions as per-slot / per-token GATHERS driven by scalar-prefetched
+index tables, which Mosaic turns into plain async block copies:
+
+* dispatch — the oracle's kept destinations are UNIQUE (capacity
+  assignment), so the scatter has an exact inverse permutation.  A tiny
+  jnp prologue inverts `dest` into `src_tok[slot] -> token | -1`; the
+  kernel then copies `x[src_tok[s]]` into slot `s` (zeros when empty).
+  Parity is bit-exact: every slot is a verbatim row copy or zeros,
+  matching add-into-zeros.
+* combine — slot sources `src[a, n]` (the trash row E*C when dropped)
+  and fp32 gate weights ride SMEM; each token accumulates its k expert
+  rows in ascending assignment order — the same term order as the
+  oracle's axis-0 sum.  Parity is tolerance-bounded at ~1 ulp: the
+  accumulator's multiply-add may fuse to an FMA where the oracle's
+  separate mul/sum rounds twice.
+
+Both oracles are vmapped over batch rows by callers; these wrappers
+are shaped identically so `dispatch("moe_dispatch", ...)` drops in
+under the same vmap.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..ops.transformer.flash_attention import compiler_params_cls
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _clamp(i):
+    return jnp.maximum(i, 0)
+
+
+# ---------------------------------------------------------------------------
+# dispatch: tokens -> [E, C, D] capacity buckets
+# ---------------------------------------------------------------------------
+
+
+def _dispatch_kernel(tok_ref, x_ref, o_ref):
+    s = pl.program_id(0)
+    # empty slots (tok == -1) read a clamped dummy row; the where zeroes it
+    o_ref[...] = jnp.where(tok_ref[s] >= 0, x_ref[...],
+                           jnp.zeros_like(o_ref))
+
+
+def sorted_dispatch_pallas(x, eidx, pos, keep, num_experts: int,
+                           capacity: int):
+    """Drop-in for `sorted_dispatch_ref` (bit-exact)."""
+    k, N = eidx.shape
+    D = x.shape[-1]
+    E, C = num_experts, capacity
+    flat_keep = keep.reshape(-1)
+    dest = jnp.where(flat_keep, eidx.reshape(-1) * C + pos.reshape(-1),
+                     E * C)
+    # invert the (unique-per-slot) scatter: slot -> assignment -> token.
+    # assignment a carries token a % N (the oracle's tiled gather order)
+    slot_a = jnp.full((E * C + 1,), -1, jnp.int32).at[dest].set(
+        jnp.arange(k * N, dtype=jnp.int32))[:E * C]
+    src_tok = jnp.where(slot_a >= 0,
+                        jax.lax.rem(slot_a, jnp.int32(N)),
+                        jnp.int32(-1))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(E * C,),
+        in_specs=[
+            pl.BlockSpec((1, D), lambda s, tok: (_clamp(tok[s]), 0)),
+        ],
+        out_specs=pl.BlockSpec((1, D), lambda s, tok: (s, 0)),
+    )
+    buf = pl.pallas_call(
+        _dispatch_kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((E * C, D), x.dtype),
+        compiler_params=compiler_params_cls()(
+            dimension_semantics=(pltpu.PARALLEL,)),
+        interpret=_interpret(),
+    )(src_tok, x)
+    return buf.reshape(E, C, D)
+
+
+# ---------------------------------------------------------------------------
+# combine: gated gather back to [N, D]
+# ---------------------------------------------------------------------------
+
+
+def _combine_kernel(src_ref, w_ref, flat_ref, o_ref, acc, *, k, N):
+    n = pl.program_id(0)
+    a = pl.program_id(1)
+
+    @pl.when(a == 0)
+    def _init():
+        acc[...] = jnp.zeros_like(acc)
+
+    # dropped assignments point src at the zero trash row AND carry
+    # w == 0, so the term vanishes exactly like the oracle's
+    acc[...] = acc[...] + flat_ref[...].astype(jnp.float32) * w_ref[a, n]
+
+    @pl.when(a == k - 1)
+    def _finish():
+        o_ref[...] = acc[...].astype(o_ref.dtype)
+
+
+def sorted_combine_pallas(expert_out, eidx, gate, pos, keep):
+    """Drop-in for `sorted_combine_ref` (~1-ulp tolerance parity)."""
+    E, C, D = expert_out.shape
+    k, N = eidx.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, D),
+         jnp.zeros((1, D), expert_out.dtype)])
+    src = jnp.where(keep.reshape(-1),
+                    eidx.reshape(-1) * C + pos.reshape(-1),
+                    E * C).astype(jnp.int32)
+    # the oracle weights in expert_out's dtype; replicate the rounding
+    # by casting gate*keep through that dtype before the fp32 multiply
+    w = (gate * keep).astype(expert_out.dtype).astype(jnp.float32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(N, k),
+        in_specs=[
+            pl.BlockSpec((1, D), lambda n, a, src, w: (src[a * N + n], 0)),
+        ],
+        out_specs=pl.BlockSpec((1, D), lambda n, a, src, w: (n, 0)),
+        scratch_shapes=[pltpu.VMEM((1, D), jnp.float32)],
+    )
+    return pl.pallas_call(
+        functools.partial(_combine_kernel, k=k, N=N),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((N, D), expert_out.dtype),
+        compiler_params=compiler_params_cls()(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY)),
+        interpret=_interpret(),
+    )(src, w, flat)

@@ -1,0 +1,537 @@
+"""THE kernel-selection mechanism: op_builder-style registry of Pallas
+hot-loop implementations with jnp correctness oracles.
+
+The paper's pitch — "csrc/transformer + sparse_attention kernels
+reimplemented as Pallas/XLA ops behind op_builder" — lands here.  Every
+hot inner loop that has a Pallas implementation registers a `KernelOp`
+with:
+
+* `pallas(...)`   — the Pallas TPU kernel (runs under the Pallas
+  interpreter off-TPU, which is how tier-1 pins parity on CPU);
+* `oracle(...)`   — the pre-existing jnp expression, kept bit-for-bit
+  (it IS the correctness contract: exact for the integer codecs and MoE
+  permutations, tolerance-bounded for attention);
+* `is_compatible()` / `compatibility_message()` — op_builder-style
+  capability probing: Pallas is only *selected* natively on a TPU
+  backend, gated per-op by `DS_KERNEL_{NAME}=0` (the `DS_BUILD_*`
+  convention from ops/op_builder/builder.py);
+* `auto_supports(...)` — the per-call shape heuristic `impl="auto"`
+  consults (e.g. sparse attention's block%128 / head-dim tiling rule).
+
+Selection contract (`resolve_impl`):
+
+* `"auto"`  — pallas iff the probe AND the shape heuristic pass (an
+  autotuner-recorded winner, keyed per fabric fingerprint, overrides
+  the heuristic — see `record_winner`); otherwise the jnp oracle.
+* `"pallas"` — the kernel, NO silent fallback: off-TPU this raises
+  loudly unless the interpret escape is set (`kernels.interpret=true`
+  in the config, or the call-site `interpret_ok=True` that preserves
+  `SparseSelfAttention(impl="pallas")`'s historical run-the-kernel-
+  under-the-interpreter semantics).
+* `"jnp"` (alias `"xla"`) — the oracle, unconditionally.
+
+Every `dispatch()` bumps `kernel.dispatches` (pallas chosen) or
+`kernel.fallbacks` (oracle chosen).  Like the `dist.*` family these are
+TRACE-time counts — once per compiled program per call site, not per
+execution — so a decode program that retraces shows exactly its
+per-layer dispatch count (docs/tutorials/kernels.md).
+
+Config install mirrors moe/dispatch.py's wire config: the engine
+installs the parsed `"kernels"` block process-globally at initialize();
+direct users scope overrides with the `kernel_config(...)` context
+manager.  Implementation modules (`flash`, `quant_codec`,
+`moe_kernels`, `paged`) are imported lazily from the op methods so the
+registry itself stays import-cycle-free (config validation can name
+the op set without dragging in jax kernels).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+from typing import Dict, Mapping, Optional, Tuple
+
+import jax
+
+from ..monitor.counters import COUNTERS
+from ..utils.logging import logger
+
+KERNEL_IMPLS = ("auto", "pallas", "jnp")
+# legacy spelling accepted at call sites (SparseSelfAttention's
+# impl="xla") — normalized to "jnp" before resolution
+_IMPL_ALIASES = {"xla": "jnp"}
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+# ---------------------------------------------------------------------------
+# op classes (op_builder pattern: NAME + compatibility probe per op)
+# ---------------------------------------------------------------------------
+
+
+class KernelOp:
+    """One registered hot-loop op.  Subclasses lazily import their
+    implementation module inside `pallas()`/`oracle()` — registration
+    stays cheap and cycle-free."""
+
+    NAME = "base"
+    VARIANTS: Tuple[str, ...] = ("default",)
+    # False when pallas<->oracle parity is tolerance-bounded (attention
+    # reduction order); True when bit-exact (integer codecs, gathers)
+    EXACT = False
+
+    def env_enabled(self) -> bool:
+        return os.environ.get(f"DS_KERNEL_{self.NAME.upper()}",
+                              "1") != "0"
+
+    def is_compatible(self) -> bool:
+        """Pallas-on-TPU probe: native selection needs a TPU backend
+        and the op's env switch left on."""
+        return self.env_enabled() and _on_tpu()
+
+    def compatibility_message(self) -> str:
+        if not self.env_enabled():
+            return (f"disabled via DS_KERNEL_{self.NAME.upper()}=0")
+        if not _on_tpu():
+            return (f"backend is {jax.default_backend()!r}, not 'tpu' "
+                    f"(the Pallas kernel only runs natively on TPU; "
+                    f"off-TPU it needs the interpret escape)")
+        return "compatible"
+
+    def auto_supports(self, variant: str, info: Optional[Mapping]
+                      ) -> Tuple[bool, str]:
+        """Per-call shape heuristic for impl='auto' (info is the call
+        site's shape dict; None = no constraint data, assume yes)."""
+        return True, ""
+
+    def check_variant(self, variant: str) -> None:
+        if variant not in self.VARIANTS:
+            raise ValueError(
+                f"kernels.{self.NAME}: unknown variant {variant!r}; "
+                f"valid: {self.VARIANTS}")
+
+    def pallas(self, variant: str, *args, **kwargs):
+        raise NotImplementedError
+
+    def oracle(self, variant: str, *args, **kwargs):
+        raise NotImplementedError
+
+
+class FlashAttentionOp(KernelOp):
+    """Dense causal flash attention blocks (op 4): wraps
+    ops/transformer/flash_attention.flash_attention; oracle is the
+    plain jnp softmax attention it streams."""
+
+    NAME = "flash_attention"
+
+    def auto_supports(self, variant, info):
+        if not info:
+            return True, ""
+        bq = int(info.get("block_q", 128))
+        bk = int(info.get("block_k", 128))
+        s, sk = int(info.get("seq_len", bq)), int(info.get("kv_len", bk))
+        if s % bq or sk % bk:
+            return False, (f"seq lens ({s},{sk}) not divisible by "
+                           f"blocks ({bq},{bk})")
+        return True, ""
+
+    def pallas(self, variant, *args, **kwargs):
+        from . import flash
+        return flash.flash_attention_pallas(*args, **kwargs)
+
+    def oracle(self, variant, *args, **kwargs):
+        from . import flash
+        return flash.flash_attention_reference(*args, **kwargs)
+
+
+class SparseAttentionOp(KernelOp):
+    """Block-sparse attention under a SparsityConfig layout (satellite:
+    the ad-hoc impl=auto|pallas|xla selection from
+    ops/sparse_attention/sparse_attention.py folded into the registry).
+    Pallas = flash_sparse_attention, oracle = block_sparse_attention."""
+
+    NAME = "sparse_attention"
+
+    def auto_supports(self, variant, info):
+        if not info:
+            return True, ""
+        # the historical auto heuristic, verbatim: kernel only for
+        # plain (bias-free) calls with MXU-shaped blocks and head dims
+        if not info.get("plain", True):
+            return False, "biases route to the XLA gather path"
+        block = int(info.get("block", 0))
+        if block % 128 != 0:
+            return False, f"layout block {block} not a multiple of 128"
+        d = int(info.get("head_dim", 0))
+        if d not in (64, 128, 256):
+            return False, f"head_dim {d} not in (64, 128, 256)"
+        return True, ""
+
+    def pallas(self, variant, q, k, v, layout, block, *, causal=False,
+               key_padding_bias=None, attn_bias=None, dropout_rate=0.0,
+               dropout_rng=None):
+        from ..ops.sparse_attention.flash_sparse import \
+            flash_sparse_attention
+        # the kernel has no bias path; auto never selects it with
+        # biases and the module wrapper routes biased calls to the
+        # oracle (the historical silent-XLA behaviour, now explicit)
+        return flash_sparse_attention(
+            q, k, v, layout, block, causal=causal,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+    def oracle(self, variant, q, k, v, layout, block, *, causal=False,
+               key_padding_bias=None, attn_bias=None, dropout_rate=0.0,
+               dropout_rng=None):
+        from ..ops.sparse_attention.sparse_attention import \
+            block_sparse_attention
+        return block_sparse_attention(
+            q, k, v, layout, block, causal_token_mask=causal,
+            key_padding_bias=key_padding_bias, attn_bias=attn_bias,
+            dropout_rate=dropout_rate, dropout_rng=dropout_rng)
+
+
+class PagedAttentionOp(KernelOp):
+    """Decode-path paged attention (op 1): fused block-table gather +
+    online-softmax attention over the PagedKVCache, with the quantized
+    KV dequant fused into the gather.  Oracle = the gather/einsum/
+    softmax expression serving/programs.py's `_paged_block` always ran
+    (bit-identical serving behaviour wherever the oracle is chosen)."""
+
+    NAME = "paged_attention"
+
+    def auto_supports(self, variant, info):
+        if not info:
+            return True, ""
+        bs = int(info.get("block_size", 0))
+        L = int(info.get("kv_len", bs))
+        if bs <= 0 or L % bs:
+            return False, (f"gathered rows {L} not a whole number of "
+                           f"cache blocks of {bs}")
+        t = int(info.get("q_len", 1))
+        if t > 8:
+            return False, (f"q_len {t} too large for the unrolled "
+                           f"decode kernel (prefill stays on jnp)")
+        d = int(info.get("head_dim", 128))
+        if d % 128:
+            return False, f"head_dim {d} not lane-aligned (128)"
+        return True, ""
+
+    def pallas(self, variant, *args, **kwargs):
+        from . import paged
+        return paged.paged_attention_pallas(*args, **kwargs)
+
+    def oracle(self, variant, *args, **kwargs):
+        from . import paged
+        return paged.paged_attention_reference(*args, **kwargs)
+
+
+class QuantCodecOp(KernelOp):
+    """Blockwise int8/int4 quantize/dequantize (op 2, the ZeRO++ wire
+    codec from runtime/comm/quant.py).  Variants: "quantize" /
+    "dequantize".  Parity is BIT-exact: the kernel runs the oracle's
+    op sequence (subnormal flush -> finite amax -> fp16 scale ->
+    round/clip -> non-finite marker) tile-by-tile; `pack_wire`/
+    `unpack_wire` bitcast glue rides in the wrappers unchanged."""
+
+    NAME = "quant_codec"
+    VARIANTS = ("quantize", "dequantize")
+    EXACT = True
+
+    def auto_supports(self, variant, info):
+        if not info:
+            return True, ""
+        block = int(info.get("block", 0))
+        if block % 128:
+            return False, (f"quant block {block} not lane-aligned "
+                           f"(128)")
+        return True, ""
+
+    def pallas(self, variant, *args, **kwargs):
+        from . import quant_codec
+        if variant == "quantize":
+            return quant_codec.quantize_blockwise_pallas(*args, **kwargs)
+        return quant_codec.dequantize_blockwise_pallas(*args, **kwargs)
+
+    def oracle(self, variant, *args, **kwargs):
+        from ..runtime.comm import quant
+        if variant == "quantize":
+            return quant.quantize_blockwise_ref(*args, **kwargs)
+        return quant.dequantize_blockwise_ref(*args, **kwargs)
+
+
+class MoEDispatchOp(KernelOp):
+    """Sort-based MoE token movement (op 3, moe/dispatch.py).  Variants:
+    "dispatch" (tokens -> [E, C, D] buckets; the kernel reformulates
+    the oracle's scatter-add — whose kept destinations are unique — as
+    a per-slot gather through a precomputed inverse permutation, so
+    parity is BIT-exact) and "combine" (gated gather-back in the same
+    term order; ~1-ulp tolerance, the accumulator may fuse an FMA).
+    """
+
+    NAME = "moe_dispatch"
+    VARIANTS = ("dispatch", "combine")
+    EXACT = True
+
+    def auto_supports(self, variant, info):
+        if not info:
+            return True, ""
+        d = int(info.get("model_dim", 128))
+        if d % 128:
+            return False, f"model dim {d} not lane-aligned (128)"
+        return True, ""
+
+    def pallas(self, variant, *args, **kwargs):
+        from . import moe_kernels
+        if variant == "dispatch":
+            return moe_kernels.sorted_dispatch_pallas(*args, **kwargs)
+        return moe_kernels.sorted_combine_pallas(*args, **kwargs)
+
+    def oracle(self, variant, *args, **kwargs):
+        from ..moe import dispatch as moe_dispatch
+        if variant == "dispatch":
+            return moe_dispatch.sorted_dispatch_ref(*args, **kwargs)
+        return moe_dispatch.sorted_combine_ref(*args, **kwargs)
+
+
+KERNEL_OPS: Dict[str, KernelOp] = {
+    op.NAME: op for op in (FlashAttentionOp(), SparseAttentionOp(),
+                           PagedAttentionOp(), QuantCodecOp(),
+                           MoEDispatchOp())
+}
+
+
+def get_kernel(name: str) -> KernelOp:
+    if name not in KERNEL_OPS:
+        raise ValueError(
+            f"unknown kernel op {name!r}; valid ops: "
+            f"{sorted(KERNEL_OPS)}")
+    return KERNEL_OPS[name]
+
+
+# ---------------------------------------------------------------------------
+# config (the validated "kernels" block; installed like the moe wire)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelConfig:
+    """Process-global kernel selection.  The default-constructed config
+    is the shipping behaviour: auto-probe per op, counters on, no
+    interpret escape."""
+
+    impl: str = "auto"                 # global default: auto|pallas|jnp
+    ops: Mapping[str, str] = dataclasses.field(default_factory=dict)
+    interpret: bool = False            # allow forced pallas off-TPU
+    counters: bool = True
+
+    def impl_for(self, name: str) -> str:
+        return self.ops.get(name, self.impl)
+
+    def describe(self) -> str:
+        per_op = ", ".join(f"{k}={v}" for k, v in sorted(self.ops.items()))
+        return (f"kernels: impl={self.impl}"
+                + (f", {per_op}" if per_op else "")
+                + (", interpret" if self.interpret else ""))
+
+
+def parse_kernels_config(d) -> KernelConfig:
+    """Validate the `"kernels"` config block -> KernelConfig.  Unknown
+    keys, unknown OP NAMES, and invalid impl values all raise HERE — at
+    config time, naming the valid set, never inside a traced program."""
+    d = d or {}
+    if not isinstance(d, dict):
+        raise ValueError(
+            f"kernels must be an object, got {type(d).__name__}")
+    known = {"impl", "ops", "interpret", "counters"}
+    unknown = set(d) - known
+    if unknown:
+        raise ValueError(
+            f"kernels: unknown key(s) {sorted(unknown)}; expected a "
+            f"subset of {sorted(known)}")
+
+    def impl_value(key, v):
+        v = str(v).lower()
+        v = _IMPL_ALIASES.get(v, v)
+        if v not in KERNEL_IMPLS:
+            raise ValueError(
+                f"kernels.{key} must be one of {KERNEL_IMPLS}, "
+                f"got {v!r}")
+        return v
+
+    impl = impl_value("impl", d.get("impl", "auto"))
+
+    ops_d = d.get("ops", {})
+    if not isinstance(ops_d, dict):
+        raise ValueError(
+            f"kernels.ops must be an object mapping op name -> impl, "
+            f"got {type(ops_d).__name__}")
+    ops = {}
+    for name, v in ops_d.items():
+        if name not in KERNEL_OPS:
+            raise ValueError(
+                f"kernels.ops: unknown op {name!r}; registered ops: "
+                f"{sorted(KERNEL_OPS)}")
+        ops[name] = impl_value(f"ops.{name}", v)
+
+    interpret = d.get("interpret", False)
+    if not isinstance(interpret, bool):
+        raise ValueError(
+            f"kernels.interpret must be a bool, got {interpret!r}")
+    counters = d.get("counters", True)
+    if not isinstance(counters, bool):
+        raise ValueError(
+            f"kernels.counters must be a bool, got {counters!r}")
+    return KernelConfig(impl=impl, ops=ops, interpret=interpret,
+                        counters=counters)
+
+
+_KERNEL_CONFIG = KernelConfig()
+
+
+def get_kernel_config() -> KernelConfig:
+    return _KERNEL_CONFIG
+
+
+def set_kernel_config(cfg: KernelConfig) -> KernelConfig:
+    """Install `cfg` process-globally; returns the previous config.
+    Like the moe wire config, selection is read at TRACE time — a
+    config swap affects programs traced after it, never cached ones."""
+    global _KERNEL_CONFIG
+    prev = _KERNEL_CONFIG
+    _KERNEL_CONFIG = cfg
+    if cfg != prev:
+        logger.debug(cfg.describe())
+    return prev
+
+
+@contextlib.contextmanager
+def kernel_config(cfg: Optional[KernelConfig] = None, **kwargs):
+    """Scoped kernel config for direct users / tests:
+    `with kernel_config(impl="jnp"): ...` or
+    `with kernel_config(ops={"quant_codec": "pallas"}, interpret=True)`.
+    Keyword form routes through the REAL validator."""
+    if cfg is None:
+        cfg = parse_kernels_config(kwargs)
+    prev = set_kernel_config(cfg)
+    try:
+        yield get_kernel_config()
+    finally:
+        set_kernel_config(prev)
+
+
+# ---------------------------------------------------------------------------
+# autotuner winner table (the `kernel` scope's output)
+# ---------------------------------------------------------------------------
+
+# op name -> {"impl": "pallas"|"jnp", "fingerprint": dict|None}
+_WINNERS: Dict[str, Dict] = {}
+
+
+def record_winner(name: str, impl: str,
+                  fingerprint: Optional[Mapping] = None) -> None:
+    """Install an autotuner-measured per-op choice.  `fingerprint` is a
+    `kernel_fingerprint(...)` dict; at resolution time the winner only
+    applies while its `fabric` section still matches the live fabric —
+    a backend/device change invalidates it (measured-not-assumed, the
+    PR-14 contract)."""
+    get_kernel(name)
+    impl = _IMPL_ALIASES.get(str(impl).lower(), str(impl).lower())
+    if impl not in ("pallas", "jnp"):
+        raise ValueError(
+            f"kernel winner impl must be 'pallas' or 'jnp', got {impl!r}")
+    _WINNERS[name] = {"impl": impl,
+                      "fingerprint": dict(fingerprint) if fingerprint
+                      else None}
+
+
+def clear_winners() -> None:
+    _WINNERS.clear()
+
+
+def winner_for(name: str) -> Optional[str]:
+    """The recorded winner impl for `name`, or None when absent or
+    recorded on a different fabric."""
+    w = _WINNERS.get(name)
+    if w is None:
+        return None
+    fp = w["fingerprint"]
+    if fp is not None:
+        from ..runtime.autotune.fingerprint import fabric_section
+        if fp.get("fabric") != fabric_section():
+            return None
+    return w["impl"]
+
+
+# ---------------------------------------------------------------------------
+# resolution + dispatch
+# ---------------------------------------------------------------------------
+
+
+def resolve_impl(name: str, variant: str = "default",
+                 impl: Optional[str] = None, interpret_ok: bool = False,
+                 info: Optional[Mapping] = None) -> str:
+    """-> the concrete "pallas" | "jnp" this call will run (raises on
+    an unsatisfiable forced pallas; see module docstring)."""
+    op = get_kernel(name)
+    op.check_variant(variant)
+    cfg = get_kernel_config()
+    choice = impl if impl is not None else cfg.impl_for(name)
+    choice = _IMPL_ALIASES.get(str(choice).lower(), str(choice).lower())
+    if choice not in KERNEL_IMPLS:
+        raise ValueError(
+            f"kernels.{name}: impl must be one of {KERNEL_IMPLS}, "
+            f"got {choice!r}")
+    if choice == "pallas":
+        if not (op.is_compatible() or interpret_ok or cfg.interpret):
+            raise RuntimeError(
+                f"kernels.{name}: impl='pallas' forced but "
+                f"{op.compatibility_message()}; use impl='auto' for the "
+                f"jnp fallback, or set kernels.interpret=true to run "
+                f"the kernel under the Pallas interpreter (tests/bench)")
+        return "pallas"
+    if choice == "jnp":
+        return "jnp"
+    # auto: an autotuned winner (fabric-matched) overrides the heuristic
+    w = winner_for(name)
+    if w == "jnp":
+        return "jnp"
+    if w == "pallas" and op.is_compatible():
+        return "pallas"
+    if op.is_compatible() and op.auto_supports(variant, info)[0]:
+        return "pallas"
+    return "jnp"
+
+
+def dispatch(name: str, *args, variant: str = "default",
+             impl: Optional[str] = None, interpret_ok: bool = False,
+             info: Optional[Mapping] = None, **kwargs):
+    """Run op `name` through the registry's selection contract.
+
+    Bumps `kernel.dispatches` / `kernel.fallbacks` at trace time (the
+    `dist.*` once-per-compiled-program convention)."""
+    op = get_kernel(name)
+    chosen = resolve_impl(name, variant, impl=impl,
+                          interpret_ok=interpret_ok, info=info)
+    if get_kernel_config().counters:
+        COUNTERS.add("kernel.dispatches" if chosen == "pallas"
+                     else "kernel.fallbacks")
+    if chosen == "pallas":
+        return op.pallas(variant, *args, **kwargs)
+    return op.oracle(variant, *args, **kwargs)
+
+
+def probe_report():
+    """[(name, verdict, reason)] for every registered op — verdict is
+    "pallas" or "jnp-fallback" with the decline reason (ds_report's
+    Kernels section; reason is "" when pallas is selected)."""
+    rows = []
+    for name in sorted(KERNEL_OPS):
+        op = KERNEL_OPS[name]
+        if op.is_compatible():
+            rows.append((name, "pallas", ""))
+        else:
+            rows.append((name, "jnp-fallback", op.compatibility_message()))
+    return rows

@@ -335,6 +335,27 @@ def _assignment_tokens(k: int, N: int):
 
 
 def sorted_dispatch(x, eidx, pos, keep, num_experts: int, capacity: int):
+    """Registry-dispatching entry (kernels/registry.py): the Pallas
+    gather kernel when probing selects it (bit-exact), otherwise
+    `sorted_dispatch_ref` below.  Same shapes/contract either way."""
+    from ..kernels import registry
+
+    return registry.dispatch(
+        "moe_dispatch", x, eidx, pos, keep, num_experts, capacity,
+        variant="dispatch", info={"model_dim": x.shape[-1]})
+
+
+def sorted_combine(expert_out, eidx, gate, pos, keep):
+    """Registry-dispatching entry; see `sorted_combine_ref`."""
+    from ..kernels import registry
+
+    return registry.dispatch(
+        "moe_dispatch", expert_out, eidx, gate, pos, keep,
+        variant="combine", info={"model_dim": expert_out.shape[-1]})
+
+
+def sorted_dispatch_ref(x, eidx, pos, keep, num_experts: int,
+                        capacity: int):
     """x [N, D] + routing [k, N] -> expert inputs [E, C, D].
 
     One gather of the selected token rows + one scatter-add into the
@@ -354,7 +375,7 @@ def sorted_dispatch(x, eidx, pos, keep, num_experts: int, capacity: int):
     return buf[:E * C].reshape(E, C, D)
 
 
-def sorted_combine(expert_out, eidx, gate, pos, keep):
+def sorted_combine_ref(expert_out, eidx, gate, pos, keep):
     """expert outputs [E, C, D] + routing -> y [N, D].
 
     Gathers each kept assignment's slot and sums the k rounds' gated

@@ -128,6 +128,13 @@ Instrumented sites:
   regression (step-time or exposed-wire creep); `autotune.swaps` —
   live config swaps applied through the StepBuilder rebuild (search
   winners, cached winners and online retune winners all count here).
+* the Pallas kernel registry (`kernel.*`, deepspeed_tpu/kernels;
+  rendered by monitor/report.py as the "Kernels" section, excluded
+  from the comm byte table): `kernel.dispatches` — registry
+  resolutions that took an op's Pallas path (counted at TRACE time,
+  once per jit trace, not per step); `kernel.fallbacks` — resolutions
+  that ran the jnp oracle instead (incompatible fabric, declined
+  shape, or an explicit jnp pin).
 * trace/SLO telemetry (`trace.*` / `slo.*`, monitor/tracing.py;
   rendered by monitor/report.py as the "Tracing" rows of the Serving
   SLO section, excluded from the comm byte table): `trace.events` —

@@ -249,12 +249,14 @@ def render_markdown(run: Dict[str, Any]) -> str:
     # trace.*/slo.* carry trace-recorder bookkeeping (JSONL bytes,
     # drop counts, SLO window counts), not wire bytes — rendered as
     # the "Serving SLO" section's Tracing rows below
+    # kernel.* counts registry dispatches (Pallas vs jnp-fallback
+    # resolutions), not wire bytes — the "Kernels" section below
     wire_counters = {k: v for k, v in any_comm.items()
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
                                           "elastic.", "serve.", "kv.",
                                           "moe.", "autotune.", "trace.",
-                                          "slo."))
+                                          "slo.", "kernel."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -794,6 +796,27 @@ def render_markdown(run: Dict[str, Any]) -> str:
                 lines.append(f"| {i + 1} | {ev} | {e.get('step', '—')} | "
                              f"{detail} |")
             lines.append("")
+
+    # the Pallas kernel registry (deepspeed_tpu/kernels): trace-time
+    # dispatch resolutions — how often a hot loop ran its Pallas path
+    # vs its jnp oracle fallback (kernel.* is excluded from the comm
+    # byte table above)
+    kern_counters = {k: v for k, v in any_comm.items()
+                     if k.startswith("kernel.")}
+    if kern_counters:
+        lines.append("## Kernels")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        disp = kern_counters.get("kernel.dispatches")
+        if disp:
+            lines.append(f"| Pallas kernel dispatches (trace-time) | "
+                         f"{disp['calls']:,} |")
+        falls = kern_counters.get("kernel.fallbacks")
+        if falls:
+            lines.append(f"| jnp oracle fallbacks (trace-time) | "
+                         f"{falls['calls']:,} |")
+        lines.append("")
 
     qwz = any_comm.get("qwz.gather")
     if qwz:

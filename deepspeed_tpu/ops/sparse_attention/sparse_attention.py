@@ -174,24 +174,27 @@ class SparseSelfAttention:
             rpe = jnp.asarray(rpe, jnp.float32)
             attn_bias = rpe if attn_bias is None else attn_bias + rpe
 
-        # Pallas flash-sparse kernel: streams only active layout blocks
-        # through VMEM (no [.., W, blk, blk] score tiles in HBM). Dropout
-        # runs in-kernel (hash tile masks); biases route to the XLA path.
-        plain = key_padding_bias is None and attn_bias is None
-        want_pallas = self.impl == "pallas" or (
-            self.impl == "auto" and plain
-            and jax.default_backend() == "tpu"
-            and self.sparsity_config.block % 128 == 0
-            and D in (64, 128, 256))
-        if want_pallas and plain:
-            from .flash_sparse import flash_sparse_attention
+        # Selection lives in the kernel registry (kernels/registry.py) —
+        # ONE mechanism for every op.  Pallas = flash_sparse (streams
+        # only active layout blocks through VMEM, in-kernel hash
+        # dropout); the jnp oracle is block_sparse_attention above.
+        # Historical semantics preserved: the module-level impl="pallas"
+        # runs the kernel even off-TPU (under the Pallas interpreter —
+        # interpret_ok), and biased calls always take the oracle (the
+        # kernel has no bias path; silently dropping a mask would be
+        # numerically wrong).
+        from ...kernels import registry
 
-            return flash_sparse_attention(
-                query, key, value, layout, self.sparsity_config.block,
-                causal=causal, dropout_rate=dropout_rate,
-                dropout_rng=dropout_rng)
-        return block_sparse_attention(
-            query, key, value, layout, self.sparsity_config.block,
-            causal_token_mask=causal, key_padding_bias=key_padding_bias,
+        plain = key_padding_bias is None and attn_bias is None
+        impl = None if self.impl == "auto" else self.impl
+        if not plain and impl == "pallas":
+            impl = "jnp"
+        return registry.dispatch(
+            "sparse_attention", query, key, value, layout,
+            self.sparsity_config.block,
+            impl=impl, interpret_ok=True,
+            info={"plain": plain, "block": self.sparsity_config.block,
+                  "head_dim": D},
+            causal=causal, key_padding_bias=key_padding_bias,
             attn_bias=attn_bias, dropout_rate=dropout_rate,
             dropout_rng=dropout_rng)

@@ -42,6 +42,33 @@ def fingerprint_diff(a: Dict[str, Any], b: Dict[str, Any]) -> List[str]:
     return diffs
 
 
+def fabric_section() -> Dict[str, Any]:
+    """The fabric half of a fingerprint: backend, device kind, device
+    count.  Kernel winners are keyed on exactly this — a Pallas-vs-jnp
+    measurement transfers across shapes on the same fabric but never
+    across a backend or device-kind change."""
+    import jax
+
+    devices = jax.devices()
+    return {"backend": jax.default_backend(),
+            "device_kind": devices[0].device_kind if devices else "?",
+            "devices": len(devices)}
+
+
+def kernel_fingerprint(op: str, shape=None, dtype=None) -> Dict[str, Any]:
+    """Fingerprint one kernel-scope probe: which registered op was
+    measured, the representative shape/dtype it was lapped on, and the
+    fabric.  `registry.winner_for` honours a recorded winner only while
+    the `fabric` section still matches `fabric_section()` — the same
+    stale-loudly contract as the engine/serve winner caches."""
+    return make_fingerprint(
+        kernel={"op": str(op),
+                "shape": list(shape) if shape is not None else None,
+                "dtype": str(dtype) if dtype is not None else None},
+        fabric=fabric_section(),
+    )
+
+
 def _model_section(params) -> Dict[str, Any]:
     import jax
     import numpy as np
@@ -65,10 +92,7 @@ def serve_fingerprint(engine) -> Dict[str, Any]:
     contract as `engine_fingerprint`: a cached serve winner is only
     trustworthy for the exact (model, geometry, fabric) it was lapped
     on — a different block size or device kind re-probes loudly."""
-    import jax
-
     c = engine.config
-    devices = jax.devices()
     return make_fingerprint(
         model=_model_section(engine.params),
         geometry={"block_size": c.block_size,
@@ -82,9 +106,7 @@ def serve_fingerprint(engine) -> Dict[str, Any]:
                  "draft_len": int(c.draft_len),
                  "spec_ngram": int(c.spec_ngram),
                  "quantized_weights": c.quant_mode},
-        fabric={"backend": jax.default_backend(),
-                "device_kind": devices[0].device_kind if devices else "?",
-                "devices": len(devices)},
+        fabric=fabric_section(),
     )
 
 
@@ -101,7 +123,6 @@ def engine_fingerprint(engine) -> Dict[str, Any]:
         processes = jax.process_count()
     except Exception:
         processes = 1
-    devices = jax.devices()
     return make_fingerprint(
         model=_model_section(engine._params),
         batch={"micro": cfg.train_micro_batch_size_per_gpu,
@@ -117,10 +138,8 @@ def engine_fingerprint(engine) -> Dict[str, Any]:
               "seq": mi.axis_size("seq"),
               "data_outer": mi.data_outer_size,
               "data_inner": mi.data_inner_size},
-        fabric={"backend": jax.default_backend(),
-                "device_kind": devices[0].device_kind if devices else "?",
-                "devices": len(devices),
-                "processes": processes,
-                "topology": "multi-process" if processes > 1
-                            else "single-process"},
+        fabric=dict(fabric_section(),
+                    processes=processes,
+                    topology="multi-process" if processes > 1
+                             else "single-process"),
     )

@@ -117,6 +117,29 @@ def _flush_subnormals(f32):
 
 
 def quantize_blockwise(x, block: int, wire: str = "int8"):
+    """Flat (or any-shape) tensor -> (payload, fp16 scales), routed
+    through the kernel registry: the Pallas codec when probing selects
+    it (kernels/quant_codec.py, BIT-identical payload), this module's
+    `quantize_blockwise_ref` otherwise.  Same contract either way —
+    the docstring below describes both."""
+    from ...kernels import registry
+
+    return registry.dispatch("quant_codec", x, block, wire,
+                             variant="quantize", info={"block": block})
+
+
+def dequantize_blockwise(payload, scales, wire: str, n_elems: int):
+    """Registry-dispatching inverse; see `dequantize_blockwise_ref`."""
+    from ...kernels import registry
+
+    width = payload.shape[-1]
+    block = width if wire == "int8" else width * 2
+    return registry.dispatch("quant_codec", payload, scales, wire,
+                             n_elems, variant="dequantize",
+                             info={"block": block})
+
+
+def quantize_blockwise_ref(x, block: int, wire: str = "int8"):
     """Flat (or any-shape) tensor -> (payload, fp16 scales).
 
     payload: int8 [n_blocks, block] for "int8", uint8 [n_blocks,
@@ -155,7 +178,7 @@ def quantize_blockwise(x, block: int, wire: str = "int8"):
     return packed, scales
 
 
-def dequantize_blockwise(payload, scales, wire: str, n_elems: int):
+def dequantize_blockwise_ref(payload, scales, wire: str, n_elems: int):
     """(payload, scales) -> fp32 [..., n_elems].
 
     Broadcasts over leading batch dims: an all-gathered wire arrives as

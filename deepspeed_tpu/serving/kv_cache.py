@@ -90,6 +90,17 @@ def resolve_kv_dtype(dtype):
     return "dense", dtype
 
 
+def rows_for_tables(tables, block_size: int):
+    """Block tables [R, W] -> flat cache row indices [R, W * block_size]
+    (row-major walk of each slot's blocks).  THE addressing the serving
+    programs attend through and the paged-attention kernel inverts
+    (`rows[:, ::block_size] // block_size` recovers the table), so the
+    two stay in lockstep by sharing this one definition."""
+    R, W = tables.shape
+    return (tables[:, :, None] * block_size +
+            jnp.arange(block_size)[None, None, :]).reshape(R, -1)
+
+
 def kv_block_bytes(num_layers: int, num_heads: int, head_dim: int,
                    block_size: int, kv_dtype) -> int:
     """Device bytes ONE block costs across all layers (K and V) — the

@@ -58,9 +58,9 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
-from ..models.generation import NEG_INF
 from ..models.gpt import GPT, layer_norm
 from ..utils.logging import logger
+from .kv_cache import rows_for_tables
 
 QUANT_MODES = ("none", "int8", "int4")
 
@@ -161,17 +161,17 @@ def _kv_read(c, rows, kv_mode):
     """Gather cache rows `rows` [B, L] -> [B, L, H, Dh].  Dense reads
     come back at the cache dtype (the downstream casts mirror
     generation._block_with_cache); quantized reads dequantize the
-    gathered rows to fp32 in-program."""
-    if kv_mode == "dense":
-        return c[rows]
-    from ..runtime.comm.quant import dequantize_rows
+    gathered rows to fp32 in-program.  The single definition lives in
+    kernels/paged.py — it doubles as the paged-attention oracle's
+    gather, which is what keeps the registry's jnp path bit-identical
+    to this program."""
+    from ..kernels.paged import kv_read
 
-    payload, scales = c
-    return dequantize_rows(payload[rows], scales[rows], kv_mode)
+    return kv_read(c, rows, kv_mode)
 
 
 def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos,
-                 kv_mode="dense"):
+                 kv_mode="dense", block_size=0):
     """One decoder block over x [B, T, D] with paged KV.
 
     `write_idx` [B*T] flat cache rows this chunk's K/V land in, `rows`
@@ -195,16 +195,19 @@ def _paged_block(p, cfg, x, ck, cv, write_idx, rows, q_pos,
     q, k, v = shape(q), shape(k), shape(v)
     ck = _kv_write(ck, write_idx, k.reshape(B * T, H, Dh), kv_mode)
     cv = _kv_write(cv, write_idx, v.reshape(B * T, H, Dh), kv_mode)
-    keys = _kv_read(ck, rows, kv_mode)      # [B, L, H, Dh]
-    vals = _kv_read(cv, rows, kv_mode)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                        keys.astype(jnp.float32)) * (Dh ** -0.5)
-    L = rows.shape[1]
-    k_idx = jnp.arange(L)[None, None, :]
-    mask = q_pos[:, :, None] >= k_idx            # [B, T, L]
-    scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
-    probs = jax.nn.softmax(scores, axis=-1)
-    attn = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vals.dtype), vals)
+    # attention core through the kernel registry: the jnp oracle
+    # (kernels/paged.py paged_attention_reference) is this block's
+    # pre-registry gather/einsum/softmax chain op-for-op — wherever the
+    # oracle is chosen, serving output is bit-identical; the Pallas
+    # kernel fuses the table gather (+ quantized-KV dequant) into an
+    # online-softmax sweep over cache blocks
+    from ..kernels import registry
+
+    attn = registry.dispatch(
+        "paged_attention", q, ck, cv, rows, q_pos,
+        info={"block_size": block_size, "kv_len": rows.shape[1],
+              "q_len": T, "head_dim": Dh},
+        kv_mode=kv_mode, block_size=block_size)
     attn = attn.reshape(B, T, D)
     attn = attn @ p["attn"]["proj"]["w"].astype(h.dtype) + \
         p["attn"]["proj"]["b"].astype(h.dtype)
@@ -379,7 +382,8 @@ class ServeProgramBuilder:
             for bp, (ck, cv) in zip(params["blocks"], caches):
                 x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
                                          rows, q_pos,
-                                         kv_mode=s.kv_dtype)
+                                         kv_mode=s.kv_dtype,
+                                         block_size=bs)
                 new_caches.append((ck, cv))
             x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
             last = jax.lax.dynamic_slice_in_dim(x, n_valid - 1, 1, axis=1)
@@ -413,14 +417,14 @@ class ServeProgramBuilder:
                 tables, jnp.clip(blk_i, 0, s.table_width - 1)[:, None],
                 axis=1)[:, 0]
             write_idx = jnp.where(active, blk * bs + positions % bs, 0)
-            rows = (tables[:, :, None] * bs +
-                    jnp.arange(bs)[None, None, :]).reshape(R, -1)
+            rows = rows_for_tables(tables, bs)
             q_pos = positions[:, None]
             new_caches = []
             for bp, (ck, cv) in zip(params["blocks"], caches):
                 x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
                                          rows, q_pos,
-                                         kv_mode=s.kv_dtype)
+                                         kv_mode=s.kv_dtype,
+                                         block_size=bs)
                 new_caches.append((ck, cv))
             x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
             logits = _proj_logits(cfg, params, x[:, -1, :])  # [R, V]
@@ -479,14 +483,14 @@ class ServeProgramBuilder:
             # the trash block, the decode convention
             write_idx = jnp.where(valid, blk * bs + abs_pos % bs,
                                   0).reshape(R * T)
-            rows = (tables[:, :, None] * bs +
-                    jnp.arange(bs)[None, None, :]).reshape(R, -1)
+            rows = rows_for_tables(tables, bs)
             q_pos = abs_pos
             new_caches = []
             for bp, (ck, cv) in zip(params["blocks"], caches):
                 x, ck, cv = _paged_block(bp, cfg, x, ck, cv, write_idx,
                                          rows, q_pos,
-                                         kv_mode=s.kv_dtype)
+                                         kv_mode=s.kv_dtype,
+                                         block_size=bs)
                 new_caches.append((ck, cv))
             x = layer_norm(x, params["ln_f"], cfg.layer_norm_eps)
             logits = _proj_logits(

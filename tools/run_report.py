@@ -108,6 +108,11 @@ def selftest() -> int:
             COUNTERS.add("autotune.rejected", calls=2)
             COUNTERS.add("autotune.retunes", calls=1)
             COUNTERS.add("autotune.swaps", calls=1)
+            # the Pallas kernel registry (deepspeed_tpu/kernels):
+            # trace-time dispatch resolutions — rendered as the
+            # "Kernels" section, never comm byte rows
+            COUNTERS.add("kernel.dispatches", calls=4)
+            COUNTERS.add("kernel.fallbacks", calls=2)
             # trace recorder bookkeeping (monitor/tracing.py): event/
             # byte tallies + SLO window count — rendered as the
             # "Serving SLO" section's Tracing rows, never comm byte rows
@@ -255,6 +260,9 @@ def selftest() -> int:
                        "live config swaps applied",
                        "swapped to `flat_fp32`",
                        "online retune: exposed wire creep",
+                       "## Kernels",
+                       "Pallas kernel dispatches (trace-time) | 12",
+                       "jnp oracle fallbacks (trace-time) | 6",
                        "## Serving SLO", "SLO windows emitted | 2",
                        "last window: TTFT p50/p99 | 21.00 / 55.00 ms "
                        "(n=6)",
@@ -294,6 +302,9 @@ def selftest() -> int:
         assert "`autotune.probes`" not in md and \
             "`autotune.swaps`" not in md, \
             "autotune.* rows must not leak into the comm table"
+        assert "`kernel.dispatches`" not in md and \
+            "`kernel.fallbacks`" not in md, \
+            "kernel.* rows must not leak into the comm table"
         assert "`trace.events`" not in md and \
             "`trace.dropped`" not in md and \
             "`slo.windows`" not in md, \
