@@ -68,6 +68,45 @@ def kernel_report(out=sys.stdout):
     print("-" * 74, file=out)
 
 
+def serving_report(out=sys.stdout, engine=None):
+    """The serving-side status block: whether the paged-attention
+    Pallas kernel would engage on this fabric (and the registry's
+    reason when it declines), the configured KV storage dtype, the
+    prefix-cache switch, and the resident pinned-session count.
+    Without a live engine the config rows report `ServeConfig()`
+    defaults — what an engine built here WOULD run with."""
+    from .kernels import probe_report
+
+    verdict, reason = "unknown", "not registered"
+    for name, v, r in probe_report():
+        if name == "paged_attention":
+            verdict, reason = v, r
+            break
+    if engine is not None:
+        cfg = engine.config
+        kv_dtype = engine.kv.quant_wire or (
+            str(cfg.kv_dtype) if cfg.kv_dtype is not None else "dense")
+        sessions = f"{engine.resident_sessions}"
+    else:
+        from .serving.engine import ServeConfig
+
+        cfg = ServeConfig()
+        kv_dtype = (str(cfg.kv_dtype) if cfg.kv_dtype is not None
+                    else "dense") + " (default)"
+        sessions = "0 (no live engine)"
+    kern_s = SUCCESS if verdict == "pallas" else NO
+    kern_tail = verdict if verdict == "pallas" else f"{verdict}: {reason}"
+    pfx = "enabled" if cfg.prefix_cache else "disabled"
+    rows = [("paged attention kernel", f"{kern_s} {kern_tail}"),
+            ("kv cache dtype", kv_dtype),
+            ("prefix cache", pfx),
+            ("resident sessions", sessions)]
+    print("DeepSpeed-TPU serving status:", file=out)
+    for name, val in rows:
+        print(f"{name} {'.' * max(1, 24 - len(name))} {val}", file=out)
+    print("-" * 74, file=out)
+
+
 def _probe_devices(timeout_s: int = 60):
     """Device inventory via a subprocess with a hard timeout: a status
     report must never hang, and accelerator-plugin backend init CAN hang
@@ -130,6 +169,7 @@ def debug_report(out=sys.stdout):
 def main(out=sys.stdout):
     op_report(out=out)
     kernel_report(out=out)
+    serving_report(out=out)
     debug_report(out=out)
 
 

@@ -90,7 +90,30 @@ Instrumented sites:
   time of decode-family dispatches against a QUANTIZED kv cache (XLA
   fuses the row dequant into the attention gather, so the cost is only
   isolable by A/B against a dense lane — serve_bench does exactly
-  that); zero when kv_dtype is dense.
+  that); zero when kv_dtype is dense.  Prefix caching + sessions
+  (rendered as the section's "Prefix cache" rows): `kv.prefix_hits` —
+  admissions that aliased at least one cached block (bytes = blocks
+  aliased instead of recomputed); `kv.prefix_hit_tokens` — prompt
+  tokens whose prefill was SKIPPED because their KV rows were already
+  resident (bytes; counted for both hash-matched and session-pinned
+  admissions — the numerator of the cache hit rate);
+  `kv.cow_copies` — copy-on-write block privatizations when a
+  full-prompt hit must recompute its final token into a LIVE-shared
+  block (bytes = device bytes copied); `kv.session_pins` — session
+  pin events at request finish (bytes = blocks held resident);
+  `kv.prefix_evictions` — refcount-0 cached blocks reclaimed LRU-first
+  by the allocator under pool pressure (distinct from `kv.evictions`,
+  which counts FORCED frees of errored requests' live blocks).
+  Fleet routing (`router.*`, serving/router.py, rendered as the
+  "Fleet router" rows; excluded from the comm byte table like the
+  rest of the serving families): `router.dispatches` — requests
+  dispatched to a replica (bytes += the chosen replica's
+  `kv.blocks_in_use` at dispatch, so bytes/calls is the mean load a
+  dispatch landed on); `router.spills` — dispatches deflected from
+  the least-loaded pick because its queue was full;
+  `router.shed` — requests refused at the front door with every
+  replica queue saturated (returned in state 'error', never
+  enqueued).
 * the MoE wire (`moe.*`, moe/dispatch.py sorted dispatch + explicit
   expert all-to-all; rendered by monitor/report.py as the "MoE wire"
   section, excluded from the comm byte table).  Recorded per EXECUTION

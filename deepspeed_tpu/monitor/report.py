@@ -255,8 +255,8 @@ def render_markdown(run: Dict[str, Any]) -> str:
                      if not k.startswith(("input.", "ckpt.", "fault.",
                                           "watchdog.", "exchange.",
                                           "elastic.", "serve.", "kv.",
-                                          "moe.", "autotune.", "trace.",
-                                          "slo.", "kernel."))
+                                          "router.", "moe.", "autotune.",
+                                          "trace.", "slo.", "kernel."))
                      and k not in _WIRE_TIME_COUNTERS}
     if wire_counters:
         lines.append("## Comm counters (all ranks, whole run)")
@@ -393,6 +393,61 @@ def render_markdown(run: Dict[str, Any]) -> str:
                              f"{total_ms:,.1f} ms total over "
                              f"{dq['calls']:,} dispatches "
                              f"({total_ms / dq['calls']:.2f} ms each) |")
+        # prefix caching + pinned sessions (kv.prefix_*, kv.cow_copies,
+        # kv.session_pins) — sub-rows like speculative decoding
+        hits = serve_counters.get("kv.prefix_hits")
+        hit_tok = serve_counters.get("kv.prefix_hit_tokens")
+        cow = serve_counters.get("kv.cow_copies")
+        pins = serve_counters.get("kv.session_pins")
+        pev = serve_counters.get("kv.prefix_evictions")
+        if hits or hit_tok or cow or pins or pev:
+            lines.append("| **Prefix cache** | |")
+            if hits:
+                lines.append(f"| prefix-hit admissions | "
+                             f"{hits['calls']:,} "
+                             f"({hits['bytes']:,} blocks aliased) |")
+            if hit_tok:
+                rate = ""
+                if pre and (hit_tok["bytes"] + pre["bytes"]):
+                    frac = (hit_tok["bytes"] /
+                            (hit_tok["bytes"] + pre["bytes"]))
+                    rate = f" ({frac:.0%} of prefill tokens)"
+                lines.append(f"| prompt tokens skipped | "
+                             f"{hit_tok['bytes']:,}{rate} |")
+            if cow:
+                lines.append(f"| copy-on-write privatizations | "
+                             f"{cow['calls']:,} "
+                             f"({_fmt_bytes(cow['bytes'])} copied) |")
+            if pins:
+                lines.append(f"| session pins | {pins['calls']:,} "
+                             f"({pins['bytes']:,} blocks held) |")
+            if pev:
+                lines.append(f"| cached blocks reclaimed (LRU) | "
+                             f"{pev['calls']:,} |")
+        lines.append("")
+
+    # fleet router counters (serving/router.py): dispatch balance,
+    # queue spill-over, front-door shedding — their own section
+    router_counters = {k: v for k, v in any_comm.items()
+                      if k.startswith("router.")}
+    if router_counters:
+        lines.append("## Fleet router")
+        lines.append("")
+        lines.append("| metric | value |")
+        lines.append("|---|---|")
+        disp = router_counters.get("router.dispatches")
+        if disp and disp["calls"]:
+            lines.append(f"| requests dispatched | {disp['calls']:,} "
+                         f"(mean load at dispatch "
+                         f"{disp['bytes'] / disp['calls']:.2f} KV "
+                         f"blocks) |")
+        spill = router_counters.get("router.spills")
+        if spill:
+            lines.append(f"| queue spill-overs | {spill['calls']:,} |")
+        rshed = router_counters.get("router.shed")
+        if rshed:
+            lines.append(f"| requests shed at front door | "
+                         f"{rshed['calls']:,} |")
         lines.append("")
 
     # live SLO telemetry: monitor.tracing.ServingSLO windows land in
@@ -476,6 +531,8 @@ def render_markdown(run: Dict[str, Any]) -> str:
         lines.append("|---|---|---|---|---|---|---|---|")
         for name in sorted(sv["lanes"]):
             lane = sv["lanes"][name]
+            if "requests" not in lane:
+                continue  # session lanes render below, not as ?/? rows
             ttft_l, itl = lane.get("ttft_ms", {}), lane.get("itl_ms", {})
             kvb = lane.get("kv_blocks", {})
             lines.append(
@@ -500,6 +557,33 @@ def render_markdown(run: Dict[str, Any]) -> str:
                              f"+{lane['accepted_per_step']:.2f} tok/step "
                              f"(kv {lane.get('kv_dtype', 'dense')}, "
                              f"draft {lane.get('draft_len', 0)})")
+        pfx_lanes = {n: l for n, l in sv["lanes"].items()
+                     if l.get("prefix_hit_rate") is not None
+                     and "requests" in l}
+        if any(l["prefix_hit_rate"] > 0 for l in pfx_lanes.values()):
+            lines.append("")
+            lines.append("Prefix-cache lanes (fraction of prompt tokens "
+                         "served from cache):")
+            for name in sorted(pfx_lanes):
+                lane = pfx_lanes[name]
+                per = lane.get("dispatch_per_replica")
+                lines.append(
+                    f"- {name}: {lane['prefix_hit_rate']:.1%} hit rate"
+                    + (f", dispatches/replica {per}" if per else ""))
+        ses_lanes = {n: l for n, l in sv["lanes"].items()
+                     if "turn2plus_ttft_ms" in l}
+        if ses_lanes:
+            lines.append("")
+            lines.append("Session lanes (multi-turn; TTFT on turns >= 2):")
+            for name in sorted(ses_lanes):
+                lane = ses_lanes[name]
+                t = lane["turn2plus_ttft_ms"]
+                lines.append(
+                    f"- {name}: TTFT p50 {_fmt(t.get('p50'))} ms, "
+                    f"prefill tokens computed "
+                    f"{_fmt(lane.get('prefill_tokens_computed'), 0)}, "
+                    f"served from cache "
+                    f"{_fmt(lane.get('prefix_hit_tokens'), 0)}")
         cont = sv["lanes"].get("continuous")
         stat = sv["lanes"].get("static")
         if cont and stat and cont.get("tokens_per_sec") and \

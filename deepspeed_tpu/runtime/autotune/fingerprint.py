@@ -105,7 +105,10 @@ def serve_fingerprint(engine) -> Dict[str, Any]:
                  (str(c.kv_dtype) if c.kv_dtype is not None else "dense"),
                  "draft_len": int(c.draft_len),
                  "spec_ngram": int(c.spec_ngram),
-                 "quantized_weights": c.quant_mode},
+                 "quantized_weights": c.quant_mode,
+                 "prefix_cache": bool(c.prefix_cache),
+                 "prefix_min_match_blocks": int(c.prefix_min_match_blocks),
+                 "session_ttl_s": float(c.session_ttl_s)},
         fabric=fabric_section(),
     )
 

@@ -21,7 +21,8 @@ Candidate scopes:
           hierarchy mutations only probe through an engine factory
           (tools/autotune_bench.py) and never online.
   serve   inference-side knobs (KV cache storage dtype, speculative
-          draft length) for a ServeEngine.  The `comm` field carries a
+          draft length, prefix-cache enable / min match blocks /
+          session TTL) for a ServeEngine.  The `comm` field carries a
           "serving"-block fragment instead, validated through the REAL
           `DeepSpeedServingConfig` by `generate_serve_candidates`; every
           serve candidate needs a fresh ServeEngine (the KV pool layout
@@ -56,7 +57,8 @@ _KNOB_FIELDS = ("gradient_reduction", "wire_dtype", "wire_dtype_inner",
 
 # the serve scope's knob fields (Candidate.comm carries a "serving"
 # fragment there; see generate_serve_candidates)
-_SERVE_KNOB_FIELDS = ("kv_dtype", "draft_len")
+_SERVE_KNOB_FIELDS = ("kv_dtype", "draft_len", "prefix_cache",
+                      "min_match_blocks", "session_ttl_s")
 
 # the kernel scope's knob view: one synthetic field holding the sorted
 # (op, impl) pin tuple, so distance counts per-op pin differences
@@ -82,10 +84,14 @@ class Candidate(NamedTuple):
             return {"kernel_ops": tuple(sorted(ops.items()))}
         if self.scope == "serve":
             spec = c.get("speculative") or {}
+            pfx = c.get("prefix_cache") or {}
             return {
                 "kv_dtype": c.get("kv_dtype") or "dense",
                 "draft_len": (int(spec.get("draft_len", 0))
                               if spec.get("enabled") else 0),
+                "prefix_cache": bool(pfx.get("enabled", True)),
+                "min_match_blocks": int(pfx.get("min_match_blocks", 1)),
+                "session_ttl_s": float(pfx.get("session_ttl_s", 120.0)),
             }
         hier = c.get("hierarchy", "none")
         if isinstance(hier, dict):
@@ -111,6 +117,10 @@ class Candidate(NamedTuple):
             parts = [f"kv {k['kv_dtype']}"]
             if k["draft_len"]:
                 parts.append(f"spec draft {k['draft_len']}")
+            if not k["prefix_cache"]:
+                parts.append("prefix off")
+            elif k["min_match_blocks"] != 1:
+                parts.append(f"prefix match>={k['min_match_blocks']}")
             return f"{self.name}: " + ", ".join(parts)
         parts = [k["gradient_reduction"]]
         if k["gradient_reduction"] == "bucketed":
@@ -305,16 +315,21 @@ def generate_candidates(
     return out, rejected
 
 
-def _serve_fragment(kv_dtype, draft_len: int) -> Dict:
-    """The "serving"-block fragment a (kv_dtype, draft_len) point maps
-    to — the exact dict a user would write under "serving" in their
-    config, so validating it validates the real surface."""
+def _serve_fragment(kv_dtype, draft_len: int, prefix_cache: bool = True,
+                    min_match_blocks: int = 1,
+                    session_ttl_s: float = 120.0) -> Dict:
+    """The "serving"-block fragment a serve-scope knob point maps to —
+    the exact dict a user would write under "serving" in their config,
+    so validating it validates the real surface."""
     frag: Dict = {"kv_dtype": kv_dtype}
     if draft_len > 0:
         frag["speculative"] = {"enabled": True,
                                "draft_len": int(draft_len)}
     else:
         frag["speculative"] = {"enabled": False}
+    frag["prefix_cache"] = {"enabled": bool(prefix_cache),
+                            "min_match_blocks": int(min_match_blocks),
+                            "session_ttl_s": float(session_ttl_s)}
     return frag
 
 
@@ -323,39 +338,65 @@ def generate_serve_candidates(
         kv_dtypes: Sequence[Optional[str]] = (None, "bf16", "int8",
                                               "int4"),
         draft_lens: Sequence[int] = (0, 2, 4),
+        prefix_modes: Sequence[bool] = (True, False),
+        min_matches: Sequence[int] = (1,),
+        session_ttls: Sequence[float] = (120.0,),
 ) -> Tuple[List[Candidate], int]:
     """Enumerate the serve-scope candidate set: the cartesian product
-    of KV storage modes and speculative draft lengths, each composition
+    of KV storage modes, speculative draft lengths, and prefix-cache
+    knobs (enabled, min match blocks, session TTL), each composition
     run through the REAL `DeepSpeedServingConfig` validator (same
     pruning contract as the comm space: a typo'd dtype or a negative
     draft_len is rejected and counted, never probed).  `head_dim` gates
     int4 — the packed nibble payload needs an even head_dim, so int4
     points are pruned (and counted rejected) on odd-head_dim models,
-    mirroring PagedKVCache's own constructor check.
+    mirroring PagedKVCache's own constructor check.  Disabled prefix
+    points collapse min_match/ttl to their defaults (the knobs are
+    inert with the cache off — enumerating them would duplicate).
 
     `safe_numerics` is True only for kv_dtype None/"fp32" (bit-exact
     vs `generate()`); draft_len alone never flips it — speculation is
     token-identical at matched kv_dtype by construction, it changes
-    WHEN tokens arrive, never WHICH."""
+    WHEN tokens arrive, never WHICH — and the prefix cache never flips
+    it either: aliased blocks are bitwise-identical to recompute by
+    the exactness contract (docs/tutorials/serving.md)."""
     from ..config import DeepSpeedServingConfig
 
     out: List[Candidate] = []
     rejected = 0
+
+    def pfx_points():
+        for on in prefix_modes:
+            if not on:
+                yield (False, 1, 120.0)
+                continue
+            for mm in min_matches:
+                for ttl in session_ttls:
+                    yield (True, int(mm), float(ttl))
+
     for kv in kv_dtypes:
         for draft in draft_lens:
-            if kv == "int4" and int(head_dim) % 2 != 0:
-                rejected += 1
-                continue
-            frag = _serve_fragment(kv, int(draft))
-            try:
-                DeepSpeedServingConfig({"serving": frag})
-            except ValueError:
-                rejected += 1
-                continue
-            name = f"serve_{kv or 'dense'}_d{int(draft)}"
-            out.append(Candidate(
-                name=name, comm=frag, scope="serve",
-                safe_numerics=kv in (None, "fp32", "float32")))
+            for on, mm, ttl in pfx_points():
+                if kv == "int4" and int(head_dim) % 2 != 0:
+                    rejected += 1
+                    continue
+                frag = _serve_fragment(kv, int(draft), on, mm, ttl)
+                try:
+                    DeepSpeedServingConfig({"serving": frag})
+                except ValueError:
+                    rejected += 1
+                    continue
+                name = f"serve_{kv or 'dense'}_d{int(draft)}"
+                if not on:
+                    name += "_nopfx"
+                else:
+                    if mm != 1:
+                        name += f"_m{mm}"
+                    if ttl != 120.0:
+                        name += f"_ttl{int(ttl)}"
+                out.append(Candidate(
+                    name=name, comm=frag, scope="serve",
+                    safe_numerics=kv in (None, "fp32", "float32")))
     return out, rejected
 
 
@@ -408,10 +449,19 @@ def current_serve_candidate(engine) -> Candidate:
     kv = engine.kv.quant_wire  # "int8"/"int4" or None (dense)
     if kv is None and c.kv_dtype is not None:
         kv = str(c.kv_dtype)
-    frag = _serve_fragment(kv, int(c.draft_len))
+    frag = _serve_fragment(kv, int(c.draft_len), bool(c.prefix_cache),
+                           int(c.prefix_min_match_blocks),
+                           float(c.session_ttl_s))
+    name = f"serve_{kv or 'dense'}_d{int(c.draft_len)}"
+    if not c.prefix_cache:
+        name += "_nopfx"
+    else:
+        if int(c.prefix_min_match_blocks) != 1:
+            name += f"_m{int(c.prefix_min_match_blocks)}"
+        if float(c.session_ttl_s) != 120.0:
+            name += f"_ttl{int(c.session_ttl_s)}"
     return Candidate(
-        name=f"serve_{kv or 'dense'}_d{int(c.draft_len)}",
-        comm=frag, scope="serve",
+        name=name, comm=frag, scope="serve",
         safe_numerics=kv in (None, "fp32", "float32"))
 
 

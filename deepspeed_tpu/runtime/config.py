@@ -634,7 +634,11 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
 
     "serving": {"kv_dtype": null,
                 "speculative": {"enabled": false, "draft_len": 4,
-                                "ngram": 3}}
+                                "ngram": 3},
+                "prefix_cache": {"enabled": true, "min_match_blocks": 1,
+                                 "session_ttl_s": 120.0},
+                "fleet": {"replicas": 1, "queue_limit": 64,
+                          "session_affinity": true}}
 
     `kv_dtype` selects the paged KV cache's storage mode: null stores
     at the param dtype; "bf16"/"fp16"/"fp32" store dense at that dtype;
@@ -642,16 +646,19 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
     pairs (runtime/comm/quant.py row kernels).  `speculative.enabled`
     arms self-speculative n-gram decoding: `draft_len` candidate tokens
     drafted host-side per verify step by an `ngram`-suffix match over
-    the request's own context (no extra model).  Every knob is
-    validated HERE so a typo fails at config time, not mid-serve; the
-    autotuner's "serve" scope re-validates its candidate fragments
-    through this class so the search space can never propose an
-    illegal config."""
+    the request's own context (no extra model).  `prefix_cache` governs
+    block-level KV sharing (serving/kv_cache.py chain hashes) and the
+    pinned-session residency window; `fleet` sizes the multi-replica
+    router (serving/router.py).  Every knob is validated HERE so a typo
+    fails at config time, not mid-serve; the autotuner's "serve" scope
+    re-validates its candidate fragments through this class so the
+    search space can never propose an illegal config."""
 
     def __init__(self, param_dict):
         super().__init__()
         d = param_dict.get(c.SERVING) or {}
-        known = {c.SERVING_KV_DTYPE, c.SERVING_SPECULATIVE}
+        known = {c.SERVING_KV_DTYPE, c.SERVING_SPECULATIVE,
+                 c.SERVING_PREFIX_CACHE, c.SERVING_FLEET}
         unknown = set(d) - known
         if unknown:
             raise ValueError(
@@ -692,6 +699,61 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
         self.spec_ngram = spec_int(c.SERVING_SPEC_NGRAM,
                                    c.SERVING_SPEC_NGRAM_DEFAULT)
 
+        p = d.get(c.SERVING_PREFIX_CACHE) or {}
+        known_p = {c.SERVING_PREFIX_ENABLED,
+                   c.SERVING_PREFIX_MIN_MATCH_BLOCKS,
+                   c.SERVING_PREFIX_SESSION_TTL_S}
+        unknown = set(p) - known_p
+        if unknown:
+            raise ValueError(
+                f"serving.{c.SERVING_PREFIX_CACHE}: unknown key(s) "
+                f"{sorted(unknown)}; expected a subset of {sorted(known_p)}")
+        self.prefix_enabled = bool(get_scalar_param(
+            p, c.SERVING_PREFIX_ENABLED, c.SERVING_PREFIX_ENABLED_DEFAULT))
+        mm = get_scalar_param(p, c.SERVING_PREFIX_MIN_MATCH_BLOCKS,
+                              c.SERVING_PREFIX_MIN_MATCH_BLOCKS_DEFAULT)
+        if isinstance(mm, bool) or not isinstance(mm, int) or mm < 1:
+            raise ValueError(
+                f"serving.prefix_cache.{c.SERVING_PREFIX_MIN_MATCH_BLOCKS} "
+                f"must be an int >= 1, got {mm!r}")
+        self.prefix_min_match_blocks = int(mm)
+        ttl = get_scalar_param(p, c.SERVING_PREFIX_SESSION_TTL_S,
+                               c.SERVING_PREFIX_SESSION_TTL_S_DEFAULT)
+        try:
+            ttl = float(ttl)
+        except (TypeError, ValueError):
+            ttl = -1.0
+        if ttl <= 0:
+            raise ValueError(
+                f"serving.prefix_cache.{c.SERVING_PREFIX_SESSION_TTL_S} "
+                f"must be a second count > 0, got "
+                f"{p.get(c.SERVING_PREFIX_SESSION_TTL_S)!r}")
+        self.session_ttl_s = ttl
+
+        f = d.get(c.SERVING_FLEET) or {}
+        known_f = {c.SERVING_FLEET_REPLICAS, c.SERVING_FLEET_QUEUE_LIMIT,
+                   c.SERVING_FLEET_SESSION_AFFINITY}
+        unknown = set(f) - known_f
+        if unknown:
+            raise ValueError(
+                f"serving.{c.SERVING_FLEET}: unknown key(s) "
+                f"{sorted(unknown)}; expected a subset of {sorted(known_f)}")
+
+        def fleet_int(key, default):
+            v = get_scalar_param(f, key, default)
+            if isinstance(v, bool) or not isinstance(v, int) or v < 1:
+                raise ValueError(
+                    f"serving.fleet.{key} must be an int >= 1, got {v!r}")
+            return int(v)
+
+        self.fleet_replicas = fleet_int(c.SERVING_FLEET_REPLICAS,
+                                        c.SERVING_FLEET_REPLICAS_DEFAULT)
+        self.fleet_queue_limit = fleet_int(
+            c.SERVING_FLEET_QUEUE_LIMIT, c.SERVING_FLEET_QUEUE_LIMIT_DEFAULT)
+        self.fleet_session_affinity = bool(get_scalar_param(
+            f, c.SERVING_FLEET_SESSION_AFFINITY,
+            c.SERVING_FLEET_SESSION_AFFINITY_DEFAULT))
+
     def to_serve_kwargs(self):
         """The ServeConfig fragment this block selects: feed as
         `ServeConfig(**cfg.serving_config.to_serve_kwargs(), ...)`.
@@ -702,6 +764,19 @@ class DeepSpeedServingConfig(DeepSpeedConfigObject):
             "kv_dtype": self.kv_dtype,
             "draft_len": self.spec_draft_len if self.spec_enabled else 0,
             "spec_ngram": self.spec_ngram,
+            "prefix_cache": self.prefix_enabled,
+            "prefix_min_match_blocks": self.prefix_min_match_blocks,
+            "session_ttl_s": self.session_ttl_s,
+        }
+
+    def to_fleet_kwargs(self):
+        """The FleetRouter sizing this block selects: feed as
+        `FleetRouter(build_fleet(..., replicas=k['replicas']),
+        queue_limit=k['queue_limit'], ...)`."""
+        return {
+            "replicas": self.fleet_replicas,
+            "queue_limit": self.fleet_queue_limit,
+            "session_affinity": self.fleet_session_affinity,
         }
 
 

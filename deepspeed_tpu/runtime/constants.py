@@ -406,6 +406,16 @@ AUTOTUNE_ONLINE_SAFE_ONLY_DEFAULT = True
 #     "enabled": false,        # arm self-speculative n-gram decoding
 #     "draft_len": 4,          # candidate tokens per verify step
 #     "ngram": 3               # suffix-match length of the host drafter
+#   },
+#   "prefix_cache": {
+#     "enabled": true,         # block-level prefix sharing + sessions
+#     "min_match_blocks": 1,   # shortest chain worth aliasing
+#     "session_ttl_s": 120.0   # pinned-session residency window
+#   },
+#   "fleet": {
+#     "replicas": 1,           # in-process ServeEngine replicas
+#     "queue_limit": 64,       # per-replica waiting-queue cap
+#     "session_affinity": true # pinned sessions land on their replica
 #   }
 # }
 #############################################
@@ -419,6 +429,20 @@ SERVING_SPEC_DRAFT_LEN = "draft_len"
 SERVING_SPEC_DRAFT_LEN_DEFAULT = 4
 SERVING_SPEC_NGRAM = "ngram"
 SERVING_SPEC_NGRAM_DEFAULT = 3
+SERVING_PREFIX_CACHE = "prefix_cache"
+SERVING_PREFIX_ENABLED = "enabled"
+SERVING_PREFIX_ENABLED_DEFAULT = True
+SERVING_PREFIX_MIN_MATCH_BLOCKS = "min_match_blocks"
+SERVING_PREFIX_MIN_MATCH_BLOCKS_DEFAULT = 1
+SERVING_PREFIX_SESSION_TTL_S = "session_ttl_s"
+SERVING_PREFIX_SESSION_TTL_S_DEFAULT = 120.0
+SERVING_FLEET = "fleet"
+SERVING_FLEET_REPLICAS = "replicas"
+SERVING_FLEET_REPLICAS_DEFAULT = 1
+SERVING_FLEET_QUEUE_LIMIT = "queue_limit"
+SERVING_FLEET_QUEUE_LIMIT_DEFAULT = 64
+SERVING_FLEET_SESSION_AFFINITY = "session_affinity"
+SERVING_FLEET_SESSION_AFFINITY_DEFAULT = True
 
 #############################################
 # Kernels (deepspeed_tpu.kernels) — the Pallas hot-loop op registry

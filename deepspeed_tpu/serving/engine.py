@@ -35,6 +35,17 @@ acceptance-rate numerator; accepted/decode_steps is the extra
 tokens/step speculation bought), and `kv.dequant_ms` (µs-in-bytes:
 decode-family dispatch wall time against a QUANTIZED cache).
 
+Prefix caching + pinned sessions (PR 19): admission aliases the
+request's already-cached full prompt blocks (serving/kv_cache.py chain
+hashes) so prefill starts at the first non-cached position, and a
+request submitted with a `session_id` keeps its blocks resident after
+finishing (`SessionPin`, TTL + pressure-released) so the conversation's
+next turn re-prefills only its new tokens.  Both are table-entry
+aliasing — the programs are untouched, which is what keeps greedy
+output bitwise-identical with the cache on or off.  Counters:
+`kv.prefix_hits`, `kv.prefix_hit_tokens`, `kv.cow_copies`,
+`kv.session_pins`, `kv.prefix_evictions`.
+
 Speculative decoding (`draft_len > 0`): each decode step becomes a
 verify step — a host-side n-gram drafter proposes up to `draft_len`
 candidates per slot from the request's own emitted tokens, the batched
@@ -85,6 +96,9 @@ class ServeConfig:
     #                                   "int8" | "int4" | dtype-like
     draft_len: int = 0                # speculative candidates per step
     spec_ngram: int = 3               # suffix n-gram the drafter matches
+    prefix_cache: bool = True         # block-level prefix sharing
+    prefix_min_match_blocks: int = 1  # shortest chain worth aliasing
+    session_ttl_s: float = 120.0      # pinned-session residency window
 
     def __post_init__(self):
         for name in ("block_size", "max_batch", "prefill_chunk",
@@ -116,10 +130,35 @@ class ServeConfig:
         if int(self.spec_ngram) < 1:
             raise ValueError(
                 f"serving spec_ngram must be >= 1, got {self.spec_ngram}")
+        if int(self.prefix_min_match_blocks) < 1:
+            raise ValueError(
+                f"serving prefix_min_match_blocks must be >= 1, got "
+                f"{self.prefix_min_match_blocks}")
+        if float(self.session_ttl_s) <= 0:
+            raise ValueError(
+                f"serving session_ttl_s must be > 0, got "
+                f"{self.session_ttl_s}")
 
     @property
     def quant_mode(self) -> str:
         return self.quantized_weights if self.quantized_weights else "none"
+
+
+@dataclasses.dataclass
+class SessionPin:
+    """One resident session: a finished request's KV blocks held by an
+    extra reference so the next turn re-prefills only its new tokens.
+    `tokens` is the full history (prompt + output) the pin's blocks
+    encode; `cached_len` the rows actually written (the final emitted
+    token's K/V never is — its row is recomputed by the next turn's
+    prefill)."""
+
+    sid: Any
+    owner: Any                        # the kv allocator's owner key
+    tokens: List[int]
+    cached_len: int
+    blocks: int
+    expires: float
 
 
 class ServeEngine:
@@ -146,15 +185,28 @@ class ServeEngine:
 
             mesh_info = peek_mesh()
         self.mesh_info = mesh_info
+        # the chain-hash salt: anything that changes K/V block CONTENT
+        # for the same token ids must key the prefix cache (the kv
+        # storage mode is folded in by the cache itself)
+        prefix_salt = (f"{cfg.num_layers}|{cfg.num_heads}|{cfg.head_dim}|"
+                       f"{cfg.vocab_size}|{cfg.max_seq_len}|{c.quant_mode}")
         self.kv = PagedKVCache(
             num_layers=cfg.num_layers, num_heads=cfg.num_heads,
             head_dim=cfg.head_dim, num_blocks=c.num_blocks,
             block_size=c.block_size, table_width=table_width,
             dtype=(cfg.param_dtype if c.kv_dtype is None else c.kv_dtype),
-            mesh_info=mesh_info)
+            mesh_info=mesh_info, prefix_cache=c.prefix_cache,
+            min_match_blocks=c.prefix_min_match_blocks,
+            prefix_salt=prefix_salt)
         self.scheduler = Scheduler(self.kv, c.max_batch,
                                    admission=c.admission, clock=clock,
                                    draft_len=int(c.draft_len))
+        # resident sessions (sid -> SessionPin), insertion-ordered so
+        # pressure release walks oldest-pinned first
+        self._sessions: "dict[Any, SessionPin]" = {}
+        if c.prefix_cache:
+            self.scheduler.session_lookup = self._session_lookup
+            self.scheduler.session_consumed = self._session_consumed
         schedule = ServeSchedule(
             max_batch=c.max_batch, prefill_chunk=c.prefill_chunk,
             block_size=c.block_size, num_blocks=c.num_blocks,
@@ -224,7 +276,8 @@ class ServeEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               eos_token: Optional[int] = None) -> Request:
+               eos_token: Optional[int] = None,
+               session_id: Optional[Any] = None) -> Request:
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("prompt must be non-empty")
@@ -241,10 +294,86 @@ class ServeEngine:
                 f"{top_k}, {temperature}")
         req = Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                       temperature=float(temperature), top_k=int(top_k),
-                      seed=int(seed), eos_token=eos_token)
+                      seed=int(seed), eos_token=eos_token,
+                      session_id=session_id)
         self.scheduler.submit(req)
         self._wake.set()
         return req
+
+    # -- pinned sessions ----------------------------------------------
+
+    @property
+    def resident_sessions(self) -> int:
+        return len(self._sessions)
+
+    def _session_lookup(self, req: Request):
+        """Scheduler hook: the pin `req` can adopt, or None.  A pin is
+        only served when its history is a PREFIX of the new prompt —
+        anything else (edited history, expired TTL) releases the pin
+        and falls back to chain-hash matching, which still catches the
+        registered full blocks."""
+        s = self._sessions.get(req.session_id)
+        if s is None:
+            return None
+        n = len(s.tokens)
+        if (s.expires <= self.clock() or n > len(req.prompt)
+                or req.prompt[:n] != s.tokens):
+            self.release_session(s.sid)
+            return None
+        return s
+
+    def _session_consumed(self, req: Request, pin: SessionPin) -> None:
+        """Scheduler hook: the pin's blocks now belong to `req`."""
+        self._sessions.pop(pin.sid, None)
+
+    def _pin_session(self, req: Request) -> None:
+        """Keep a naturally-finished session request's blocks resident
+        (one extra reference each) so turn k+1 re-prefills only its new
+        tokens.  Called BEFORE scheduler.finish drops the request's own
+        references — net effect: the blocks stay held by the pin."""
+        sid = req.session_id
+        old = self._sessions.pop(sid, None)
+        if old is not None:
+            self.kv.free(old.owner)
+        owner = ("session", sid, req.rid)
+        n = self.kv.pin(owner, req.rid)
+        if not n:
+            return
+        self._sessions[sid] = SessionPin(
+            sid=sid, owner=owner, tokens=req.prompt + req.out,
+            cached_len=req.cached_len, blocks=n,
+            expires=self.clock() + float(self.config.session_ttl_s))
+        COUNTERS.add("kv.session_pins", nbytes=n)
+
+    def release_session(self, sid) -> bool:
+        """Drop a session's pin (its registered blocks stay matchable
+        from the prefix LRU until evicted).  Returns True if held."""
+        s = self._sessions.pop(sid, None)
+        if s is None:
+            return False
+        self.kv.free(s.owner)
+        return True
+
+    def _expire_sessions(self) -> None:
+        now = self.clock()
+        for sid in [sid for sid, s in self._sessions.items()
+                    if s.expires <= now]:
+            self.release_session(sid)
+
+    def _session_pressure_release(self) -> None:
+        """KV-pressure valve: while admission is starving the queue
+        head with a decode slot free (so the shortfall is blocks, not
+        slots), release pinned sessions oldest-first and retry — a
+        waiting request always outranks a resident session."""
+        sch = self.scheduler
+        while (sch.n_waiting and self._sessions
+               and any(s is None for s in sch.slots)
+               and not (sch.admission == "static"
+                        and any(s is not None for s in sch.slots))):
+            oldest = next(iter(self._sessions))
+            self.release_session(oldest)
+            if sch.admit():
+                break
 
     # -- tracing / SLO telemetry --------------------------------------
 
@@ -321,7 +450,9 @@ class ServeEngine:
         if self._watchdog is not None:
             self._watchdog.beat(self.steps)
         fault_point("serve.admit")
+        self._expire_sessions()
         self.scheduler.admit()
+        self._session_pressure_release()
         if self._slo is not None:
             # depth AFTER admission = backlog the cache/slots could not
             # absorb this step, the saturation signal SLO windows want
@@ -398,12 +529,23 @@ class ServeEngine:
         req.cached_len = req.prefill_pos
         COUNTERS.add("serve.prefill_chunks", nbytes=n_valid)
         if tr is not None:
+            # cached/computed: the prefix-cache outcome per request —
+            # how many prompt tokens this request never prefilled
             tr.add_complete("prefill_chunk", "serve", ts_us=tus0,
                             dur_us=tr.now_us() - tus0, rid=req.rid,
-                            pos=pos0, n=n_valid)
+                            pos=pos0, n=n_valid,
+                            cached=req.prefix_cached_tokens,
+                            computed=(len(req.prompt)
+                                      - req.prefix_cached_tokens))
         if req.prefill_pos < len(req.prompt):
             return
-        # final chunk: the program sampled the request's FIRST token
+        # final chunk committed: publish the prompt's full blocks under
+        # their chain hashes, starting past any adopted (decode-written)
+        # region — only prefill-written rows are bitwise-reproducible
+        if req.block_hashes:
+            start = -(-req.prefix_cached_tokens // self.kv.block_size)
+            self.kv.register_prefix(req.rid, req.block_hashes, start)
+        # the program sampled the request's FIRST token
         first = int(tok)
         now = self.clock()
         req.t_first_token = now
@@ -624,6 +766,8 @@ class ServeEngine:
         if tr is not None:
             tr.instant("finish", "serve", rid=req.rid,
                        tokens=len(req.out))
+        if req.session_id is not None and self.config.prefix_cache:
+            self._pin_session(req)
         self.scheduler.finish(req, FINISHED)
 
     # -- watchdog / worker integration ---------------------------------
@@ -649,6 +793,8 @@ class ServeEngine:
                      if t is not None and t.is_alive()])
 
     def close(self) -> None:
+        for sid in list(self._sessions):
+            self.release_session(sid)
         if self._worker is not None:
             self._worker.stop()
             self._worker = None

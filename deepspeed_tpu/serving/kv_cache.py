@@ -1,5 +1,5 @@
-"""Paged KV cache: fixed-size blocks, a free-list allocator, per-request
-block tables.
+"""Paged KV cache: fixed-size blocks, a refcounted free-list allocator,
+per-request block tables, and a block-level prefix cache.
 
 The serving problem the static cache in models/generation.py cannot
 solve: a decode batch whose membership changes every step.  A contiguous
@@ -12,6 +12,31 @@ request returns them the same step, and the decode program addresses KV
 through a per-request block table — so fragmentation is bounded at one
 partially-filled block per request and admission is a free-list check,
 not a compaction.
+
+Prefix cache (PR 19): a FULL, immutable block's content is named by a
+token-id chain hash `h_i = H(h_{i-1}, tokens_in_block_i)` salted with
+the model fingerprint + kv storage mode, so two requests sharing a
+prompt prefix resolve to the same hash chain.  Blocks become refcounted:
+N requests alias ONE physical block by putting the same id in their
+tables (the paged-attention gather cannot tell — `serving/programs.py`
+is untouched on the read path, which is what keeps greedy serving
+bitwise-identical to `generate()` with the cache on).  A finished
+holder's registered blocks are not freed but parked in an LRU of
+refcount-0 blocks: still matchable, evicted (hash deregistered, block
+reused) only when the free list runs dry — never a live holder.  The
+partially-filled tail block is always private (only full blocks are
+hashed), and the one write that can land in a shared block — the
+recompute of the final prompt token when the whole prompt is cached —
+goes copy-on-write: sole registered holder is adopted in place, a
+live-shared block is row-copied to a private block first
+(`kv.cow_copies`).  Only prefill-written rows are ever registered;
+decode-written rows (whose bitwise equality with a prefill recompute is
+not pinned) stay private to their request/session.
+
+Session pins ride the same refcounts: `pin(owner, rid)` takes one extra
+reference on a finished request's blocks so a follow-up turn can adopt
+them wholesale (`alloc_from_pin` transfers ownership, no copies) and
+re-prefill only its new tokens.
 
 Device layout: per layer, K and V each live in ONE flat array
 `[num_blocks * block_size, block_size-major]` -> shaped
@@ -34,7 +59,10 @@ shards scales `[rows, H]` alongside the payload.  The programs
 dequantize gathered rows to fp32 in-program (serving/programs.py) —
 at matched kv_dtype both the speculative and the plain decode path
 read identical quantized rows, which is what keeps the spec-decode
-parity pin exact even at int4.
+parity pin exact even at int4.  Quantized rows are pure functions of
+the token prefix like dense rows, so prefix aliasing stays bitwise at
+int8/int4 too (the chain hash is salted with the storage mode, so a
+dense block is never served to an int8 engine).
 
 Block 0 is the reserved TRASH block: the allocator never hands it out,
 block tables are padded with it, and inactive decode slots write to it —
@@ -46,12 +74,19 @@ Counters (monitor/counters.py): `kv.blocks_in_use` is sampled by the
 engine each step (bytes += in-use blocks, mean = bytes/calls, the
 input.queue_depth convention); `kv.evictions` counts blocks reclaimed
 from requests that did NOT finish naturally (shed / errored), i.e.
-forced frees — a healthy run keeps it at zero.
+forced frees — a healthy run keeps it at zero.  The prefix cache adds
+`kv.prefix_hits` (admissions that aliased cached blocks; bytes =
+blocks aliased), `kv.prefix_hit_tokens` (bytes = prompt tokens whose
+prefill was skipped), `kv.cow_copies` (bytes = device bytes copied),
+`kv.session_pins` (bytes = blocks pinned) and `kv.prefix_evictions`
+(refcount-0 cached blocks LRU-evicted to serve an allocation).
 """
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import hashlib
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -124,11 +159,17 @@ class PagedKVCache:
     of (k, v) per layer, each `[num_blocks * block_size, H, Dh]`.  The
     engine passes it into a program and stores the returned (donated)
     arrays back; this object owns the allocator book-keeping only.
+
+    Owners are opaque hashable keys: the scheduler uses request rids,
+    the session store uses `("session", sid)` tuples — both walk the
+    same refcount/free paths.
     """
 
     def __init__(self, num_layers: int, num_heads: int, head_dim: int,
                  num_blocks: int, block_size: int, table_width: int,
-                 dtype=jnp.float32, mesh_info=None):
+                 dtype=jnp.float32, mesh_info=None,
+                 prefix_cache: bool = True, min_match_blocks: int = 1,
+                 prefix_salt: str = ""):
         if num_blocks < 2:
             raise ValueError(
                 f"num_blocks must be >= 2 (block 0 is the reserved trash "
@@ -137,6 +178,9 @@ class PagedKVCache:
             raise ValueError(f"block_size must be >= 1, got {block_size}")
         if table_width < 1:
             raise ValueError(f"table_width must be >= 1, got {table_width}")
+        if int(min_match_blocks) < 1:
+            raise ValueError(
+                f"min_match_blocks must be >= 1, got {min_match_blocks}")
         self.num_layers = int(num_layers)
         self.num_heads = int(num_heads)
         self.head_dim = int(head_dim)
@@ -158,8 +202,24 @@ class PagedKVCache:
         # block 0 reserved as trash; LIFO free list so the fragmentation
         # tests exercise immediate reuse of just-freed blocks
         self._free: List[int] = list(range(self.num_blocks - 1, 0, -1))
-        self._owned: Dict[int, List[int]] = {}
+        self._owned: Dict[Any, List[int]] = {}
+        # holders per block (live requests + session pins); absent = 0
+        self._ref: Dict[int, int] = {}
         self.evictions = 0
+        # -- prefix cache state ---------------------------------------
+        self.prefix_enabled = bool(prefix_cache)
+        self.min_match_blocks = int(min_match_blocks)
+        mode_name = self.quant_wire or jnp.dtype(self.dense_dtype).name
+        self._salt = hashlib.blake2b(
+            f"{prefix_salt}|{mode_name}|{self.block_size}".encode(),
+            digest_size=16).digest()
+        self._hash_index: Dict[bytes, int] = {}   # chain hash -> block
+        self._block_hash: Dict[int, bytes] = {}   # block -> chain hash
+        # refcount-0 registered blocks, oldest first (the eviction order)
+        self._lru: "OrderedDict[int, None]" = OrderedDict()
+        self.cow_copies = 0
+        self.prefix_evictions = 0
+        self._copy_fn = None                      # lazy jitted block copy
 
     # -- device state -------------------------------------------------
 
@@ -234,20 +294,54 @@ class PagedKVCache:
 
     @property
     def blocks_in_use(self) -> int:
-        return self.capacity_blocks - len(self._free)
+        """Blocks with a live holder (request or session pin).
+        Refcount-0 cached blocks parked in the LRU are NOT in use —
+        they are reclaimable the moment an allocation needs them."""
+        return self.capacity_blocks - len(self._free) - len(self._lru)
 
     @property
     def free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: the free list plus the refcount-0
+        cached blocks the LRU would evict to serve an allocation."""
+        return len(self._free) + len(self._lru)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Hash-registered blocks (live holders + LRU residents)."""
+        return len(self._hash_index)
 
     def blocks_needed(self, n_tokens: int) -> int:
         return -(-int(n_tokens) // self.block_size)
 
-    def alloc(self, rid: int, n_blocks: int) -> Optional[np.ndarray]:
-        """Allocate `n_blocks` for request `rid`; returns the padded
-        block table `[table_width] int32` (unused entries point at the
-        trash block) or None when the free list cannot cover it."""
+    def _take_free(self) -> int:
+        """Pop one allocatable block, evicting the coldest refcount-0
+        cached block when the free list is dry.  Callers check
+        `free_blocks` first; eviction never touches a live holder."""
+        if self._free:
+            return self._free.pop()
+        block, _ = self._lru.popitem(last=False)   # oldest first
+        h = self._block_hash.pop(block, None)
+        if h is not None:
+            self._hash_index.pop(h, None)
+        self.prefix_evictions += 1
+        COUNTERS.add("kv.prefix_evictions")
+        return block
+
+    def alloc(self, rid, n_blocks: int,
+              shared: Optional[Sequence[int]] = None,
+              privatize_last: bool = False) -> Optional[np.ndarray]:
+        """Allocate `n_blocks` table entries for request `rid`; returns
+        the padded block table `[table_width] int32` (unused entries
+        point at the trash block) or None when the pool cannot cover
+        the FRESH share.  `shared` aliases already-cached blocks (from
+        `match_prefix`) as the table's leading entries — each gains a
+        reference instead of costing a fresh block.  `privatize_last`
+        handles the whole-prompt-cached case, where prefill must
+        rewrite the final prompt token inside the last shared block:
+        a refcount-0 (LRU) block is adopted in place, a live-shared
+        block is copied to a private block first (copy-on-write)."""
         n_blocks = int(n_blocks)
+        shared = list(shared or ())
         if rid in self._owned:
             raise ValueError(f"request {rid} already holds blocks")
         if n_blocks > self.table_width:
@@ -255,29 +349,207 @@ class PagedKVCache:
                 f"request {rid} needs {n_blocks} blocks > table width "
                 f"{self.table_width} (engine capacity "
                 f"{self.table_width * self.block_size} tokens)")
-        if n_blocks > len(self._free):
+        if len(shared) > n_blocks:
+            raise ValueError(
+                f"request {rid}: {len(shared)} shared blocks exceed the "
+                f"{n_blocks}-block table")
+        cow = (privatize_last and bool(shared)
+               and self._ref.get(shared[-1], 0) > 0)
+        fresh = n_blocks - len(shared) + (1 if cow else 0)
+        if fresh > self.free_blocks:
             return None
-        blocks = [self._free.pop() for _ in range(n_blocks)]
+        blocks: List[int] = []
+        cow_pair = None
+        for i, b in enumerate(shared):
+            if privatize_last and i == len(shared) - 1:
+                if self._ref.get(b, 0) == 0:
+                    # sole cached holder: adopt the block in place (it
+                    # keeps its hash — the rewrite of the final prompt
+                    # token is bitwise-identical by the chunk-invariance
+                    # pin, so the registration stays truthful)
+                    self._lru.pop(b, None)
+                    self._ref[b] = 1
+                    blocks.append(b)
+                else:
+                    nb = self._take_free()
+                    self._ref[nb] = 1
+                    cow_pair = (b, nb)
+                    blocks.append(nb)
+                continue
+            if self._ref.get(b, 0) == 0:
+                self._lru.pop(b, None)
+            self._ref[b] = self._ref.get(b, 0) + 1
+            blocks.append(b)
+        for _ in range(n_blocks - len(shared)):
+            nb = self._take_free()
+            self._ref[nb] = 1
+            blocks.append(nb)
+        self._owned[rid] = blocks
+        if cow_pair is not None:
+            self._cow_copy(*cow_pair)
+        table = np.full((self.table_width,), TRASH_BLOCK, np.int32)
+        table[:n_blocks] = blocks
+        return table
+
+    def blocks_of(self, rid) -> List[int]:
+        return list(self._owned.get(rid, ()))
+
+    def free(self, rid, evicted: bool = False) -> int:
+        """Drop `rid`'s references.  A block whose refcount reaches
+        zero returns to the free list — unless it is hash-registered,
+        in which case it parks in the LRU (still matchable, reclaimed
+        only under pressure).  `evicted=True` marks a FORCED reclaim
+        (shed/errored request) and bumps `kv.evictions` for every block
+        actually released (a still-shared block survives its evicted
+        holder); natural completion does not."""
+        blocks = self._owned.pop(rid, None)
+        if not blocks:
+            return 0
+        released = 0
+        for b in reversed(blocks):
+            r = self._ref.get(b, 1) - 1
+            if r > 0:
+                self._ref[b] = r
+                continue
+            self._ref.pop(b, None)
+            released += 1
+            if b in self._block_hash:
+                self._lru[b] = None            # park at the MRU end
+            else:
+                self._free.append(b)
+        if evicted and released:
+            self.evictions += released
+            COUNTERS.add("kv.evictions", calls=released)
+        return len(blocks)
+
+    # -- prefix cache -------------------------------------------------
+
+    def prefix_hashes(self, tokens: Sequence[int]) -> List[bytes]:
+        """Chain hashes of `tokens`' FULL blocks: `h_i = H(h_{i-1},
+        block_i_tokens)`, seeded with the (model, kv storage mode,
+        block size) salt.  The partial tail block is never hashed —
+        only immutable, full blocks are shareable."""
+        if not self.prefix_enabled:
+            return []
+        bs = self.block_size
+        out: List[bytes] = []
+        h = self._salt
+        for i in range(len(tokens) // bs):
+            blk = np.asarray(tokens[i * bs:(i + 1) * bs], np.int64)
+            h = hashlib.blake2b(h + blk.tobytes(),
+                                digest_size=16).digest()
+            out.append(h)
+        return out
+
+    def match_prefix(self, hashes: Sequence[bytes]) -> List[int]:
+        """The longest registered prefix of `hashes` -> block ids.
+        Matches shorter than `min_match_blocks` return empty (below
+        that, aliasing buys less than its book-keeping costs)."""
+        if not self.prefix_enabled:
+            return []
+        blocks: List[int] = []
+        for h in hashes:
+            b = self._hash_index.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+        if len(blocks) < self.min_match_blocks:
+            return []
+        return blocks
+
+    def register_prefix(self, rid, hashes: Sequence[bytes],
+                        start: int = 0) -> int:
+        """Publish `rid`'s blocks `start..len(hashes)-1` under their
+        chain hashes (first registration wins — a concurrent identical
+        prompt keeps the incumbent).  Callers pass `start` past any
+        decode-written region: only prefill-written rows are pinned
+        bitwise against recomputation, so only those blocks are safe
+        to serve to other requests."""
+        if not self.prefix_enabled:
+            return 0
+        blocks = self._owned.get(rid)
+        if not blocks:
+            return 0
+        n = 0
+        for i in range(int(start), min(len(hashes), len(blocks))):
+            h = hashes[i]
+            if h in self._hash_index:
+                continue
+            b = blocks[i]
+            old = self._block_hash.get(b)
+            if old is not None and old != h:
+                continue
+            self._hash_index[h] = b
+            self._block_hash[b] = h
+            n += 1
+        return n
+
+    def _cow_copy(self, src: int, dst: int) -> None:
+        """Device row copy of one block (every layer, K and V, payload
+        and scales) — the copy-on-write servicing a write into a
+        live-shared block."""
+        if self._copy_fn is None:
+            def fn(caches, src_rows, dst_rows):
+                return jax.tree_util.tree_map(
+                    lambda a: a.at[dst_rows].set(a[src_rows]), caches)
+
+            self._copy_fn = jax.jit(fn, donate_argnums=(0,))
+        bs = self.block_size
+        rows = np.arange(bs, dtype=np.int32)
+        self.caches = self._copy_fn(self.caches,
+                                    jnp.asarray(rows + src * bs),
+                                    jnp.asarray(rows + dst * bs))
+        self.cow_copies += 1
+        COUNTERS.add("kv.cow_copies", nbytes=self.bytes_per_block())
+
+    # -- session pins -------------------------------------------------
+
+    def pin(self, owner, rid) -> int:
+        """Take one extra reference on `rid`'s blocks under `owner` (a
+        session key) so they survive the request's `free()` — the
+        resident-session mechanism.  Returns the pinned block count."""
+        if owner in self._owned:
+            raise ValueError(f"owner {owner!r} already holds blocks")
+        blocks = self._owned.get(rid)
+        if not blocks:
+            return 0
+        for b in blocks:
+            self._ref[b] = self._ref.get(b, 0) + 1
+        self._owned[owner] = list(blocks)
+        return len(blocks)
+
+    def alloc_from_pin(self, rid, n_blocks: int,
+                       pin_owner) -> Optional[np.ndarray]:
+        """Transfer a session pin's blocks to request `rid` wholesale
+        (references move, nothing is copied — the pin's partial tail
+        block arrives private and writable) and top up with fresh
+        blocks to `n_blocks`.  Returns the table, or None (pin left
+        intact) when the fresh share cannot be covered."""
+        if rid in self._owned:
+            raise ValueError(f"request {rid} already holds blocks")
+        blocks = self._owned.get(pin_owner)
+        if not blocks:
+            return None
+        n_blocks = max(int(n_blocks), len(blocks))
+        if n_blocks > self.table_width:
+            raise ValueError(
+                f"request {rid} needs {n_blocks} blocks > table width "
+                f"{self.table_width}")
+        fresh = n_blocks - len(blocks)
+        if fresh > self.free_blocks:
+            return None
+        self._owned.pop(pin_owner)
+        blocks = list(blocks)
+        for _ in range(fresh):
+            nb = self._take_free()
+            self._ref[nb] = 1
+            blocks.append(nb)
         self._owned[rid] = blocks
         table = np.full((self.table_width,), TRASH_BLOCK, np.int32)
         table[:n_blocks] = blocks
         return table
 
-    def blocks_of(self, rid: int) -> List[int]:
-        return list(self._owned.get(rid, ()))
-
-    def free(self, rid: int, evicted: bool = False) -> int:
-        """Return `rid`'s blocks to the free list.  `evicted=True`
-        marks a FORCED reclaim (shed/errored request) and bumps
-        `kv.evictions`; natural completion does not."""
-        blocks = self._owned.pop(rid, None)
-        if not blocks:
-            return 0
-        self._free.extend(reversed(blocks))
-        if evicted:
-            self.evictions += len(blocks)
-            COUNTERS.add("kv.evictions", calls=len(blocks))
-        return len(blocks)
+    # -- telemetry ----------------------------------------------------
 
     def sample_occupancy(self) -> None:
         """Per-step occupancy sample (mean = bytes/calls in the
@@ -291,5 +563,6 @@ class PagedKVCache:
                 f"blocks={self.num_blocks} x {self.block_size} tok, "
                 f"table_width={self.table_width}, heads={self.num_heads}, "
                 f"head_dim={self.head_dim}, kv={mode}, "
+                f"prefix_cache={'on' if self.prefix_enabled else 'off'}, "
                 f"sharded={self._sharding is not None}, "
                 f"{self.nbytes() / (1 << 20):.2f} MiB)")

@@ -1,0 +1,194 @@
+"""Fleet router: admission over N in-process ServeEngine replicas.
+
+One excellent serving node (PR 12-18) is not a fleet.  The router is
+the smallest thing that makes N of them act like one endpoint:
+
+* **Least-loaded dispatch** — a request lands on the replica holding
+  the fewest live KV blocks (`kv.blocks_in_use`, queue depth as the
+  tiebreak).  Block occupancy is the honest load signal for a paged
+  engine: it is what actually gates admission, so balancing it
+  balances time-to-first-token.
+* **Session affinity** — a pinned session's KV blocks are resident on
+  exactly one replica, so a request carrying that `session_id` MUST
+  land there (and does, even over the queue limit — re-prefilling the
+  whole history elsewhere costs more than queueing).  The router
+  learns the mapping at first dispatch and drops it when the request
+  chain errors.
+* **Queue spill-over** — when the least-loaded pick's waiting queue is
+  at `queue_limit`, the request spills to the next-least-loaded
+  replica with room (`router.spills`).
+* **Shed-on-saturation** — when EVERY replica's queue is full the
+  request is refused immediately in state "error" (`router.shed`)
+  instead of deepening every queue: the serving analogue of the
+  engine-level watchdog shed, load-shedding at the front door.
+
+Replicas are in-process engines sharing ONE compiled program pair
+(`build_fleet` builds the first engine, the rest reuse its programs —
+`ServeSchedule.program_key` zeroes the pool size precisely so engines
+with different pool sizes can share), each with its OWN PagedKVCache
+and therefore its own prefix cache.  Cross-replica prefix reuse —
+live KV block migration — is explicitly out of scope (next PR); the
+router's session affinity is what keeps the per-replica caches hot.
+
+Counters (`router.*`, excluded from the comm byte table like the
+other serving families): `router.dispatches` — requests dispatched
+(bytes += the chosen replica's `kv.blocks_in_use` at dispatch, so
+bytes/calls is the mean load a dispatch landed on);
+`router.spills` — dispatches deflected from a full queue;
+`router.shed` — requests refused with every queue full.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..monitor.counters import COUNTERS
+from ..utils.logging import logger
+from .engine import ServeConfig, ServeEngine, ServeWorker
+from .scheduler import ERROR, Request
+
+
+def build_fleet(model, params, config: Optional[ServeConfig] = None,
+                replicas: int = 2, mesh_info=None, programs=None,
+                clock=time.monotonic) -> List[ServeEngine]:
+    """N ServeEngine replicas sharing one compiled program pair: the
+    first engine compiles (or adopts `programs`, e.g. a bench's warmed
+    pair), the rest reuse (same schedule -> same program_key, the
+    prebuilt-programs path ServeEngine already validates).  Each
+    replica owns its KV pool and prefix cache."""
+    if int(replicas) < 1:
+        raise ValueError(f"fleet replicas must be >= 1, got {replicas}")
+    first = ServeEngine(model, params, config, mesh_info=mesh_info,
+                        programs=programs, clock=clock)
+    engines = [first]
+    for _ in range(int(replicas) - 1):
+        engines.append(ServeEngine(model, params, config,
+                                   mesh_info=mesh_info,
+                                   programs=first.programs, clock=clock))
+    return engines
+
+
+class FleetRouter:
+    """Front door over a list of ServeEngine replicas.  `submit()` is
+    the whole API a frontend needs; `start()`/`close()` run one
+    ServeWorker per replica so the engines decode concurrently (XLA
+    releases the GIL during execution, so replicas overlap even in one
+    process), and `run()` drives them synchronously for tests."""
+
+    def __init__(self, engines: Sequence[ServeEngine],
+                 queue_limit: int = 64, session_affinity: bool = True):
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine")
+        if int(queue_limit) < 1:
+            raise ValueError(
+                f"fleet queue_limit must be >= 1, got {queue_limit}")
+        self.engines: List[ServeEngine] = list(engines)
+        self.queue_limit = int(queue_limit)
+        self.session_affinity = bool(session_affinity)
+        self._session_replica: Dict[Any, int] = {}
+        self._workers: List[ServeWorker] = []
+        self.dispatched = 0
+        self.spilled = 0
+        self.shed = 0
+
+    # -- dispatch ------------------------------------------------------
+
+    def _load(self, i: int):
+        eng = self.engines[i]
+        return (eng.kv.blocks_in_use, eng.scheduler.n_waiting, i)
+
+    def _queue_depth(self, i: int) -> int:
+        return self.engines[i].scheduler.n_waiting
+
+    def _choose(self, session_id) -> Optional[int]:
+        """The replica this request lands on, or None (saturated)."""
+        if (self.session_affinity and session_id is not None
+                and session_id in self._session_replica):
+            # hard affinity: the pin's blocks live there; even a full
+            # queue beats re-prefilling the whole history cold
+            return self._session_replica[session_id]
+        order = sorted(range(len(self.engines)), key=self._load)
+        first_choice = order[0]
+        for i in order:
+            if self._queue_depth(i) < self.queue_limit:
+                if i != first_choice:
+                    self.spilled += 1
+                    COUNTERS.add("router.spills")
+                return i
+        return None
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               eos_token: Optional[int] = None,
+               session_id: Optional[Any] = None) -> Request:
+        """Route one request.  Returns the live Request from the chosen
+        replica — or, with every queue at the limit, a Request already
+        in state "error" that was never enqueued anywhere."""
+        i = self._choose(session_id)
+        if i is None:
+            self.shed += 1
+            COUNTERS.add("router.shed")
+            req = Request(prompt=[int(t) for t in prompt],
+                          max_new_tokens=int(max_new_tokens),
+                          session_id=session_id)
+            req.state = ERROR
+            req.error = (f"fleet saturated: every replica queue >= "
+                         f"{self.queue_limit}")
+            logger.warning(f"fleet router: shed a request ({req.error})")
+            return req
+        eng = self.engines[i]
+        COUNTERS.add("router.dispatches", nbytes=eng.kv.blocks_in_use)
+        self.dispatched += 1
+        if self.session_affinity and session_id is not None:
+            self._session_replica[session_id] = i
+        req = eng.submit(prompt, max_new_tokens, temperature=temperature,
+                         top_k=top_k, seed=seed, eos_token=eos_token,
+                         session_id=session_id)
+        req.replica = i
+        return req
+
+    # -- driving -------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return any(e.has_work() for e in self.engines)
+
+    def step_all(self) -> bool:
+        did = False
+        for e in self.engines:
+            if e.has_work():
+                did = e.step() or did
+        return did
+
+    def run(self) -> None:
+        """Synchronous drive: step every replica until the fleet is
+        idle (tests and the dry lanes; the bench uses workers)."""
+        while self.has_work():
+            self.step_all()
+
+    def start(self) -> None:
+        """One ServeWorker daemon per replica — concurrent decoding."""
+        if self._workers:
+            return
+        for e in self.engines:
+            w = ServeWorker(e)
+            w.start()
+            self._workers.append(w)
+
+    def close(self) -> None:
+        for w in self._workers:
+            w.stop()
+        self._workers = []
+        for e in self.engines:
+            e.close()
+
+    # -- telemetry -----------------------------------------------------
+
+    @property
+    def resident_sessions(self) -> int:
+        return sum(e.resident_sessions for e in self.engines)
+
+    def describe(self) -> str:
+        return (f"FleetRouter({len(self.engines)} replicas, "
+                f"queue_limit={self.queue_limit}, session_affinity="
+                f"{'on' if self.session_affinity else 'off'})")

@@ -4,14 +4,19 @@ KV cache.
 The scheduling loop the engine drives once per `step()`:
 
 1. **admit** — move waiting requests into free decode slots whenever
-   the free list can cover their whole KV budget:
-   ceil((prompt + max_new + draft_len) / block_size) blocks, clamped to
-   the table width.  The `draft_len` tail matters under speculative
-   decoding: a verify step writes up to `draft_len` candidate K/V rows
-   PAST the committed length, and without the reservation those rows
-   would spill into the trash-padded tail of the block table — an
-   accepted draft's K/V silently living in the trash block, corrupting
-   every later attention read (the off-by-draft starvation
+   the pool can cover their UNSHARED KV budget.  The whole-life budget
+   is ceil((prompt + max_new + draft_len) / block_size) blocks, clamped
+   to the table width, but the prefix cache discounts it: blocks whose
+   chain hash is already registered are aliased (one refcount, zero
+   fresh blocks) and a request arriving with a live session pin adopts
+   the pin's blocks outright — admission charges only what is actually
+   new.  Prefill then starts at the first non-cached position.  The
+   `draft_len` tail matters under speculative decoding: a verify step
+   writes up to `draft_len` candidate K/V rows PAST the committed
+   length, and without the reservation those rows would spill into the
+   trash-padded tail of the block table — an accepted draft's K/V
+   silently living in the trash block, corrupting every later
+   attention read (the off-by-draft starvation
    tests/test_spec_decode.py pins).  Admission policy:
 
    * `"continuous"` (the subsystem's reason to exist): a request joins
@@ -25,12 +30,15 @@ The scheduling loop the engine drives once per `step()`:
 2. **prefill** — admitted requests stream their prompt through the
    chunked prefill program, at most `max_prefill_chunks_per_step`
    chunks per engine step, so a long prompt never stalls the decode
-   batch for more than one chunk's worth of compute.
+   batch for more than one chunk's worth of compute.  A prefix-cached
+   request's stream starts at its first non-cached token.
 
 3. **decode** — every RUNNING slot advances one token.
 
 Requests own their block table for their whole life; finishing
-(naturally or shed) frees the blocks immediately.
+(naturally or shed) drops their references immediately — a block a
+finished request shared with a live holder survives, its private
+blocks return to the pool (registered ones park in the prefix LRU).
 """
 
 from __future__ import annotations
@@ -39,8 +47,9 @@ import itertools
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Any, List, Optional
 
+from ..monitor.counters import COUNTERS
 from .kv_cache import PagedKVCache
 
 WAITING = "waiting"
@@ -62,6 +71,7 @@ class Request:
     top_k: int = 0
     seed: int = 0
     eos_token: Optional[int] = None
+    session_id: Optional[Any] = None  # pin blocks for a follow-up turn
     rid: int = -1
     state: str = WAITING
     out: List[int] = field(default_factory=list)
@@ -71,6 +81,8 @@ class Request:
     table = None                      # np.int32 [table_width]
     prefill_pos: int = 0              # tokens already prefilled
     cached_len: int = 0               # cache positions written (real)
+    prefix_cached_tokens: int = 0     # prompt tokens skipped at admit
+    block_hashes: List[bytes] = field(default_factory=list)
     # timestamps (engine clock)
     t_submit: float = 0.0
     t_first_token: Optional[float] = None
@@ -118,6 +130,11 @@ class Scheduler:
         # ServeEngine.attach_tracing; admit() emits one `queue_wait`
         # complete event per sampled admitted request.
         self.tracer = None
+        # session hooks (set by ServeEngine when sessions are enabled):
+        # session_lookup(req) -> pin info or None; session_consumed(req,
+        # pin) runs after the pin's blocks transferred to the request.
+        self.session_lookup = None
+        self.session_consumed = None
 
     # -- submission (any thread) --------------------------------------
 
@@ -150,12 +167,56 @@ class Scheduler:
         by real blocks (never the trash-padded table tail) or an
         accepted draft's K/V would be silently lost.  Clamped to the
         table width — the engine clamps per-step draft proposals to
-        the allocated rows, so the cap is never overrun."""
+        the allocated rows, so the cap is never overrun.  This is the
+        TABLE budget; the prefix cache discounts what admission
+        actually charges against the pool."""
         tokens = min(len(req.prompt) + req.max_new_tokens + self.draft_len,
                      self.kv.table_width * self.kv.block_size)
         return self.kv.blocks_needed(tokens)
 
     # -- engine-thread scheduling -------------------------------------
+
+    def _try_alloc(self, req: Request):
+        """One admission attempt: session-pin adoption first, then the
+        hash-chain prefix match, then a plain allocation.  Returns the
+        block table or None; on success the request's cached offsets
+        and registration hashes are set."""
+        needed = self.blocks_reserved(req)
+        pin = None
+        if req.session_id is not None and self.session_lookup is not None:
+            pin = self.session_lookup(req)
+        if pin is not None:
+            table = self.kv.alloc_from_pin(req.rid, needed, pin.owner)
+            if table is None:
+                return None
+            req.block_hashes = self.kv.prefix_hashes(req.prompt)
+            req.cached_len = req.prefill_pos = pin.cached_len
+            req.prefix_cached_tokens = pin.cached_len
+            if pin.cached_len:
+                COUNTERS.add("kv.prefix_hit_tokens",
+                             nbytes=pin.cached_len)
+            if self.session_consumed is not None:
+                self.session_consumed(req, pin)
+            return table
+        hashes = self.kv.prefix_hashes(req.prompt)
+        matched = self.kv.match_prefix(hashes)
+        m = len(matched)
+        # a fully-cached, block-aligned prompt still recomputes its
+        # final token (prefill samples the first output there) — that
+        # write lands in the last shared block, the one COW case
+        privatize = bool(m) and m * self.kv.block_size >= len(req.prompt)
+        table = self.kv.alloc(req.rid, needed, shared=matched,
+                              privatize_last=privatize)
+        if table is None:
+            return None
+        req.block_hashes = hashes
+        if m:
+            skipped = min(m * self.kv.block_size, len(req.prompt) - 1)
+            req.cached_len = req.prefill_pos = skipped
+            req.prefix_cached_tokens = skipped
+            COUNTERS.add("kv.prefix_hits", nbytes=m)
+            COUNTERS.add("kv.prefix_hit_tokens", nbytes=skipped)
+        return table
 
     def admit(self) -> List[Request]:
         """Admission pass; returns the newly admitted requests."""
@@ -170,8 +231,7 @@ class Scheduler:
                 if not free_slots:
                     break
                 req = self._waiting[0]
-                needed = self.blocks_reserved(req)
-                table = self.kv.alloc(req.rid, needed)
+                table = self._try_alloc(req)
                 if table is None:
                     break  # FIFO: never starve the head of the queue
                 self._waiting.pop(0)
@@ -193,7 +253,8 @@ class Scheduler:
                 tr.add_complete("queue_wait", "serve",
                                 ts_us=tr.now_us() - dur_us,
                                 dur_us=dur_us, rid=req.rid,
-                                prompt=len(req.prompt))
+                                prompt=len(req.prompt),
+                                cached=req.prefix_cached_tokens)
         return admitted
 
     def prefilling(self) -> List[Request]:
@@ -209,9 +270,9 @@ class Scheduler:
 
     def finish(self, req: Request, state: str = FINISHED,
                error: Optional[str] = None) -> None:
-        """Terminal transition: free the slot and the KV blocks NOW —
-        immediate reclaim is what lets the next waiting request join
-        at the very next step."""
+        """Terminal transition: free the slot and drop the KV
+        references NOW — immediate reclaim is what lets the next
+        waiting request join at the very next step."""
         req.state = state
         req.error = error
         req.t_finish = self.clock()

@@ -346,13 +346,17 @@ def test_serving_config_block_validation():
     dflt = DeepSpeedServingConfig({})
     assert dflt.kv_dtype is None and not dflt.spec_enabled
     assert dflt.to_serve_kwargs() == {
-        "kv_dtype": None, "draft_len": 0, "spec_ngram": 3}
+        "kv_dtype": None, "draft_len": 0, "spec_ngram": 3,
+        "prefix_cache": True, "prefix_min_match_blocks": 1,
+        "session_ttl_s": 120.0}
 
     on = DeepSpeedServingConfig({"serving": {
         "kv_dtype": "INT8",
         "speculative": {"enabled": True, "draft_len": 2, "ngram": 4}}})
     assert on.to_serve_kwargs() == {
-        "kv_dtype": "int8", "draft_len": 2, "spec_ngram": 4}
+        "kv_dtype": "int8", "draft_len": 2, "spec_ngram": 4,
+        "prefix_cache": True, "prefix_min_match_blocks": 1,
+        "session_ttl_s": 120.0}
     # disabled speculation maps to draft_len=0, not a missing key
     off = DeepSpeedServingConfig({"serving": {
         "speculative": {"draft_len": 2}}})
@@ -386,13 +390,15 @@ def test_generate_serve_candidates_space():
     from deepspeed_tpu.runtime.autotune import generate_serve_candidates
 
     cands, rejected = generate_serve_candidates(head_dim=8)
-    assert len(cands) == 12 and rejected == 0    # 4 kv x 3 draft
+    # 4 kv x 3 draft x 2 prefix modes (on with defaults / off)
+    assert len(cands) == 24 and rejected == 0
     assert all(c.scope == "serve" for c in cands)
     names = {c.name for c in cands}
     assert "serve_int8_d4" in names and "serve_dense_d0" in names
+    assert "serve_dense_d0_nopfx" in names
     # int4 packs two codes per byte: odd head_dim prunes the column
     cands7, rejected7 = generate_serve_candidates(head_dim=7)
-    assert len(cands7) == 9 and rejected7 == 3
+    assert len(cands7) == 18 and rejected7 == 6
     assert not any("int4" in c.name for c in cands7)
 
 
@@ -403,12 +409,16 @@ def test_current_serve_candidate_and_knob_distance(model_and_params):
     eng = _engine(model_and_params, kv_dtype="int8", draft_len=4)
     cur = current_serve_candidate(eng)
     assert cur.name == "serve_int8_d4"
-    assert cur.knobs() == {"kv_dtype": "int8", "draft_len": 4}
+    assert cur.knobs() == {
+        "kv_dtype": "int8", "draft_len": 4, "prefix_cache": True,
+        "min_match_blocks": 1, "session_ttl_s": 120.0}
     dense = _engine(model_and_params, draft_len=0)
     base = current_serve_candidate(dense)
-    assert base.knobs() == {"kv_dtype": "dense", "draft_len": 0}
+    assert base.knobs() == {
+        "kv_dtype": "dense", "draft_len": 0, "prefix_cache": True,
+        "min_match_blocks": 1, "session_ttl_s": 120.0}
     assert knob_distance(cur, cur) == 0
-    assert knob_distance(cur, base) == 2          # both knobs differ
+    assert knob_distance(cur, base) == 2          # kv + draft differ
 
 
 def test_serve_fingerprint_keys_on_kv_dtype(model_and_params):

@@ -91,6 +91,21 @@ def selftest() -> int:
             COUNTERS.add("serve.draft_tokens", calls=8)
             COUNTERS.add("serve.accepted_tokens", calls=6)
             COUNTERS.add("kv.dequant_ms", 90_000, calls=3)
+            # block-level prefix caching + pinned sessions: hit
+            # admissions (bytes = blocks aliased), prompt tokens whose
+            # prefill was skipped, COW privatizations (bytes = device
+            # bytes copied), session pins (bytes = blocks held), LRU
+            # reclaims — the Serving section's "Prefix cache" rows;
+            # router.* (fleet dispatch/spill/shed) is the "Fleet
+            # router" section.  All excluded from the comm byte table.
+            COUNTERS.add("kv.prefix_hits", 4, calls=2)
+            COUNTERS.add("kv.prefix_hit_tokens", 16, calls=2)
+            COUNTERS.add("kv.cow_copies", 4608, calls=1)
+            COUNTERS.add("kv.session_pins", 6, calls=2)
+            COUNTERS.add("kv.prefix_evictions", calls=1)
+            COUNTERS.add("router.dispatches", 5, calls=2)
+            COUNTERS.add("router.spills", calls=1)
+            COUNTERS.add("router.shed", calls=1)
             # MoE wire (moe/dispatch.py): a2a hop bytes + the
             # slow-fabric subset, exposed µs (ckpt.stall_ms
             # convention), capacity drops and ppm-in-bytes bucket
@@ -245,6 +260,19 @@ def selftest() -> int:
                        "draft tokens accepted | 18 (+2.00 bonus "
                        "tokens/step)",
                        "quantized-KV decode dispatch",
+                       "**Prefix cache**",
+                       "prefix-hit admissions | 6 (12 blocks aliased)",
+                       "prompt tokens skipped | 48 (50% of prefill "
+                       "tokens)",
+                       "copy-on-write privatizations | 3 "
+                       "(13.50 KiB copied)",
+                       "session pins | 6 (18 blocks held)",
+                       "cached blocks reclaimed (LRU) | 3",
+                       "## Fleet router",
+                       "requests dispatched | 6 (mean load at dispatch "
+                       "2.50 KV blocks)",
+                       "queue spill-overs | 3",
+                       "requests shed at front door | 3",
                        "Serving bench (continuous batching)",
                        "Speculative decoding lanes",
                        "spec_int8_d4: +1.80 tok/step (kv int8, draft 4)",
@@ -296,6 +324,15 @@ def selftest() -> int:
             "`serve.accepted_tokens`" not in md and \
             "`kv.dequant_ms`" not in md, \
             "serve.*/kv.* rows must not leak into the comm table"
+        assert "`kv.prefix_hits`" not in md and \
+            "`kv.prefix_hit_tokens`" not in md and \
+            "`kv.cow_copies`" not in md and \
+            "`kv.session_pins`" not in md and \
+            "`kv.prefix_evictions`" not in md and \
+            "`router.dispatches`" not in md and \
+            "`router.spills`" not in md and \
+            "`router.shed`" not in md, \
+            "kv.*/router.* rows must not leak into the comm table"
         assert "`moe.a2a_bytes`" not in md and \
             "`moe.capacity_frac`" not in md, \
             "moe.* rows must not leak into the comm table"

@@ -33,8 +33,22 @@ Campaigns:
   asserted token-identical across draft_len at matched kv_dtype), plus
   the equal-pool-bytes resident-session pair (bf16 vs int8 KV at the
   same byte budget, peak concurrently resident sessions compared).
+* `--fleet` — the prefix-cache + fleet-router campaign: ONE
+  shared-prefix greedy Poisson timeline through a prefix-cache-OFF
+  single engine (the cold baseline AND the bitwise oracle) and a
+  FleetRouter lane per replica count (tok/s, p50/p99 TTFT, cache-hit
+  rate vs replica count), plus warm-pinned-session vs cold-turn
+  multi-turn lanes (turn>=2 TTFT, prefill tokens actually computed).
+  Every cache-on lane asserts bitwise identity to the cache-off
+  oracle; the recorded campaign additionally asserts hit rate > 50%
+  and warm < cold TTFT p50 so a sub-claim artifact cannot commit.
 * `--dry-run` — a seconds-scale miniature of the same two lanes, wired
   into tier-1 via tests/test_serving.py so the bench cannot rot.
+* `--dry-run --fleet` / `run_dry_fleet()` (tests/test_serving.py) —
+  the tier-1 fleet miniature: cache-off oracle + 1/2-replica fleets +
+  session lanes, pinning the deterministic claims (bitwise identity,
+  nonzero hit rate, session pins engaging, warm lane computing fewer
+  prefill tokens).
 * `--dry-run --spec` / `run_dry_spec()` (tests/test_spec_decode.py) —
   the tier-1 spec miniature: the (kv_dtype x draft_len) sweep with
   BITWISE oracles — dense/bf16 lanes pinned token-identical to
@@ -205,6 +219,9 @@ def run_lane(model, params, serve_cfg, timeline, programs=None,
                       "capacity": eng.kv.capacity_blocks},
         "decode_steps": delta.get("serve.decode_steps", {}).get("calls", 0),
         "shed": delta.get("serve.shed", {}).get("calls", 0),
+        "prefix_hit_rate": _hit_rate(delta),
+        "prefix_hit_tokens": delta.get("kv.prefix_hit_tokens",
+                                       {}).get("bytes", 0),
         "kv_dtype": eng.kv.quant_wire or
         (str(serve_cfg.kv_dtype) if serve_cfg.kv_dtype is not None
          else "dense"),
@@ -560,6 +577,358 @@ def run_resident_lanes(model, params, seed=0, dry=False):
     return lanes, resident
 
 
+def build_prefix_timeline(n_requests: int, rate_hz: float, seed: int,
+                          vocab: int, n_prefixes=4, prefix_len=16,
+                          tail_range=(2, 6), new_range=(8, 16)):
+    """Seeded Poisson timeline of SHARED-PREFIX greedy prompts: each
+    request draws one of `n_prefixes` fixed prefixes and appends a
+    short random tail — the workload block-level prefix caching exists
+    for (a few system prompts fanned out across user turns).  Greedy
+    (temperature 0) so the cache-on lanes have a bitwise cache-off
+    oracle."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    prefixes = [rng.randint(0, vocab, (prefix_len,)).tolist()
+                for _ in range(n_prefixes)]
+    t = 0.0
+    timeline = []
+    for i in range(n_requests):
+        t += float(rng.exponential(1.0 / rate_hz))
+        pre = prefixes[int(rng.randint(0, n_prefixes))]
+        tail = rng.randint(
+            0, vocab, (int(rng.randint(*tail_range)),)).tolist()
+        max_new = int(rng.randint(*new_range))
+        timeline.append((t, pre + tail, max_new, 0.0, 0, 1000 + i))
+    return timeline
+
+
+def _hit_rate(delta):
+    """Fraction of prefill tokens served from the prefix cache:
+    skipped / (skipped + computed), from the counter delta
+    (kv.prefix_hit_tokens bytes vs serve.prefill_chunks bytes)."""
+    hit = delta.get("kv.prefix_hit_tokens", {}).get("bytes", 0)
+    computed = delta.get("serve.prefill_chunks", {}).get("bytes", 0)
+    total = hit + computed
+    return round(hit / total, 4) if total else 0.0
+
+
+def run_fleet_lane(model, params, serve_cfg, timeline, replicas,
+                   programs=None, queue_limit=64):
+    """Replay `timeline` through a FleetRouter over `replicas`
+    in-process engines (one ServeWorker each); returns the lane
+    metrics dict, engines closed."""
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.serving import FleetRouter, build_fleet
+
+    engines = build_fleet(model, params, serve_cfg, replicas=replicas,
+                          programs=programs)
+    router = FleetRouter(engines, queue_limit=queue_limit)
+    snap = COUNTERS.snapshot()
+    router.start()
+    t0 = time.monotonic()
+    reqs = []
+    try:
+        for t_arr, prompt, max_new, temp, top_k, seed in timeline:
+            delay = t0 + t_arr - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            reqs.append(router.submit(prompt, max_new, temperature=temp,
+                                      top_k=top_k, seed=seed))
+        while router.has_work():
+            time.sleep(0.005)
+    finally:
+        router.close()
+    delta = COUNTERS.delta_since(snap)
+
+    done = [r for r in reqs if r.state == "finished"]
+    errored = [r for r in reqs if r.state == "error"]
+    ttfts = [r.ttft_s * 1000.0 for r in done if r.ttft_s is not None]
+    itls = []
+    for r in done:
+        itls.extend((b - a) * 1000.0
+                    for a, b in zip(r.token_times, r.token_times[1:]))
+    n_tokens = sum(len(r.out) for r in done)
+    makespan = max((r.t_finish for r in done if r.t_finish is not None),
+                   default=t0) - t0
+    per_replica = [0] * replicas
+    for r in reqs:
+        i = getattr(r, "replica", None)
+        if i is not None:
+            per_replica[i] += 1
+    return {
+        "replicas": replicas,
+        "requests": len(reqs),
+        "completed": len(done),
+        "errored": len(errored),
+        "tokens": n_tokens,
+        "makespan_s": round(makespan, 3),
+        "tokens_per_sec": round(n_tokens / makespan, 2) if makespan
+        else None,
+        "ttft_ms": {
+            "p50": round(_percentile(ttfts, 50), 2) if ttfts else None,
+            "p99": round(_percentile(ttfts, 99), 2) if ttfts else None,
+            "mean": round(sum(ttfts) / len(ttfts), 2) if ttfts
+            else None},
+        "itl_ms": {
+            "p50": round(_percentile(itls, 50), 2) if itls else None,
+            "p99": round(_percentile(itls, 99), 2) if itls else None},
+        "prefix_hit_rate": _hit_rate(delta),
+        "prefix_hits": delta.get("kv.prefix_hits", {}).get("calls", 0),
+        "prefix_hit_tokens": delta.get("kv.prefix_hit_tokens",
+                                       {}).get("bytes", 0),
+        "cow_copies": delta.get("kv.cow_copies", {}).get("calls", 0),
+        "dispatch_per_replica": per_replica,
+        "spills": router.spilled,
+        "shed_router": router.shed,
+        "counters": delta,
+        "outputs": [list(r.out) for r in reqs],
+    }
+
+
+def run_session_lanes(model, params, seed=0, dry=False, programs=None):
+    """Warm pinned-session turns vs cold re-prefilled turns: the SAME
+    multi-turn conversations (greedy, so histories match bitwise)
+    driven through an engine with sessions on vs a prefix-cache-off
+    engine.  The warm lane's turn k+1 re-prefills only its new user
+    tokens (session pin adoption); the cold lane recomputes the whole
+    history every turn.  Compared on turn>=2 TTFT and on prefill
+    tokens actually computed (the deterministic, timing-free
+    separation the dry lane pins)."""
+    import numpy as np
+
+    from deepspeed_tpu.monitor.counters import COUNTERS
+    from deepspeed_tpu.serving import ServeConfig, ServeEngine
+
+    vocab = model.config.vocab_size
+    if dry:
+        n_conv, n_turns, turn_new = 3, 3, 5
+        user_len = (3, 6)
+        mk = lambda pfx: ServeConfig(
+            block_size=4, num_blocks=64, max_batch=n_conv,
+            prefill_chunk=8, max_seq_len=model.config.max_seq_len,
+            prefix_cache=pfx)
+    else:
+        n_conv, n_turns, turn_new = 8, 4, 16
+        user_len = (8, 17)
+        mk = lambda pfx: ServeConfig(
+            block_size=8, num_blocks=256, max_batch=n_conv,
+            prefill_chunk=16, max_seq_len=model.config.max_seq_len,
+            prefix_cache=pfx)
+    rng = np.random.RandomState(seed + 2)
+    user_turns = [
+        [rng.randint(0, vocab,
+                     (int(rng.randint(*user_len)),)).tolist()
+         for _ in range(n_turns)]
+        for _ in range(n_conv)]
+
+    lanes = {}
+    # the dry session schedule matches the dry fleet schedule by
+    # construction, so the campaign's warmed pair is reusable; the
+    # real lanes size their own batch/blocks, so the first lane
+    # compiles and the second adopts (prefix on/off shares programs —
+    # the cache is host-side allocator state)
+    lane_programs = programs if dry else None
+    for name, pfx in (("session_warm", True), ("session_cold", False)):
+        eng = ServeEngine(model, params, mk(pfx), programs=lane_programs)
+        lane_programs = eng.programs
+        snap = COUNTERS.snapshot()
+        hist = [[] for _ in range(n_conv)]
+        later_ttfts = []  # turn >= 2 only: the warm-vs-cold separation
+        outputs = []
+        print(f"--- session lane: {name} ({n_conv} conversations x "
+              f"{n_turns} turns) ---")
+        try:
+            for t in range(n_turns):
+                reqs = []
+                for c in range(n_conv):
+                    prompt = hist[c] + user_turns[c][t]
+                    reqs.append(eng.submit(
+                        prompt, turn_new,
+                        session_id=(c if pfx else None)))
+                eng.run()
+                for c, r in enumerate(reqs):
+                    assert r.state == "finished", \
+                        (name, t, c, r.state, r.error)
+                    hist[c] = list(r.prompt) + list(r.out)
+                    outputs.append(list(r.out))
+                    if t >= 1 and r.ttft_s is not None:
+                        later_ttfts.append(r.ttft_s * 1000.0)
+        finally:
+            eng.close()
+        delta = COUNTERS.delta_since(snap)
+        lanes[name] = {
+            "conversations": n_conv,
+            "turns": n_turns,
+            "turn2plus_ttft_ms": {
+                "p50": round(_percentile(later_ttfts, 50), 2),
+                "p99": round(_percentile(later_ttfts, 99), 2)},
+            "prefill_tokens_computed":
+                delta.get("serve.prefill_chunks", {}).get("bytes", 0),
+            "prefix_hit_tokens":
+                delta.get("kv.prefix_hit_tokens", {}).get("bytes", 0),
+            "session_pins":
+                delta.get("kv.session_pins", {}).get("calls", 0),
+            "prefix_hit_rate": _hit_rate(delta),
+            "counters": delta,
+            "outputs": outputs,
+        }
+        print(f"    turn>=2 TTFT p50 "
+              f"{lanes[name]['turn2plus_ttft_ms']['p50']} ms; "
+              f"{lanes[name]['prefill_tokens_computed']} prefill tok "
+              f"computed, {lanes[name]['prefix_hit_tokens']} skipped")
+    # greedy + bitwise prefix cache -> identical conversations; every
+    # downstream turn's prompt (history) is only comparable because of
+    # this, so assert it before comparing any latency
+    assert lanes["session_warm"]["outputs"] == \
+        lanes["session_cold"]["outputs"], \
+        "pinned sessions changed greedy tokens"
+    warm, cold = lanes["session_warm"], lanes["session_cold"]
+    comparison = {
+        "warm_ttft_p50_ms": warm["turn2plus_ttft_ms"]["p50"],
+        "cold_ttft_p50_ms": cold["turn2plus_ttft_ms"]["p50"],
+        "warm_prefill_tokens": warm["prefill_tokens_computed"],
+        "cold_prefill_tokens": cold["prefill_tokens_computed"],
+        "session_pins": warm["session_pins"],
+    }
+    return lanes, comparison
+
+
+def run_fleet_campaign(n_requests=64, rate_hz=32.0, seed=0, record=True,
+                       dry=False, replica_counts=(1, 2, 4)):
+    """The prefix-cache + fleet campaign: ONE shared-prefix greedy
+    Poisson timeline replayed against (a) a prefix-cache-OFF single
+    engine — simultaneously the cold baseline row and the bitwise
+    oracle — and (b) a FleetRouter lane per replica count with
+    per-replica prefix caches; plus the warm-session vs cold-turn
+    lanes.  Headline claims (BENCH.md): the cache serves > 50% of
+    prefill tokens on this workload, warm session turns beat cold
+    turns on turn>=2 TTFT p50, and tokens/s scales with replica
+    count.  Every cache-on lane is asserted BITWISE identical to the
+    cache-off oracle — the speed claim can never drift from the
+    exactness contract."""
+    import jax
+
+    from deepspeed_tpu.serving import ServeConfig, ServeEngine
+
+    if dry:
+        n_requests = min(n_requests, 10)
+        replica_counts = (1, 2)
+        model, params = _nano_model(vocab=64, max_seq=64, d_model=32)
+        vocab = 64
+        mk = lambda pfx: ServeConfig(
+            block_size=4, num_blocks=64, max_batch=3, prefill_chunk=8,
+            max_seq_len=64, prefix_cache=pfx)
+        timeline = build_prefix_timeline(
+            n_requests, max(rate_hz, 48.0), seed, vocab, n_prefixes=2,
+            prefix_len=12, tail_range=(2, 5), new_range=(4, 8))
+    else:
+        model, params = _nano_model(vocab=512, max_seq=256, layers=4,
+                                    d_model=128, heads=8)
+        vocab = 512
+        mk = lambda pfx: ServeConfig(
+            block_size=8, num_blocks=160, max_batch=4, prefill_chunk=16,
+            max_seq_len=256, prefix_cache=pfx)
+        timeline = build_prefix_timeline(
+            n_requests, rate_hz, seed, vocab, n_prefixes=4,
+            prefix_len=64, tail_range=(4, 16), new_range=(8, 32))
+
+    warm = ServeEngine(model, params, mk(True))
+    warm.generate([timeline[0][1]], 2)
+    programs = warm.programs
+    del warm
+
+    print(f"--- fleet lane: cache off, 1 replica "
+          f"({len(timeline)} requests, the bitwise oracle) ---")
+    off, _eng = run_lane(model, params, mk(False), timeline,
+                         programs=programs)
+    _print_lane("cache_off_r1", off)
+    lanes = {"cache_off_r1": off}
+    scaling = {}
+    for r in replica_counts:
+        print(f"--- fleet lane: cache on, {r} replica(s) "
+              f"({len(timeline)} requests, Poisson) ---")
+        m = run_fleet_lane(model, params, mk(True), timeline, r,
+                           programs=programs)
+        # the exactness contract, asserted on the bench's own numbers:
+        # greedy tokens with the cache on == cache off, bitwise
+        assert m["outputs"] == off["outputs"], \
+            f"prefix cache changed greedy tokens (replicas={r})"
+        lanes[f"fleet_r{r}"] = m
+        scaling[r] = m["tokens_per_sec"]
+        _print_lane(f"fleet_r{r}", m)
+        print(f"    prefix hit rate {m['prefix_hit_rate']:.1%} "
+              f"({m['prefix_hit_tokens']} tok skipped, "
+              f"{m['cow_copies']} COW); dispatches/replica "
+              f"{m['dispatch_per_replica']}, spills {m['spills']}, "
+              f"shed {m['shed_router']}")
+
+    ses_lanes, session = run_session_lanes(model, params, seed=seed,
+                                           dry=dry, programs=programs)
+    lanes.update(ses_lanes)
+    top = lanes[f"fleet_r{max(replica_counts)}"]
+    if not dry:
+        # committed-artifact floors (the ISSUE's acceptance numbers) —
+        # asserted here so an artifact below them cannot be recorded
+        assert top["prefix_hit_rate"] > 0.5, top["prefix_hit_rate"]
+        assert session["warm_ttft_p50_ms"] < session["cold_ttft_p50_ms"], \
+            session
+
+    outputs = {name: m.pop("outputs") for name, m in lanes.items()}
+    result = {
+        "metric": "serve_fleet_bench",
+        "platform": jax.default_backend(),
+        "dry_run": dry,
+        "n_requests": len(timeline),
+        "rate_hz": rate_hz,
+        "seed": seed,
+        "model": {"layers": model.config.num_layers,
+                  "d_model": model.config.d_model,
+                  "heads": model.config.num_heads,
+                  "vocab": model.config.vocab_size},
+        "lanes": lanes,
+        "replica_scaling_tokens_per_sec": scaling,
+        "session": session,
+        "prefix_hit_rate": top["prefix_hit_rate"],
+        "value": top["prefix_hit_rate"],
+        "unit": "prefix cache hit rate (fraction of prefill tokens)",
+    }
+    if record:
+        result["artifact"], result["run_dir"] = record_serving(result)
+        print(f"artifact: {result['artifact']}")
+        print(f"report:   python tools/run_report.py {result['run_dir']}")
+    result["outputs"] = outputs  # post-record: oracle material only
+    return result
+
+
+def run_dry_fleet(record=False):
+    """Tier-1 CPU miniature of the fleet campaign
+    (tests/test_serving.py): the shared-prefix timeline through the
+    cache-off oracle, 1- and 2-replica fleets, and the session lanes.
+    Pins the deterministic halves of every headline claim — bitwise
+    cache-on == cache-off (asserted inside run_fleet_campaign), a
+    nonzero cache hit rate, session pins engaging, and the warm lane
+    computing STRICTLY fewer prefill tokens than the cold lane — and
+    leaves the timing claims (TTFT separation, tok/s scaling) to the
+    recorded campaign, where they belong."""
+    result = run_fleet_campaign(record=record, dry=True)
+    for name, lane in result["lanes"].items():
+        if "requests" in lane:  # session lanes assert internally
+            assert lane["completed"] == lane["requests"], (name, lane)
+            assert lane["errored"] == 0, (name, lane)
+    for r in (1, 2):
+        lane = result["lanes"][f"fleet_r{r}"]
+        assert lane["prefix_hit_rate"] > 0.25, (r, lane)
+        assert lane["prefix_hits"] > 0, (r, lane)
+        assert lane["shed_router"] == 0, (r, lane)
+        assert sum(lane["dispatch_per_replica"]) == lane["requests"]
+    assert result["lanes"]["cache_off_r1"]["prefix_hit_rate"] == 0.0
+    ses = result["session"]
+    assert ses["session_pins"] > 0, ses
+    assert ses["warm_prefill_tokens"] < ses["cold_prefill_tokens"], ses
+    return result
+
+
 def record_serving(result):
     """Flat artifact via record_bench_result + a run directory holding
     serving.json for tools/run_report.py."""
@@ -751,6 +1120,14 @@ def main() -> int:
     ap.add_argument("--spec", action="store_true",
                     help="speculative-decoding campaign: (kv_dtype x "
                     "draft_len) lanes + equal-pool resident sessions")
+    ap.add_argument("--fleet", action="store_true",
+                    help="prefix-cache + fleet campaign: shared-prefix "
+                    "Poisson traffic through the cache-off oracle and "
+                    "1/2/4-replica routed fleets, plus warm-session vs "
+                    "cold-turn lanes")
+    ap.add_argument("--replicas", type=int, nargs="+",
+                    default=(1, 2, 4),
+                    help="replica counts for the --fleet lanes")
     ap.add_argument("--requests", type=int, default=48)
     ap.add_argument("--rate", type=float, default=None,
                     help="Poisson arrival rate (req/s); default 24 for "
@@ -763,9 +1140,26 @@ def main() -> int:
                     "continuous lane: per-request timeline + SLO "
                     "windows land beside serving.json in the run dir")
     args = ap.parse_args()
+    if args.dry_run and args.fleet:
+        run_dry_fleet(record=not args.no_record)
+        print("serve_bench fleet dry-run ok")
+        return 0
     if args.dry_run and args.spec:
         run_dry_spec(record=not args.no_record)
         print("serve_bench spec dry-run ok")
+        return 0
+    if args.fleet:
+        result = run_fleet_campaign(
+            n_requests=args.requests, rate_hz=args.rate or 32.0,
+            seed=args.seed, record=not args.no_record,
+            replica_counts=tuple(args.replicas))
+        print(f"\nprefix cache hit rate (largest fleet): "
+              f"{result['prefix_hit_rate']:.1%}")
+        print(f"tokens/s vs replicas: "
+              f"{result['replica_scaling_tokens_per_sec']}")
+        print(f"warm vs cold turn>=2 TTFT p50: "
+              f"{result['session']['warm_ttft_p50_ms']} vs "
+              f"{result['session']['cold_ttft_p50_ms']} ms")
         return 0
     if args.dry_run:
         run_dry(record=not args.no_record)

@@ -148,14 +148,49 @@ def merge_runs(run_dirs, labels=None):
                           "ranks": stats}}
 
 
+def prefill_skips(merged):
+    """{(pid, rid): {"cached", "computed"}} — the prefix-cache outcome
+    per request, from the cached/computed token counts the serving
+    engine stamps on every `prefill_chunk` span (serving/engine.py):
+    how many prompt tokens this request never prefilled because their
+    KV blocks were already resident."""
+    out = {}
+    for e in merged["traceEvents"]:
+        if e.get("ph") == "M" or e.get("name") != "prefill_chunk":
+            continue
+        args = e.get("args") or {}
+        if "rid" not in args:
+            continue
+        out[(e["pid"], args["rid"])] = {
+            "cached": int(args.get("cached", 0)),
+            "computed": int(args.get("computed", 0))}
+    return out
+
+
 def write_merged(run_dirs, out_path, labels=None):
     merged = merge_runs(run_dirs, labels=labels)
+    skips = prefill_skips(merged)
+    if skips:
+        merged["otherData"]["prefill_skips"] = {
+            f"pid{pid}/rid{rid}": s
+            for (pid, rid), s in sorted(skips.items())}
     with open(out_path, "w") as f:
         json.dump(merged, f)
     n = sum(1 for e in merged["traceEvents"] if e["ph"] != "M")
     print(f"wrote {out_path}: {n} events from "
           f"{len(merged['otherData']['ranks'])} rank timeline(s) — "
           f"load in chrome://tracing or https://ui.perfetto.dev")
+    if skips:
+        cached = sum(s["cached"] for s in skips.values())
+        computed = sum(s["computed"] for s in skips.values())
+        hit = sum(1 for s in skips.values() if s["cached"])
+        print(f"prefix cache: {hit}/{len(skips)} request(s) skipped "
+              f"cached prefill — {cached:,} prompt token(s) served "
+              f"from cache, {computed:,} computed")
+        for (pid, rid), s in sorted(skips.items()):
+            if s["cached"]:
+                print(f"    pid {pid} rid {rid}: {s['cached']} cached "
+                      f"+ {s['computed']} computed")
     return merged
 
 
@@ -203,6 +238,10 @@ def selftest() -> int:
         c0.t = c1.t = 1.5
         r0.instant("watchdog_beat", "watchdog", step=3)
         r1.add_complete("wire_exposed", "wire", dur_us=800, step=4)
+        # a serving prefill span carrying the prefix-cache outcome
+        # (engine stamps cached/computed on every prefill_chunk)
+        r1.add_complete("prefill_chunk", "serve", dur_us=500, rid=7,
+                        pos=0, n=4, cached=12, computed=4)
         r0.close()
         r1.close()
         # rank 0 restarts 100 true seconds later: a second recorder
@@ -247,6 +286,11 @@ def selftest() -> int:
         # args survive the merge
         assert next(e for e in data
                     if e["name"] == "apply")["args"]["step"] == 3
+        # the per-request prefix-cache skip is recoverable from the
+        # merged stream (the trace-side view of kv.prefix_hit_tokens)
+        assert prefill_skips(merged) == {
+            (1, 7): {"cached": 12, "computed": 4}}, \
+            prefill_skips(merged)
         # the file round-trips through json and is self-describing
         blob = json.dumps(merged)
         back = json.loads(blob)
