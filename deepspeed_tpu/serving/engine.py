@@ -306,6 +306,15 @@ class ServeEngine:
     def resident_sessions(self) -> int:
         return len(self._sessions)
 
+    def session_active(self, sid) -> bool:
+        """True while routing `sid` here still wins: a resident pin,
+        or a live request carrying the session (whose natural finish
+        will re-pin it).  Safe from any thread — the fleet router's
+        affinity-staleness probe."""
+        if sid in self._sessions:
+            return True
+        return self.scheduler.has_session(sid)
+
     def _session_lookup(self, req: Request):
         """Scheduler hook: the pin `req` can adopt, or None.  A pin is
         only served when its history is a PREFIX of the new prompt —
@@ -540,8 +549,11 @@ class ServeEngine:
         if req.prefill_pos < len(req.prompt):
             return
         # final chunk committed: publish the prompt's full blocks under
-        # their chain hashes, starting past any adopted (decode-written)
-        # region — only prefill-written rows are bitwise-reproducible
+        # their chain hashes.  Pin-adopted requests carry NO hashes
+        # (scheduler._try_alloc): their prefill attended over
+        # decode-written rows, so nothing they wrote is safe to serve
+        # to third parties.  `start` skips the already-registered
+        # matched prefix.
         if req.block_hashes:
             start = -(-req.prefix_cached_tokens // self.kv.block_size)
             self.kv.register_prefix(req.rid, req.block_hashes, start)

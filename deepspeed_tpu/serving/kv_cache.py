@@ -356,7 +356,15 @@ class PagedKVCache:
         cow = (privatize_last and bool(shared)
                and self._ref.get(shared[-1], 0) > 0)
         fresh = n_blocks - len(shared) + (1 if cow else 0)
-        if fresh > self.free_blocks:
+        # `free_blocks` counts LRU-parked refcount-0 residents as
+        # allocatable — but the matched blocks can BE those residents
+        # (including the adopt-in-place last block).  Aliasing removes
+        # them from the LRU, so they must not also be counted as
+        # capacity for the fresh share, or _take_free would drain an
+        # empty pool mid-allocation.
+        lru_shared = sum(1 for b in set(shared)
+                         if self._ref.get(b, 0) == 0)
+        if fresh > len(self._free) + len(self._lru) - lru_shared:
             return None
         blocks: List[int] = []
         cow_pair = None
@@ -461,10 +469,13 @@ class PagedKVCache:
                         start: int = 0) -> int:
         """Publish `rid`'s blocks `start..len(hashes)-1` under their
         chain hashes (first registration wins — a concurrent identical
-        prompt keeps the incumbent).  Callers pass `start` past any
-        decode-written region: only prefill-written rows are pinned
-        bitwise against recomputation, so only those blocks are safe
-        to serve to other requests."""
+        prompt keeps the incumbent).  Only prefill-written rows are
+        pinned bitwise against recomputation, so only blocks from a
+        pure-prefill chain are safe to publish: a request that adopted
+        decode-written rows (session pins) must not call this at all —
+        everything it prefills attends over those rows.  `start` skips
+        the leading blocks that are already registered (the matched
+        prefix)."""
         if not self.prefix_enabled:
             return 0
         blocks = self._owned.get(rid)

@@ -12,8 +12,12 @@ the smallest thing that makes N of them act like one endpoint:
   exactly one replica, so a request carrying that `session_id` MUST
   land there (and does, even over the queue limit — re-prefilling the
   whole history elsewhere costs more than queueing).  The router
-  learns the mapping at first dispatch and drops it when the request
-  chain errors.
+  learns the mapping at dispatch; at the NEXT dispatch for that
+  session it probes the replica (`ServeEngine.session_active`) and
+  drops a stale mapping — pin expired, pressure-released, or chain
+  errored — falling back to least-loaded.  The map is additionally
+  swept of stale entries whenever it outgrows `affinity_cap`, so
+  many distinct one-shot session ids cannot grow it without bound.
 * **Queue spill-over** — when the least-loaded pick's waiting queue is
   at `queue_limit`, the request spills to the next-least-loaded
   replica with room (`router.spills`).
@@ -40,6 +44,7 @@ bytes/calls is the mean load a dispatch landed on);
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -71,22 +76,30 @@ def build_fleet(model, params, config: Optional[ServeConfig] = None,
 
 class FleetRouter:
     """Front door over a list of ServeEngine replicas.  `submit()` is
-    the whole API a frontend needs; `start()`/`close()` run one
+    the whole API a frontend needs — safe from any thread (a mutex
+    serializes choose/dispatch/affinity, so concurrent first turns of
+    one session land on ONE replica); `start()`/`close()` run one
     ServeWorker per replica so the engines decode concurrently (XLA
     releases the GIL during execution, so replicas overlap even in one
     process), and `run()` drives them synchronously for tests."""
 
     def __init__(self, engines: Sequence[ServeEngine],
-                 queue_limit: int = 64, session_affinity: bool = True):
+                 queue_limit: int = 64, session_affinity: bool = True,
+                 affinity_cap: int = 1024):
         if not engines:
             raise ValueError("FleetRouter needs at least one engine")
         if int(queue_limit) < 1:
             raise ValueError(
                 f"fleet queue_limit must be >= 1, got {queue_limit}")
+        if int(affinity_cap) < 1:
+            raise ValueError(
+                f"fleet affinity_cap must be >= 1, got {affinity_cap}")
         self.engines: List[ServeEngine] = list(engines)
         self.queue_limit = int(queue_limit)
         self.session_affinity = bool(session_affinity)
+        self.affinity_cap = int(affinity_cap)
         self._session_replica: Dict[Any, int] = {}
+        self._lock = threading.Lock()
         self._workers: List[ServeWorker] = []
         self.dispatched = 0
         self.spilled = 0
@@ -103,11 +116,16 @@ class FleetRouter:
 
     def _choose(self, session_id) -> Optional[int]:
         """The replica this request lands on, or None (saturated)."""
-        if (self.session_affinity and session_id is not None
-                and session_id in self._session_replica):
-            # hard affinity: the pin's blocks live there; even a full
-            # queue beats re-prefilling the whole history cold
-            return self._session_replica[session_id]
+        if self.session_affinity and session_id is not None:
+            i = self._session_replica.get(session_id)
+            if i is not None:
+                if self.engines[i].session_active(session_id):
+                    # hard affinity: the pin's blocks live there; even a
+                    # full queue beats re-prefilling the history cold
+                    return i
+                # pin expired / pressure-released / chain errored:
+                # nothing to be warm on — route by load like a cold turn
+                del self._session_replica[session_id]
         order = sorted(range(len(self.engines)), key=self._load)
         first_choice = order[0]
         for i in order:
@@ -125,28 +143,43 @@ class FleetRouter:
         """Route one request.  Returns the live Request from the chosen
         replica — or, with every queue at the limit, a Request already
         in state "error" that was never enqueued anywhere."""
-        i = self._choose(session_id)
-        if i is None:
-            self.shed += 1
-            COUNTERS.add("router.shed")
-            req = Request(prompt=[int(t) for t in prompt],
-                          max_new_tokens=int(max_new_tokens),
-                          session_id=session_id)
-            req.state = ERROR
-            req.error = (f"fleet saturated: every replica queue >= "
-                         f"{self.queue_limit}")
-            logger.warning(f"fleet router: shed a request ({req.error})")
-            return req
-        eng = self.engines[i]
-        COUNTERS.add("router.dispatches", nbytes=eng.kv.blocks_in_use)
-        self.dispatched += 1
-        if self.session_affinity and session_id is not None:
-            self._session_replica[session_id] = i
-        req = eng.submit(prompt, max_new_tokens, temperature=temperature,
-                         top_k=top_k, seed=seed, eos_token=eos_token,
-                         session_id=session_id)
+        with self._lock:
+            i = self._choose(session_id)
+            if i is None:
+                self.shed += 1
+                COUNTERS.add("router.shed")
+                req = Request(prompt=[int(t) for t in prompt],
+                              max_new_tokens=int(max_new_tokens),
+                              session_id=session_id)
+                req.state = ERROR
+                req.error = (f"fleet saturated: every replica queue >= "
+                             f"{self.queue_limit}")
+                logger.warning(
+                    f"fleet router: shed a request ({req.error})")
+                return req
+            eng = self.engines[i]
+            COUNTERS.add("router.dispatches", nbytes=eng.kv.blocks_in_use)
+            self.dispatched += 1
+            req = eng.submit(prompt, max_new_tokens,
+                             temperature=temperature, top_k=top_k,
+                             seed=seed, eos_token=eos_token,
+                             session_id=session_id)
+            if self.session_affinity and session_id is not None:
+                # recorded AFTER eng.submit so the sweep's liveness
+                # probe already sees this session's waiting request
+                self._session_replica[session_id] = i
+                if len(self._session_replica) > self.affinity_cap:
+                    self._sweep_affinity()
         req.replica = i
         return req
+
+    def _sweep_affinity(self) -> None:
+        """Drop every mapping whose replica no longer has the session
+        active (caller holds the lock) — the bound that keeps many
+        distinct one-shot session ids from growing the map forever."""
+        self._session_replica = {
+            sid: i for sid, i in self._session_replica.items()
+            if self.engines[i].session_active(sid)}
 
     # -- driving -------------------------------------------------------
 

@@ -189,7 +189,12 @@ class Scheduler:
             table = self.kv.alloc_from_pin(req.rid, needed, pin.owner)
             if table is None:
                 return None
-            req.block_hashes = self.kv.prefix_hashes(req.prompt)
+            # block_hashes stays EMPTY: the adopted blocks hold
+            # decode-written rows, which are not pinned bitwise against
+            # a cold-prefill recompute, and every block this request
+            # prefills attends over them — so none of its blocks may be
+            # published under token-only chain hashes for third-party
+            # matching (the cache-on/off exactness contract)
             req.cached_len = req.prefill_pos = pin.cached_len
             req.prefix_cached_tokens = pin.cached_len
             if pin.cached_len:
@@ -285,6 +290,14 @@ class Scheduler:
         with self._lock:
             waiting = bool(self._waiting)
         return waiting or any(s is not None for s in self.slots)
+
+    def has_session(self, sid) -> bool:
+        """A live (waiting or slot-resident) request carries `sid`."""
+        with self._lock:
+            if any(r.session_id == sid for r in self._waiting):
+                return True
+        return any(r is not None and r.session_id == sid
+                   for r in self.slots)
 
     @property
     def n_waiting(self) -> int:

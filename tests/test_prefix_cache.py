@@ -14,6 +14,7 @@ import json
 import os
 import subprocess
 import sys
+import threading
 
 import numpy as np
 import pytest
@@ -132,6 +133,34 @@ def test_alloc_exactly_exhausting_the_pool():
     assert kv.evictions == 2
     d = COUNTERS.delta_since(snap)
     assert d["kv.evictions"]["calls"] == 2
+
+
+def test_alloc_when_matched_blocks_are_the_lru_residents():
+    """The admission check must not double-count a matched block as
+    BOTH the shared prefix and reclaimable capacity: with the free
+    list dry and every LRU resident matched, an allocation needing
+    fresh tail blocks must return None (pool intact) — not drain an
+    empty pool mid-allocation."""
+    kv = _kv(num_blocks=7)            # 6 usable
+    toks = _tokens(12)
+    hashes = kv.prefix_hashes(toks)
+    kv.alloc("r1", 3)
+    kv.register_prefix("r1", hashes)
+    kv.free("r1")                     # LRU: 3 parked, free list: 3
+    kv.alloc("hold", 2)               # free list: 1
+    m = kv.match_prefix(hashes)
+    assert len(m) == 3
+    # fresh share = 2, but real capacity = 1 free + (3 LRU - 3 matched)
+    assert kv.alloc("r2", 5, shared=m) is None
+    # same overlap through the whole-prompt-cached adopt path
+    assert kv.alloc("r2", 5, shared=m, privatize_last=True) is None
+    # the refused allocation touched nothing: blocks stay matchable
+    assert kv.blocks_in_use == 2 and kv.cached_blocks == 3
+    assert kv.match_prefix(hashes) == m
+    kv.free("hold")                   # free list: 3 -> now it fits
+    assert kv.alloc("r2", 5, shared=m) is not None
+    assert kv.blocks_of("r2")[:3] == m
+    assert kv.blocks_in_use == 5
 
 
 # -- prefix cache: hashing, refcounts, LRU, eviction, COW -------------------
@@ -379,6 +408,29 @@ def test_session_pin_second_turn_prefills_only_new_tokens(
     assert eng.release_session("chat") is False     # already gone
 
 
+def test_pin_adopted_turns_publish_no_prefix_blocks(model_and_params):
+    """A warm turn's prefill attends over the pin's decode-written
+    rows, which are NOT bitwise-pinned against a cold recompute — so
+    none of its blocks may be published under token-only chain hashes.
+    Third parties must match only the turn-1 (pure-prefill) blocks."""
+    eng = _engine(model_and_params)
+    p1 = _tokens(10, seed=37)                  # registers 2 full blocks
+    r1 = eng.submit(p1, 5, session_id="pub")
+    eng.run()
+    assert eng.kv.cached_blocks == 2
+    hist = p1 + r1.out                         # 15 tokens
+    p2 = hist + _tokens(6, seed=38)            # 21 tokens, 5 full blocks
+    r2 = eng.submit(p2, 4, session_id="pub")
+    eng.run()
+    assert r2.prefix_cached_tokens == len(hist) - 1
+    assert r2.block_hashes == []               # adopted -> never publish
+    # block 4 (tokens 16..19) was prefilled ATTENDING over the pin's
+    # decode rows; with the old registration it became matchable
+    assert eng.kv.cached_blocks == 2
+    h2 = eng.kv.prefix_hashes(p2)
+    assert len(eng.kv.match_prefix(h2)) == 2   # only turn-1's blocks
+
+
 def test_session_edited_history_falls_back_loudly(model_and_params):
     """A turn whose prompt is NOT a prefix-extension of the pinned
     history (user edited the conversation) releases the pin and falls
@@ -432,6 +484,8 @@ def test_build_fleet_shares_programs_and_validates(model_and_params):
         FleetRouter([])
     with pytest.raises(ValueError, match="queue_limit"):
         FleetRouter(engines, queue_limit=0)
+    with pytest.raises(ValueError, match="affinity_cap"):
+        FleetRouter(engines, affinity_cap=0)
     for e in engines:
         e.close()
 
@@ -478,6 +532,92 @@ def test_router_session_affinity_beats_load(model_and_params):
     assert r2.replica == home
     router.run()
     assert r2.prefix_cached_tokens == len(hist) - 1
+    router.close()
+
+
+def test_router_affinity_dropped_when_pin_released(model_and_params):
+    """Affinity must not outlive the pin: once the engine released the
+    session (TTL here; pressure/error chains behave the same), the next
+    turn routes by load and the stale mapping is dropped — a dead
+    session must not keep hammering one replica forever."""
+    model, params = model_and_params
+    clk = _Clock()
+    engines = build_fleet(model, params, _cfg(), replicas=2,
+                          programs=_PROGRAMS[("dense", 0)], clock=clk)
+    router = FleetRouter(engines, queue_limit=4)
+    p1 = _tokens(10, seed=61)
+    r1 = router.submit(p1, 5, session_id="aff")
+    router.run()
+    home = r1.replica
+    other = 1 - home
+    assert engines[home].resident_sessions == 1
+    # make home the LOADED replica: only stale affinity would pick it
+    busy = engines[home].submit(_tokens(8, seed=62), 12)
+    engines[home].step()
+    assert (engines[home].kv.blocks_in_use
+            > engines[other].kv.blocks_in_use)
+    clk.t += engines[home].config.session_ttl_s + 1
+    engines[home].step()                       # TTL releases the pin
+    assert engines[home].resident_sessions == 0
+    r2 = router.submit(_tokens(6, seed=63), 4, session_id="aff")
+    assert r2.replica == other
+    assert router._session_replica["aff"] == other
+    router.run()
+    assert all(r.state == FINISHED for r in (r1, busy, r2))
+    router.close()
+
+
+def test_router_affinity_map_swept_at_cap(model_and_params):
+    """The affinity map is bounded: overflowing `affinity_cap` sweeps
+    every mapping whose session is no longer active on its replica,
+    so many distinct one-shot session ids cannot grow it forever."""
+    model, params = model_and_params
+    engines = build_fleet(model, params, _cfg(), replicas=2,
+                          programs=_PROGRAMS[("dense", 0)])
+    router = FleetRouter(engines, queue_limit=4, affinity_cap=1)
+    ra = router.submit(_tokens(6, seed=64), 3, session_id="a")
+    router.run()
+    assert engines[ra.replica].release_session("a")   # chain abandoned
+    rb = router.submit(_tokens(6, seed=65), 3, session_id="b")
+    assert set(router._session_replica) == {"b"}      # dead "a" swept
+    router.run()
+    assert rb.state == FINISHED
+    router.close()
+
+
+def test_router_submit_is_thread_safe(model_and_params):
+    """Concurrent frontend submits: counters stay consistent and
+    concurrent first turns of ONE session land on one replica (the
+    race the dispatch mutex exists to close)."""
+    model, params = model_and_params
+    engines = build_fleet(model, params, _cfg(), replicas=2,
+                          programs=_PROGRAMS[("dense", 0)])
+    router = FleetRouter(engines, queue_limit=64)
+    reqs, errs = [], []
+    guard = threading.Lock()
+
+    def frontend(k):
+        try:
+            for _ in range(4):
+                r = router.submit(_tokens(5, seed=70 + k), 2,
+                                  session_id="t" if k % 2 == 0 else None)
+                with guard:
+                    reqs.append(r)
+        except Exception as e:                 # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=frontend, args=(k,))
+               for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert router.dispatched == len(reqs) == 32
+    homes = {r.replica for r in reqs if r.session_id == "t"}
+    assert len(homes) == 1
+    router.run()
+    assert all(r.state == FINISHED for r in reqs)
     router.close()
 
 
